@@ -1,0 +1,23 @@
+"""cometbft_tpu — a TPU-native BFT consensus framework.
+
+A ground-up re-design of the capabilities of CometBFT (the production fork of
+Tendermint Core; reference: sujae-yu/cometbft) for TPU hosts:
+
+- Orchestration (consensus rounds, p2p, storage, ABCI) is Python/asyncio —
+  the reference's Go logic is I/O-bound control flow.
+- The compute kernels — ed25519 batch signature verification (point
+  decompression, double-scalar multiplication, SHA-512), SHA-256/merkle
+  hashing — are JAX programs compiled by XLA for TPU, vectorized over
+  signature lanes and sharded over device meshes via ``shard_map``.
+
+Package layout:
+    ops/        JAX/XLA TPU kernels (field arithmetic, curve ops, hashes)
+    parallel/   device-mesh sharding + cross-height batch coalescing
+    crypto/     host-side crypto API (keys, signing, batch-verifier dispatch)
+    types/      block / vote / commit / validator data model + verification
+    models/     replicated applications (ABCI state machines, e.g. kvstore)
+    consensus/  the BFT state machine, WAL, replay
+    ...         (mempool, p2p, blocksync, light, state, store, node, rpc)
+"""
+
+__version__ = "0.1.0"

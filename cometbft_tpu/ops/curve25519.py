@@ -1,0 +1,143 @@
+"""Edwards25519 group operations for TPU, vectorized over batch lanes.
+
+Points are extended homogeneous coordinates ``(X, Y, Z, T)`` — a tuple of
+four limb vectors shaped ``(20, N...)`` (see
+:mod:`cometbft_tpu.ops.fe25519`) — with x = X/Z, y = Y/Z, x*y = T/Z.
+
+The addition law used ("add-2008-hwcd-3" for a = -1) is **complete** on
+edwards25519 (a = -1 is square mod p, d is non-square), so identity and
+small-order points need no special casing — crucial for branch-free SIMD
+lanes and for ZIP-215 semantics where small/mixed-order points are valid
+inputs (reference behavior: curve25519-voi as used by
+crypto/ed25519/ed25519.go in the reference repo).
+
+Decompression follows curve25519-dalek / ZIP-215: non-canonical y (>= p)
+accepted, x = 0 with sign bit 1 accepted (yields x = 0).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import fe25519 as fe
+
+P = fe.P
+_D = (-121665 * pow(121666, P - 2, P)) % P
+_SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+# base point y = 4/5
+_BY = 4 * pow(5, P - 2, P) % P
+
+
+def _recover_bx():
+    x2 = (_BY * _BY - 1) * pow(_D * _BY * _BY + 1, P - 2, P) % P
+    x = pow(x2, (P + 3) // 8, P)
+    if (x * x - x2) % P:
+        x = x * _SQRT_M1 % P
+    if x & 1:
+        x = P - x
+    return x
+
+
+_BX = _recover_bx()
+BASE_AFFINE = (_BX, _BY)
+
+
+def _c(x: int, ndim: int = 2):
+    return fe.const(x, ndim - 1)
+
+
+def identity(shape=()):
+    one = jnp.broadcast_to(
+        fe.const(1, max(len(shape), 1)), (fe.NLIMBS,) + shape
+    )
+    return (fe.zero(shape), one, one, fe.zero(shape))
+
+
+def base_lanes(shape):
+    """The base point broadcast to batch shape (20, *shape)."""
+    nd = max(len(shape), 1)
+    return tuple(
+        jnp.broadcast_to(fe.const(v, nd), (fe.NLIMBS,) + shape)
+        for v in (_BX, _BY, 1, _BX * _BY % P)
+    )
+
+
+def add(p, q):
+    """Complete unified addition (add-2008-hwcd-3, a = -1)."""
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    nd = max(X1.ndim, X2.ndim)
+    A = fe.mul(fe.sub(Y1, X1), fe.sub(Y2, X2))
+    B = fe.mul(fe.add(Y1, X1), fe.add(Y2, X2))
+    C = fe.mul(fe.mul(T1, fe.const(2 * _D % P, nd - 1)), T2)
+    ZZ = fe.mul(Z1, Z2)
+    Dv = fe.add(ZZ, ZZ)
+    E = fe.sub(B, A)
+    F = fe.sub(Dv, C)
+    G = fe.add(Dv, C)
+    H = fe.add(B, A)
+    return (fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H))
+
+
+def double(p):
+    """Doubling (dbl-2008-hwcd, a = -1); valid for all points."""
+    X1, Y1, Z1, _ = p
+    A = fe.square(X1)
+    B = fe.square(Y1)
+    Zsq = fe.square(Z1)
+    C = fe.add(Zsq, Zsq)
+    H = fe.add(A, B)
+    E = fe.sub(H, fe.square(fe.add(X1, Y1)))
+    G = fe.sub(A, B)
+    F = fe.add(C, G)
+    return (fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H))
+
+
+def negate(p):
+    X, Y, Z, T = p
+    return (fe.neg(X), Y, Z, fe.neg(T))
+
+
+def select(mask, p, q):
+    """Lane-wise point select: where(mask, p, q)."""
+    return tuple(fe.select(mask, a, b) for a, b in zip(p, q))
+
+
+def is_identity(p):
+    X, Y, Z, _ = p
+    return fe.is_zero(X) & fe.is_zero(fe.sub(Y, Z))
+
+
+def decompress(b):
+    """(32, N...) uint8 -> (point, ok). ZIP-215/dalek-liberal decoding.
+
+    Invalid (non-square) lanes return ok=False with the identity point so
+    downstream math stays finite.
+    """
+    y, sign = fe.from_bytes_255(b)
+    nd = y.ndim
+    one = fe.const(1, nd - 1)
+    ysq = fe.square(y)
+    u = fe.sub(ysq, one)
+    v = fe.add(fe.mul(ysq, fe.const(_D, nd - 1)), one)
+    # candidate root r = u * v^3 * (u * v^7)^((p-5)/8)
+    v3 = fe.mul(fe.square(v), v)
+    v7 = fe.mul(fe.square(v3), v)
+    r = fe.mul(fe.mul(u, v3), fe.pow2523(fe.mul(u, v7)))
+    check = fe.mul(v, fe.square(r))
+    root_ok = fe.eq(check, u)
+    root_neg = fe.eq(check, fe.neg(u))
+    ok = root_ok | root_neg
+    x = fe.select(root_neg, fe.mul(r, fe.const(_SQRT_M1, nd - 1)), r)
+    # match requested sign (x = 0 stays 0; -0 == 0 under mod p)
+    flip = fe.parity(x) != sign
+    x = fe.select(flip, fe.neg(x), x)
+    shape = y.shape[1:]
+    one_b = jnp.broadcast_to(one, (fe.NLIMBS,) + shape)
+    pt = (x, y, one_b, fe.mul(x, y))
+    return select(ok, pt, identity(shape)), ok
+
+
+def mul_by_cofactor(p):
+    return double(double(double(p)))

@@ -1,0 +1,183 @@
+"""Scalar arithmetic mod L = 2^252 + 27742...493 for TPU lanes.
+
+Scalars are plain (non-modular-redundant) little-endian 13-bit limb
+vectors in int32, **limb axis first** (shape ``(nlimbs, N...)``), length
+20 (260 bits) unless noted. The SHA-512 output reduction (512 bits ->
+mod L) uses iterated folding at bit 252:
+
+    X = hi * 2^252 + lo   ==>   X ≡ lo - hi*c  (mod L),  c = L - 2^252.
+
+To keep every intermediate *nonnegative* (so vectorized borrow
+propagation converges to canonical limbs for the next bit extraction),
+each fold adds a compensating multiple of L:
+
+    X' = lo + (L << s_j) - hi*c   >=  0,     (L << s_j) ≡ 0 (mod L).
+
+Four folds bring 512 bits to < L + 2^252 < 2L; one conditional subtract
+finishes. All shapes and loops are static for XLA.
+
+Replaces the reference's big-int `mod L` in ed25519 verification
+(crypto/ed25519 + curve25519-voi scalar arithmetic).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from .fe25519 import LIMB_BITS, MASK, NLIMBS
+
+L = 2**252 + 27742317777372353535851937790883648493
+_C = L - 2**252  # 125 bits
+
+
+def _raw(x: int, n: int) -> np.ndarray:
+    assert 0 <= x < 1 << (n * LIMB_BITS)
+    out = np.zeros(n, np.int32)
+    for i in range(n):
+        out[i] = x & MASK
+        x >>= LIMB_BITS
+    return out
+
+
+_C_LIMBS = _raw(_C, 10)
+_L_LIMBS = _raw(L, 20)
+
+
+def _cst(arr: np.ndarray, ndim: int):
+    return jnp.asarray(arr).reshape(arr.shape + (1,) * (ndim - 1))
+
+
+def from_limbs(limbs) -> int:
+    arr = np.asarray(limbs, dtype=np.int64)
+    val = 0
+    for i in reversed(range(arr.shape[0])):
+        val = (val << LIMB_BITS) + int(arr[i])
+    return val
+
+
+def carry_plain(x, rounds=None):
+    """Carry/borrow propagation, no modular wraparound (carry-out of the
+    top limb must be impossible by construction — keep a headroom limb).
+    Works for signed limbs provided the represented *value* is
+    nonnegative and rounds >= nlimbs + 6 when borrows may ripple."""
+    if rounds is None:
+        rounds = x.shape[0] + 6
+    for _ in range(rounds):
+        c = lax.shift_right_arithmetic(x, LIMB_BITS)
+        r = jnp.bitwise_and(x, MASK)
+        x = r.at[1:].add(c[:-1])
+    return x
+
+
+def _conv(a, b_const: np.ndarray):
+    """Full product limbs(a) x constant limbs -> len(a)+len(b) limbs."""
+    na, nb = a.shape[0], b_const.shape[0]
+    c = jnp.zeros((na + nb,) + a.shape[1:], jnp.int32)
+    bc = _cst(b_const, a.ndim)
+    for i in range(na):
+        c = c.at[i : i + nb].add(a[i] * bc)
+    return c
+
+
+def _split_252(x):
+    """x: canonical nonneg limbs (n, N...) -> (lo = x mod 2^252 as 20
+    limbs, hi = x >> 252 with n-19 limbs)."""
+    n = x.shape[0]
+    lo = x[:NLIMBS].at[19].set(jnp.bitwise_and(x[19], 31))
+    pad = jnp.zeros((1,) + x.shape[1:], jnp.int32)
+    xp = jnp.concatenate([x, pad], axis=0)
+    hi = jnp.bitwise_and(
+        lax.shift_right_arithmetic(xp[19:n], 5)
+        | (jnp.bitwise_and(xp[20 : n + 1], 31) << 8),
+        MASK,
+    )
+    return lo, hi
+
+
+def _ge_limbs(a, b_const: np.ndarray):
+    """Lexicographic a >= b for canonical nonneg limb vectors."""
+    bc = _cst(b_const, a.ndim)
+    gt = a > bc
+    lt = a < bc
+    ge = jnp.zeros(a.shape[1:], bool)
+    eq_above = jnp.ones(a.shape[1:], bool)
+    for i in reversed(range(a.shape[0])):
+        ge = ge | (eq_above & gt[i])
+        eq_above = eq_above & ~gt[i] & ~lt[i]
+    return ge | eq_above
+
+
+def _fold_once(x, shift: int):
+    """One fold: canonical nonneg x -> x' ≡ x (mod L), carried canonical."""
+    lo, hi = _split_252(x)
+    hic = _conv(hi, _C_LIMBS)
+    k = L << shift
+    nk = (k.bit_length() + LIMB_BITS - 1) // LIMB_BITS + 1
+    n = max(lo.shape[0], hic.shape[0], nk) + 1
+    kl = _cst(_raw(k, n), x.ndim)
+
+    def pad(v):
+        return jnp.concatenate(
+            [v, jnp.zeros((n - v.shape[0],) + v.shape[1:], jnp.int32)],
+            axis=0,
+        )
+
+    out = pad(lo) + kl - pad(hic)
+    return carry_plain(out)
+
+
+def reduce_512(x40):
+    """(40, N...) limbs of a 512-bit LE integer -> canonical scalar mod L,
+    (20, N...) limbs in [0, L)."""
+    x = carry_plain(x40)
+    x = _fold_once(x, 134)   # < 2^388
+    x = _fold_once(x, 10)    # < 2^263
+    x = _fold_once(x, 0)     # < L + 2^252 < 2L
+    x = _fold_once(x, 0)     # safety margin, keeps < 2L
+    x = x[:NLIMBS]
+    ge = _ge_limbs(x, _L_LIMBS)
+    x = jnp.where(ge[None], x - _cst(_L_LIMBS, x.ndim), x)
+    return carry_plain(x)
+
+
+def neg_mod_L(h):
+    """L - h for canonical h in [0, L). h = 0 maps to L (a 253-bit value),
+    harmless in cofactored verification: [8][L]A = identity for any A."""
+    return carry_plain(_cst(_L_LIMBS, h.ndim) - h)
+
+
+def lt_L(s):
+    """Canonicity check s < L for canonical nonneg 20-limb scalars."""
+    return ~_ge_limbs(s, _L_LIMBS)
+
+
+def bits(s, n: int = 253):
+    """(20, N...) limbs -> (n, N...) bit planes, little-endian bit order
+    (leading axis = bit index, ready for fori_loop dynamic indexing)."""
+    planes = []
+    for j in range(n):
+        limb, off = divmod(j, LIMB_BITS)
+        planes.append(
+            jnp.bitwise_and(lax.shift_right_arithmetic(s[limb], off), 1)
+        )
+    return jnp.stack(planes, axis=0)
+
+
+def hash_bytes_to_limbs(b):
+    """(64, N...) uint8 digest bytes (LE integer) -> (40, N...) limbs."""
+    b = b.astype(jnp.int32)
+    pad = jnp.zeros((2,) + b.shape[1:], jnp.int32)
+    b = jnp.concatenate([b, pad], axis=0)
+    limbs = []
+    for i in range(40):
+        bit = LIMB_BITS * i
+        byte, off = bit // 8, bit % 8
+        v = (
+            lax.shift_right_arithmetic(b[byte], off)
+            | (b[byte + 1] << (8 - off))
+            | (b[byte + 2] << (16 - off))
+        )
+        limbs.append(jnp.bitwise_and(v, MASK))
+    return jnp.stack(limbs, axis=0)

@@ -1,0 +1,15 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform.
+
+Multi-chip TPU hardware is not available in CI; shardings are validated on a
+virtual CPU mesh exactly as the driver's dryrun does.  Must run before any
+``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,119 @@
+"""Differential tests: JAX limb field arithmetic vs python big ints.
+
+Layout convention: limb axis first, batch last — shape (20, N).
+"""
+
+import random
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from cometbft_tpu.ops import fe25519 as fe
+
+P = fe.P
+rng = random.Random(1234)
+
+
+def rand_ints(n):
+    vals = [0, 1, 2, P - 1, P - 2, P, P + 1, 2 * P - 1, (1 << 255) - 1]
+    while len(vals) < n:
+        vals.append(rng.randrange(0, 1 << 256))
+    return vals[:n]
+
+
+def limbs_of(vals):
+    return jnp.asarray(np.stack([fe.to_limbs(v) for v in vals], axis=1))
+
+
+def check_all(got_limbs, want_ints):
+    got = np.asarray(got_limbs)
+    for i, w in enumerate(want_ints):
+        assert fe.from_limbs(got[:, i]) == w % P, (
+            f"lane {i}: got {fe.from_limbs(got[:, i])} want {w % P}"
+        )
+
+
+def test_roundtrip():
+    vals = rand_ints(16)
+    check_all(limbs_of(vals), vals)
+
+
+def test_add_sub_mul_square():
+    vals_a = rand_ints(32)
+    vals_b = list(reversed(rand_ints(32)))
+    a, b = limbs_of(vals_a), limbs_of(vals_b)
+    check_all(fe.add(a, b), [x + y for x, y in zip(vals_a, vals_b)])
+    check_all(fe.sub(a, b), [x - y for x, y in zip(vals_a, vals_b)])
+    check_all(fe.neg(a), [-x for x in vals_a])
+    check_all(fe.mul(a, b), [x * y for x, y in zip(vals_a, vals_b)])
+    check_all(fe.square(a), [x * x for x in vals_a])
+    check_all(fe.mul_scalar(a, 121666), [x * 121666 for x in vals_a])
+
+
+def test_mul_chains_stay_bounded():
+    # repeated dependent muls must keep limbs in a range where the
+    # convolution cannot overflow int32
+    vals = rand_ints(8)
+    a = limbs_of(vals)
+    mulj = jax.jit(fe.mul)
+    acc_limbs = a
+    acc_int = list(vals)
+    for _ in range(30):
+        acc_limbs = mulj(acc_limbs, a)
+        acc_int = [x * y for x, y in zip(acc_int, vals)]
+        assert int(jnp.max(jnp.abs(acc_limbs))) < (1 << 14)
+    check_all(acc_limbs, acc_int)
+
+
+def test_add_then_mul():
+    vals_a, vals_b = rand_ints(16), list(reversed(rand_ints(16)))
+    a, b = limbs_of(vals_a), limbs_of(vals_b)
+    s = fe.add(a, b)
+    check_all(fe.mul(s, s), [(x + y) ** 2 for x, y in zip(vals_a, vals_b)])
+    d = fe.sub(a, b)
+    check_all(fe.mul(d, d), [(x - y) ** 2 for x, y in zip(vals_a, vals_b)])
+
+
+def test_invert_pow2523():
+    vals = [v for v in rand_ints(16) if v % P != 0]
+    a = limbs_of(vals)
+    check_all(jax.jit(fe.invert)(a), [pow(v, P - 2, P) for v in vals])
+    check_all(jax.jit(fe.pow2523)(a), [pow(v, (P - 5) // 8, P) for v in vals])
+
+
+def test_predicates():
+    vals = [0, P, 2 * P, 1, P - 1, P + 1, 5, 2 * P - 1]
+    a = limbs_of(vals)
+    z = np.asarray(fe.is_zero(a))
+    assert list(z) == [v % P == 0 for v in vals]
+    par = np.asarray(fe.parity(a))
+    assert list(par) == [(v % P) & 1 for v in vals]
+    # negative representations
+    b = fe.sub(fe.zero((len(vals),)), a)
+    z2 = np.asarray(fe.is_zero(b))
+    assert list(z2) == [v % P == 0 for v in vals]
+    par2 = np.asarray(fe.parity(b))
+    assert list(par2) == [(-v) % P & 1 for v in vals]
+
+
+def test_from_bytes():
+    vals = rand_ints(16)
+    raw = np.stack(
+        [np.frombuffer(v.to_bytes(32, "little"), np.uint8) for v in vals],
+        axis=1,
+    )
+    limbs, sign = fe.from_bytes_255(jnp.asarray(raw))
+    for i, v in enumerate(vals):
+        assert (
+            fe.from_limbs(np.asarray(limbs)[:, i])
+            == (v & ((1 << 255) - 1)) % P
+        )
+        assert int(sign[i]) == v >> 255
+    limbs256 = fe.from_bytes_256(jnp.asarray(raw))
+    for i, v in enumerate(vals):
+        got = 0
+        arr = np.asarray(limbs256)[:, i]
+        for j in reversed(range(fe.NLIMBS)):
+            got = (got << fe.LIMB_BITS) + int(arr[j])
+        assert got == v

@@ -1,0 +1,61 @@
+"""Reference ed25519 oracle vs the `cryptography` library (OpenSSL)."""
+
+import os
+
+import pytest
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+from cometbft_tpu.crypto import ref_ed25519 as ref
+
+
+def test_sign_matches_openssl():
+    for i in range(8):
+        seed = os.urandom(32)
+        sk = Ed25519PrivateKey.from_private_bytes(seed)
+        msg = os.urandom(i * 17)
+        ours = ref.sign(seed, msg)
+        from cryptography.hazmat.primitives import serialization
+
+        pub = sk.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        assert ref.public_from_seed(seed) == pub
+        theirs = sk.sign(msg)
+        assert ours == theirs
+
+
+def test_verify_roundtrip_and_negatives():
+    seed = os.urandom(32)
+    pub = ref.public_from_seed(seed)
+    msg = b"cometbft_tpu vote sign bytes"
+    sig = ref.sign(seed, msg)
+    assert ref.verify_zip215(pub, msg, sig)
+    assert not ref.verify_zip215(pub, msg + b"x", sig)
+    bad = bytearray(sig)
+    bad[3] ^= 1
+    assert not ref.verify_zip215(pub, msg, bytes(bad))
+    # non-canonical S rejected
+    s = int.from_bytes(sig[32:], "little") + ref.L
+    if s < 2**256:
+        assert not ref.verify_zip215(pub, msg, sig[:32] + s.to_bytes(32, "little"))
+
+
+def test_zip215_liberal_decoding():
+    # y >= p encodings must be accepted as points (reduced mod p).
+    # Encoding of y = p + 1 == y = 1 (the identity's y); with sign 0.
+    enc = (ref.P + 1).to_bytes(32, "little")
+    pt = ref.point_decompress(enc)
+    assert pt is not None
+    assert ref.point_equal(pt, ref.IDENTITY)
+    # small-order point (y = -1, order 2) decodes fine
+    enc2 = (ref.P - 1).to_bytes(32, "little")
+    assert ref.point_decompress(enc2) is not None
+
+
+def test_small_order_pubkey_cofactored():
+    # A signature by the identity pubkey: A = identity, R = identity, S = 0
+    # verifies under the cofactored equation for h*identity = identity,
+    # S*B = identity iff S = 0.
+    ident = ref.point_compress(ref.IDENTITY)
+    sig = ident + b"\x00" * 32
+    assert ref.verify_zip215(ident, b"anything", sig)

@@ -1,0 +1,145 @@
+"""Differential tests for scalar mod-L ops and Edwards point ops.
+
+Layout convention: limb axis first, batch last — shape (20, N).
+"""
+
+import random
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from cometbft_tpu.crypto import ref_ed25519 as ref
+from cometbft_tpu.ops import curve25519 as curve
+from cometbft_tpu.ops import fe25519 as fe
+from cometbft_tpu.ops import sc25519 as sc
+
+rng = random.Random(99)
+L, P = sc.L, fe.P
+
+
+def _stack_raw(vals, n):
+    return jnp.asarray(np.stack([sc._raw(v, n) for v in vals], axis=1))
+
+
+def test_reduce_512():
+    vals = [0, 1, L - 1, L, L + 1, 2**252, 2**512 - 1, sc._C]
+    while len(vals) < 24:
+        vals.append(rng.randrange(0, 1 << 512))
+    x = _stack_raw(vals, 40)
+    got = np.asarray(jax.jit(sc.reduce_512)(x))
+    for i, v in enumerate(vals):
+        assert sc.from_limbs(got[:, i]) == v % L, i
+
+
+def test_neg_lt_bits():
+    vals = [0, 1, L - 1, 2**252] + [rng.randrange(0, L) for _ in range(12)]
+    h = _stack_raw(vals, 20)
+    got = np.asarray(sc.neg_mod_L(h))
+    for i, v in enumerate(vals):
+        assert sc.from_limbs(got[:, i]) == L - v, i  # -0 -> L by design
+    # lt_L
+    vals2 = [0, L - 1, L, L + 1, 2**255 - 1]
+    s = _stack_raw(vals2, 20)
+    assert list(np.asarray(sc.lt_L(s))) == [v < L for v in vals2]
+    # bits
+    b = np.asarray(sc.bits(h))
+    for i, v in enumerate(vals):
+        for j in range(253):
+            assert int(b[j, i]) == (v >> j) & 1
+
+
+def _pt_lanes(pts):
+    """list of ref extended points -> lane arrays (affine-normalized)."""
+    xs, ys = [], []
+    for p in pts:
+        zi = pow(p[2], P - 2, P)
+        xs.append(p[0] * zi % P)
+        ys.append(p[1] * zi % P)
+    X = jnp.asarray(np.stack([fe.to_limbs(x) for x in xs], axis=1))
+    Y = jnp.asarray(np.stack([fe.to_limbs(y) for y in ys], axis=1))
+    Z = jnp.broadcast_to(fe.const(1), X.shape)
+    T = fe.mul(X, Y)
+    return (X, Y, Z, T)
+
+
+def _lanes_to_affine(pt):
+    X, Y, Z, _ = (np.asarray(c) for c in pt)
+    out = []
+    for i in range(X.shape[1]):
+        zi = pow(fe.from_limbs(Z[:, i]), P - 2, P)
+        out.append(
+            (
+                fe.from_limbs(X[:, i]) * zi % P,
+                fe.from_limbs(Y[:, i]) * zi % P,
+            )
+        )
+    return out
+
+
+def _rand_points(n):
+    return [ref.point_mul(rng.randrange(1, L), ref.BASE) for _ in range(n)]
+
+
+def test_add_double_negate():
+    pa, pb = _rand_points(8), _rand_points(8)
+    la, lb = _pt_lanes(pa), _pt_lanes(pb)
+    got = _lanes_to_affine(curve.add(la, lb))
+    for i in range(8):
+        w = ref.point_add(pa[i], pb[i])
+        zi = pow(w[2], P - 2, P)
+        assert got[i] == (w[0] * zi % P, w[1] * zi % P)
+    got2 = _lanes_to_affine(curve.double(la))
+    for i in range(8):
+        w = ref.point_double(pa[i])
+        zi = pow(w[2], P - 2, P)
+        assert got2[i] == (w[0] * zi % P, w[1] * zi % P)
+    # complete law: P + identity, P + P, P + (-P)
+    ident = curve.identity((8,))
+    assert _lanes_to_affine(curve.add(la, ident)) == _lanes_to_affine(la)
+    negs = curve.negate(la)
+    assert list(np.asarray(curve.is_identity(curve.add(la, negs)))) == [True] * 8
+    assert _lanes_to_affine(curve.add(la, la)) == got2
+
+
+def test_decompress():
+    pts = _rand_points(6)
+    encs = [ref.point_compress(p) for p in pts]
+    # liberal encoding: y >= p; then a non-point
+    encs.append((ref.P + 1).to_bytes(32, "little"))  # y=1 -> identity
+    yv = 2
+    while ref._recover_x(yv, 0) is not None:
+        yv += 1
+    encs.append(yv.to_bytes(32, "little"))
+    raw = jnp.asarray(
+        np.stack([np.frombuffer(e, np.uint8) for e in encs], axis=1)
+    )
+    pt, ok = jax.jit(curve.decompress)(raw)
+    okl = list(np.asarray(ok))
+    assert okl == [True] * 7 + [False]
+    aff = _lanes_to_affine(pt)
+    for i, p in enumerate(pts):
+        zi = pow(p[2], P - 2, P)
+        assert aff[i] == (p[0] * zi % P, p[1] * zi % P)
+    assert aff[6] == (0, 1)  # identity from y = p+1
+
+
+def test_decompress_sign_bit_and_x0():
+    # x = 0, sign = 1 (non-canonical): ZIP-215 accepts, x stays 0.
+    enc = bytearray((1).to_bytes(32, "little"))
+    enc[31] |= 0x80
+    p1 = _rand_points(1)[0]
+    enc2 = ref.point_compress(p1)
+    raw = jnp.asarray(
+        np.stack(
+            [np.frombuffer(bytes(enc), np.uint8),
+             np.frombuffer(enc2, np.uint8)],
+            axis=1,
+        )
+    )
+    pt, ok = jax.jit(curve.decompress)(raw)
+    assert list(np.asarray(ok)) == [True, True]
+    aff = _lanes_to_affine(pt)
+    assert aff[0] == (0, 1)
+    zi = pow(p1[2], P - 2, P)
+    assert aff[1] == (p1[0] * zi % P, p1[1] * zi % P)
