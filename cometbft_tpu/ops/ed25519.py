@@ -1,0 +1,152 @@
+"""Batched ed25519 signature verification on TPU (the north-star kernel).
+
+Replaces the reference's batch-verification seam — curve25519-voi's
+``BatchVerifier`` created by ``crypto/batch/batch.go:10`` and consumed by
+``types/validation.go:261 verifyCommitBatch`` — with one XLA program that
+verifies N signatures in parallel lanes:
+
+    per lane:  h  = SHA-512(R || A || M)  mod L          (on device)
+               ok = [8]([S]B - [h]A - R) == identity     (ZIP-215, cofactored)
+
+The double-scalar multiplication [S]B + [L-h]A runs as a shared 253-step
+Straus ladder (1 doubling + 1 complete addition per step, 4-way
+branch-free point select), vectorized over the batch on the 8x128 VPU
+lanes. All point/field math is int32 limb arithmetic (see fe25519).
+
+Unlike the reference's random-linear-combination batch verify (which
+rejects the whole batch on one bad signature and needs a CPU fallback
+pass), every lane here returns its own verdict — a failed commit
+verification can point at the exact bad vote with no re-verification.
+
+The cofactored equation with per-lane verdicts is exactly ZIP-215, so
+results match curve25519-voi vote-by-vote (reference
+types/validation.go:261-320 semantics, including its all-or-nothing
+fallback behavior, can be reproduced by AND-reducing the lane mask).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from . import curve25519 as curve
+from . import fe25519 as fe
+from . import sc25519 as sc
+from . import sha512
+
+# message capacity buckets: hash input is 64 + cap bytes; choosing
+# cap = 128k - 64 - 17 makes the padded hash input exactly k blocks.
+MSG_CAPS = (47, 175, 431, 943)
+
+
+def bucket_cap(max_len: int) -> int:
+    for c in MSG_CAPS:
+        if max_len <= c:
+            return c
+    raise ValueError(f"message too long for verify kernel: {max_len}")
+
+
+def _straus(s_limbs, hneg_limbs, A):
+    """[s]B + [hneg]A over (20, N) lanes; 253-step joint ladder."""
+    shape = s_limbs.shape[1:]
+    bits_s = sc.bits(s_limbs)      # (253, N)
+    bits_h = sc.bits(hneg_limbs)
+    B = curve.base_lanes(shape)
+    AB = curve.add(A, B)
+    ident = curve.identity(shape)
+
+    def body(i, q):
+        j = 252 - i
+        bs = lax.dynamic_index_in_dim(bits_s, j, 0, keepdims=False)
+        bh = lax.dynamic_index_in_dim(bits_h, j, 0, keepdims=False)
+        sel = jnp.broadcast_to((bs + 2 * bh)[None], (fe.NLIMBS,) + shape)
+        q = curve.double(q)
+        addend = tuple(
+            lax.select_n(sel, ic, bc, ac, abc)
+            for ic, bc, ac, abc in zip(ident, B, A, AB)
+        )
+        return curve.add(q, addend)
+
+    return lax.fori_loop(0, 253, body, ident)
+
+
+def _verify_core(msgs, lens, pks, rs, ss):
+    """msgs (cap, N) uint8; lens (N,) int32; pks/rs/ss (32, N) uint8.
+
+    Returns bool (N,): per-signature ZIP-215 verdicts.
+    """
+    cap = msgs.shape[0]
+    A, ok_a = curve.decompress(pks)
+    R, ok_r = curve.decompress(rs)
+    s = fe.from_bytes_256(ss)
+    ok_s = sc.lt_L(s)
+
+    hin = jnp.concatenate([rs, pks, msgs], axis=0)
+    digest = sha512.sha512(hin, lens + 64, cap + 64)
+    h = sc.reduce_512(sc.hash_bytes_to_limbs(digest))
+    hneg = sc.neg_mod_L(h)
+
+    q = _straus(s, hneg, A)
+    p8 = curve.mul_by_cofactor(curve.add(q, curve.negate(R)))
+    return ok_a & ok_r & ok_s & curve.is_identity(p8)
+
+
+@functools.partial(jax.jit, static_argnums=())
+def verify_core_jit(msgs, lens, pks, rs, ss):
+    return _verify_core(msgs, lens, pks, rs, ss)
+
+
+def _pad_n(n: int) -> int:
+    """Pad batch to limit recompilation: powers of two >= 128."""
+    p = 128
+    while p < n:
+        p *= 2
+    return p
+
+
+def verify_batch(items) -> np.ndarray:
+    """Host API: items = list of (msg: bytes, pubkey: 32B, sig: 64B).
+
+    Returns np.ndarray of bool verdicts, one per item. Builds padded
+    device arrays (batch-last layout), dispatches one XLA program.
+    """
+    n = len(items)
+    if n == 0:
+        return np.zeros(0, bool)
+    max_len = max(len(m) for m, _, _ in items)
+    cap = bucket_cap(max_len)
+    np_ = _pad_n(n)
+
+    msgs = np.zeros((cap, np_), np.uint8)
+    lens = np.zeros(np_, np.int32)
+    pks = np.zeros((32, np_), np.uint8)
+    rs = np.zeros((32, np_), np.uint8)
+    ss = np.zeros((32, np_), np.uint8)
+    for i, (m, pk, sig) in enumerate(items):
+        if len(pk) != 32 or len(sig) != 64:
+            continue  # lane stays all-zero -> fails (identity pk, s=0 is
+            # actually valid; mark below instead)
+        msgs[: len(m), i] = np.frombuffer(m, np.uint8)
+        lens[i] = len(m)
+        pks[:, i] = np.frombuffer(pk, np.uint8)
+        rs[:, i] = np.frombuffer(sig[:32], np.uint8)
+        ss[:, i] = np.frombuffer(sig[32:], np.uint8)
+
+    out = np.array(
+        verify_core_jit(
+            jnp.asarray(msgs),
+            jnp.asarray(lens),
+            jnp.asarray(pks),
+            jnp.asarray(rs),
+            jnp.asarray(ss),
+        )
+    )[:n]
+    # malformed inputs are invalid regardless of lane math
+    for i, (m, pk, sig) in enumerate(items):
+        if len(pk) != 32 or len(sig) != 64:
+            out[i] = False
+    return out

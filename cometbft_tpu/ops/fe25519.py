@@ -13,9 +13,12 @@ Design notes (TPU-first, not a port):
   product limb is a sum of 20 partial products each < 2^26, total < 2^31,
   so the whole convolution accumulates in plain int32 with no carries
   inside the inner loop.
-* Limbs are *signed*: subtraction just subtracts. Carry propagation uses
-  arithmetic right shifts (floor semantics) + ``& MASK``, which is exact
-  for negative limbs in two's complement.
+* Limbs are kept **nonnegative end-to-end**: subtraction adds a
+  per-limb-large multiple of p (``_BIAS``, limbs in [12288, 20479],
+  value ≡ 0 mod p) before subtracting, so borrows never ripple and a
+  negative carry can never silently fall off the top headroom limb of
+  the multiply pipeline. Carry propagation is then monotone and
+  converges in a fixed 2-3 rounds (floor-semantics shifts + ``& MASK``).
 * Reduction is lazy. ``carry()`` folds the carry-out of limb 19 back into
   limb 0 multiplied by ``WRAP = 2^260 mod p = 608``. Elements stay in a
   redundant range; exact canonical comparisons are done by
@@ -92,22 +95,45 @@ def carry(x, rounds: int = 3):
     return x
 
 
+def _make_bias() -> np.ndarray:
+    """A multiple of p whose every limb is in [12288, 20479]: added before
+    subtraction so limb values stay nonnegative (see module docstring)."""
+    base = np.full(NLIMBS, 12288, np.int64)
+    v = sum(int(b) << (LIMB_BITS * i) for i, b in enumerate(base)) % P
+    adj = to_limbs((-v) % P).astype(np.int64)
+    out = base + adj
+    assert (out >= 12288).all() and (out <= 20479).all()
+    return out.astype(np.int32)
+
+
+_BIAS = _make_bias()
+
+
+def _bias(ndim: int):
+    return jnp.asarray(_BIAS).reshape((NLIMBS,) + (1,) * (ndim - 1))
+
+
 def add(a, b):
     return carry(a + b, 1)
 
 
 def sub(a, b):
-    return carry(a - b, 1)
+    """a - b mod p; bias keeps limbs nonneg (inputs must be carried)."""
+    return carry(a + _bias(max(a.ndim, b.ndim)) - b, 2)
 
 
 def neg(a):
-    return carry(-a, 1)
+    return carry(_bias(a.ndim) - a, 2)
 
 
 def _conv_mul(a, b):
-    """Schoolbook 20x20 limb convolution -> 40-limb int32 (last is headroom)."""
+    """Schoolbook 20x20 limb convolution -> 41-limb int32.
+
+    The convolution proper spans limbs 0..38; limbs 39-40 are headroom for
+    the carry rounds (limb 38 can carry ~2^13.5 into limb 39, which can
+    carry 1 into limb 40 — dropping that bit would lose 2^520 ≡ WRAP^2)."""
     shape = _bshape(a, b)
-    c = jnp.zeros((2 * NLIMBS,) + shape, jnp.int32)
+    c = jnp.zeros((2 * NLIMBS + 1,) + shape, jnp.int32)
     for i in range(NLIMBS):
         c = c.at[i : i + NLIMBS].add(a[i] * b)
     return c
@@ -126,8 +152,10 @@ def mul(a, b):
     c = _conv_mul(a, b)
     c = _carry_noWrap(c, 3)
     lo = c[:NLIMBS]
-    hi = c[NLIMBS:]
-    return carry(lo + hi * WRAP, 3)
+    hi = c[NLIMBS : 2 * NLIMBS]
+    out = lo + hi * WRAP
+    out = out.at[0].add(c[2 * NLIMBS] * (WRAP * WRAP))
+    return carry(out, 3)
 
 
 def square(a):
@@ -136,7 +164,7 @@ def square(a):
 
 def mul_scalar(a, k: int):
     """Multiply by a small nonneg python int (k < 2^17)."""
-    return carry(a * jnp.int32(k), 2)
+    return carry(a * jnp.int32(k), 3)
 
 
 def sqn(x, n: int):

@@ -1,0 +1,242 @@
+"""SHA-512 for TPU lanes: 64-bit words as (hi, lo) uint32 pairs.
+
+TPU has no 64-bit integers; every 64-bit word is a pair of uint32 arrays
+(hi, lo), batch on the trailing axes (convention: byte/word axis first,
+batch last — see fe25519 layout note). Add-with-carry, rotates and the
+sigma functions are expressed in uint32 lane ops; XLA fuses them.
+
+Variable-length messages in fixed-capacity buffers: every lane runs the
+same static number of compression rounds (`ceil((cap+17)/128)` blocks);
+a lane's state stops updating after its own final block (branch-free
+select), and padding/length bytes are injected positionally. This keeps
+shapes/control flow static for XLA while supporting per-lane lengths.
+
+Used by ed25519 verification: h = SHA-512(R || A || M) computed entirely
+on device (reference seam: curve25519-voi's use of SHA-512 inside
+crypto/ed25519 verify, reference crypto/ed25519/ed25519.go).
+
+Round constants/IVs derived exactly via integer roots (FIPS 180-4).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+
+def _iroot(x: int, n: int) -> int:
+    """floor(x**(1/n)) by Newton on python ints (exact)."""
+    if x == 0:
+        return 0
+    r = 1 << ((x.bit_length() + n - 1) // n)
+    while True:
+        nr = ((n - 1) * r + x // r ** (n - 1)) // n
+        if nr >= r:
+            return r
+        r = nr
+
+
+def _primes(n: int):
+    ps, c = [], 2
+    while len(ps) < n:
+        if all(c % p for p in ps if p * p <= c):
+            ps.append(c)
+        c += 1
+    return ps
+
+
+def _frac_root_bits(p: int, root: int, bits: int = 64) -> int:
+    whole = _iroot(p << (root * bits), root)
+    return whole & ((1 << bits) - 1)
+
+
+_K64 = [_frac_root_bits(p, 3) for p in _primes(80)]
+_H64 = [_frac_root_bits(p, 2) for p in _primes(8)]
+
+K_HI = np.asarray([k >> 32 for k in _K64], np.uint32)
+K_LO = np.asarray([k & 0xFFFFFFFF for k in _K64], np.uint32)
+H_HI = np.asarray([h >> 32 for h in _H64], np.uint32)
+H_LO = np.asarray([h & 0xFFFFFFFF for h in _H64], np.uint32)
+
+
+def _add64(ah, al, bh, bl):
+    lo = al + bl
+    carry = (lo < al).astype(jnp.uint32)
+    return ah + bh + carry, lo
+
+
+def _rotr64(h, l, n: int):
+    if n == 32:
+        return l, h
+    if n < 32:
+        m = 32 - n
+        return (
+            (h >> n) | (l << m),
+            (l >> n) | (h << m),
+        )
+    n -= 32
+    m = 32 - n
+    return (
+        (l >> n) | (h << m),
+        (h >> n) | (l << m),
+    )
+
+
+def _shr64(h, l, n: int):
+    if n < 32:
+        return h >> n, (l >> n) | (h << (32 - n))
+    return jnp.zeros_like(h), h >> (n - 32)
+
+
+def _xor3(a, b, c):
+    return (a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1])
+
+
+def _big_sigma0(h, l):
+    return _xor3(_rotr64(h, l, 28), _rotr64(h, l, 34), _rotr64(h, l, 39))
+
+
+def _big_sigma1(h, l):
+    return _xor3(_rotr64(h, l, 14), _rotr64(h, l, 18), _rotr64(h, l, 41))
+
+
+def _small_sigma0(h, l):
+    return _xor3(_rotr64(h, l, 1), _rotr64(h, l, 8), _shr64(h, l, 7))
+
+
+def _small_sigma1(h, l):
+    return _xor3(_rotr64(h, l, 19), _rotr64(h, l, 61), _shr64(h, l, 6))
+
+
+def _compress(state, whi, wlo):
+    """One SHA-512 compression. state: tuple of 8 (hi, lo) pairs;
+    whi/wlo: (16, N...) message words of this block."""
+    # message schedule, statically unrolled to 80 words
+    ws_h = [whi[i] for i in range(16)]
+    ws_l = [wlo[i] for i in range(16)]
+    for j in range(16, 80):
+        s0 = _small_sigma0(ws_h[j - 15], ws_l[j - 15])
+        s1 = _small_sigma1(ws_h[j - 2], ws_l[j - 2])
+        h, l = _add64(ws_h[j - 16], ws_l[j - 16], *s0)
+        h, l = _add64(h, l, *s1)
+        h, l = _add64(h, l, ws_h[j - 7], ws_l[j - 7])
+        ws_h.append(h)
+        ws_l.append(l)
+
+    a, b, c, d, e, f, g, hh = state
+    for j in range(80):
+        t1 = _add64(hh[0], hh[1], *_big_sigma1(*e))
+        ch = (
+            (e[0] & f[0]) ^ (~e[0] & g[0]),
+            (e[1] & f[1]) ^ (~e[1] & g[1]),
+        )
+        t1 = _add64(*t1, *ch)
+        t1 = _add64(*t1, jnp.uint32(K_HI[j]), jnp.uint32(K_LO[j]))
+        t1 = _add64(*t1, ws_h[j], ws_l[j])
+        maj = (
+            (a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+            (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]),
+        )
+        t2 = _add64(*_big_sigma0(*a), *maj)
+        hh = g
+        g = f
+        f = e
+        e = _add64(*d, *t1)
+        d = c
+        c = b
+        b = a
+        a = _add64(*t1, *t2)
+    out = []
+    for old, new in zip(state, (a, b, c, d, e, f, g, hh)):
+        out.append(_add64(*old, *new))
+    return tuple(out)
+
+
+def sha512(data, length, cap: int):
+    """SHA-512 of per-lane variable-length messages.
+
+    data:   (cap, N...) uint8, zero beyond each lane's length
+    length: (N...) int32 message byte length (<= cap)
+    cap:    static buffer capacity
+
+    Returns digest as (64, N...) uint8 (standard big-endian word bytes).
+    """
+    nblocks = (cap + 17 + 127) // 128
+    total = nblocks * 128
+    data = data.astype(jnp.uint32)
+    shape = data.shape[1:]
+    if cap < total:
+        data = jnp.concatenate(
+            [data, jnp.zeros((total - cap,) + shape, jnp.uint32)], axis=0
+        )
+    pos = jnp.arange(total, dtype=jnp.int32).reshape(
+        (total,) + (1,) * len(shape)
+    )
+    ln = length[None].astype(jnp.int32)
+    msk = (pos < ln).astype(jnp.uint32)
+    buf = data * msk + jnp.where(pos == ln, jnp.uint32(0x80), 0)
+    # 128-bit big-endian bit length: only low 4 bytes can be nonzero
+    final_block = (ln + 16) // 128  # block index holding the length field
+    bitlen = (ln * 8).astype(jnp.uint32)
+    for s in range(4):
+        at = final_block * 128 + 124 + s
+        buf = buf + jnp.where(
+            pos == at, (bitlen >> jnp.uint32(8 * (3 - s))) & 0xFF, 0
+        )
+
+    state = tuple(
+        (
+            jnp.broadcast_to(jnp.uint32(H_HI[i]), shape),
+            jnp.broadcast_to(jnp.uint32(H_LO[i]), shape),
+        )
+        for i in range(8)
+    )
+    for blk in range(nblocks):
+        base = blk * 128
+        whi = jnp.stack(
+            [
+                (buf[base + 8 * w] << 24)
+                | (buf[base + 8 * w + 1] << 16)
+                | (buf[base + 8 * w + 2] << 8)
+                | buf[base + 8 * w + 3]
+                for w in range(16)
+            ],
+            axis=0,
+        )
+        wlo = jnp.stack(
+            [
+                (buf[base + 8 * w + 4] << 24)
+                | (buf[base + 8 * w + 5] << 16)
+                | (buf[base + 8 * w + 6] << 8)
+                | buf[base + 8 * w + 7]
+                for w in range(16)
+            ],
+            axis=0,
+        )
+        new_state = _compress(state, whi, wlo)
+        active = blk <= final_block[0]  # (N...) bool
+        state = tuple(
+            (
+                jnp.where(active, nh, oh),
+                jnp.where(active, nl, ol),
+            )
+            for (nh, nl), (oh, ol) in zip(new_state, state)
+        )
+
+    out = []
+    for i in range(8):
+        h, l = state[i]
+        out.extend(
+            [
+                (h >> 24) & 0xFF,
+                (h >> 16) & 0xFF,
+                (h >> 8) & 0xFF,
+                h & 0xFF,
+                (l >> 24) & 0xFF,
+                (l >> 16) & 0xFF,
+                (l >> 8) & 0xFF,
+                l & 0xFF,
+            ]
+        )
+    return jnp.stack(out, axis=0).astype(jnp.uint8)

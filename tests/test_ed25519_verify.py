@@ -1,0 +1,114 @@
+"""End-to-end tests of the TPU ed25519 batch verify kernel.
+
+Differential vs the pure-python ZIP-215 oracle and OpenSSL signatures.
+"""
+
+import hashlib
+import os
+import random
+
+import numpy as np
+
+from cometbft_tpu.crypto import ref_ed25519 as ref
+from cometbft_tpu.ops import ed25519 as ed
+from cometbft_tpu.ops import sha512 as dsha
+
+rng = random.Random(7)
+
+
+def test_sha512_device():
+    import jax.numpy as jnp
+
+    msgs = [b"", b"abc", b"a" * 111, b"b" * 112, b"c" * 239, os.urandom(200)]
+    cap = 239
+    n = len(msgs)
+    data = np.zeros((cap, n), np.uint8)
+    lens = np.zeros(n, np.int32)
+    for i, m in enumerate(msgs):
+        data[: len(m), i] = np.frombuffer(m, np.uint8)
+        lens[i] = len(m)
+    dig = np.asarray(dsha.sha512(jnp.asarray(data), jnp.asarray(lens), cap))
+    for i, m in enumerate(msgs):
+        assert bytes(dig[:, i]) == hashlib.sha512(m).digest(), i
+
+
+def _signed_items(k):
+    items, want = [], []
+    for i in range(k):
+        seed = os.urandom(32)
+        pub = ref.public_from_seed(seed)
+        msg = os.urandom(rng.randrange(0, 170))
+        sig = ref.sign(seed, msg)
+        items.append((msg, pub, sig))
+        want.append(True)
+    return items, want
+
+
+def test_verify_valid_batch():
+    items, want = _signed_items(9)
+    got = ed.verify_batch(items)
+    assert list(got) == want
+
+
+def test_verify_rejects_tampered():
+    items, _ = _signed_items(6)
+    bad = []
+    # tamper: message, sig R, sig S, pubkey, non-canonical S, short sig
+    m, pk, sig = items[0]
+    bad.append((m + b"!", pk, sig))
+    m, pk, sig = items[1]
+    bad.append((m, pk, bytes([sig[0] ^ 1]) + sig[1:]))
+    m, pk, sig = items[2]
+    bad.append((m, pk, sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]))
+    m, pk, sig = items[3]
+    other_pk = ref.public_from_seed(os.urandom(32))
+    bad.append((m, other_pk, sig))
+    m, pk, sig = items[4]
+    s_big = (int.from_bytes(sig[32:], "little") + ref.L) % 2**256
+    bad.append((m, pk, sig[:32] + s_big.to_bytes(32, "little")))
+    m, pk, sig = items[5]
+    bad.append((m, pk, sig[:63]))
+    got = ed.verify_batch(bad)
+    # each lane must agree with the python oracle
+    for i, (m, pk, sig) in enumerate(bad):
+        assert bool(got[i]) == ref.verify_zip215(pk, m, sig), i
+    assert not got.any()
+
+
+def test_verify_mixed_batch_lanes_independent():
+    items, _ = _signed_items(5)
+    items[2] = (items[2][0] + b"x", items[2][1], items[2][2])
+    got = ed.verify_batch(items)
+    assert list(got) == [True, True, False, True, True]
+
+
+def test_verify_zip215_edge_cases():
+    # identity pubkey + identity R + S=0 is valid under cofactored rules
+    ident = ref.point_compress(ref.IDENTITY)
+    items = [(b"whatever", ident, ident + b"\x00" * 32)]
+    # small-order point encodings (order 2: y = -1)
+    small = (ref.P - 1).to_bytes(32, "little")
+    items.append((b"msg", small, ident + b"\x00" * 32))
+    # non-canonical y >= p encoding of the identity
+    noncanon = (ref.P + 1).to_bytes(32, "little")
+    items.append((b"m2", noncanon, ident + b"\x00" * 32))
+    got = ed.verify_batch(items)
+    for i, (m, pk, sig) in enumerate(items):
+        assert bool(got[i]) == ref.verify_zip215(pk, m, sig), i
+
+
+def test_verify_openssl_cross():
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    items = []
+    for _ in range(4):
+        sk = Ed25519PrivateKey.generate()
+        pk = sk.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        msg = os.urandom(100)
+        items.append((msg, pk, sk.sign(msg)))
+    assert list(ed.verify_batch(items)) == [True] * 4

@@ -82,6 +82,36 @@ def test_invert_pow2523():
     check_all(jax.jit(fe.pow2523)(a), [pow(v, (P - 5) // 8, P) for v in vals])
 
 
+def test_fuzz_op_sequences():
+    """Regression for redundant-representation bugs: random dependent op
+    chains must track python ints exactly (caught a dropped 2^520 carry)."""
+    import jax
+
+    n = 16
+    vals = rand_ints(n)
+    a = limbs_of(vals)
+    cur_l, cur_i = a, list(vals)
+    ops = [
+        ("mul", jax.jit(fe.mul), lambda x, y: x * y),
+        ("add", fe.add, lambda x, y: x + y),
+        ("sub", fe.sub, lambda x, y: x - y),
+        ("sq", jax.jit(fe.square), None),
+    ]
+    hist = []
+    for step in range(60):
+        name, f_l, f_i = ops[rng.randrange(len(ops))]
+        hist.append(name)
+        if name == "sq":
+            cur_l = f_l(cur_l)
+            cur_i = [x * x for x in cur_i]
+        else:
+            cur_l = f_l(cur_l, a)
+            cur_i = [f_i(x, y) for x, y in zip(cur_i, vals)]
+        cur_i = [x % P for x in cur_i]
+        assert int(jnp.max(jnp.abs(cur_l))) < (1 << 15)
+    check_all(cur_l, cur_i)
+
+
 def test_predicates():
     vals = [0, P, 2 * P, 1, P - 1, P + 1, 5, 2 * P - 1]
     a = limbs_of(vals)
