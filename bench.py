@@ -1,0 +1,124 @@
+"""North-star benchmark: ed25519 verifies/sec on the TPU batch kernel.
+
+Workload (BASELINE.json): commit-style signature batches — distinct
+vote-sign-bytes-sized messages, 150-validator-commit shaped — verified
+by the batched TPU kernel. Baseline = the host CPU sequential verify
+(OpenSSL via `cryptography`, the fastest available CPU path in this
+image; the reference's Go voi batch path is the same order of
+magnitude).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+NOTE (axon platform): block_until_ready does not block through the
+tunnel; timings always fetch results to host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    t_start = time.time()
+    import jax
+    import jax.numpy as jnp
+
+    from cometbft_tpu.crypto import ref_ed25519 as ref
+    from cometbft_tpu.ops import ed25519 as ed
+
+    rng = np.random.default_rng(42)
+    N = int(os.environ.get("BENCH_N", "8192"))
+    CAP = 175  # covers canonical vote sign bytes (chain-id dependent)
+    MSG_LEN = 120
+
+    # build N distinct signed messages from a pool of 150 "validators"
+    n_keys = 150
+    seeds = [rng.bytes(32) for _ in range(n_keys)]
+    pubs = [ref.public_from_seed(s) for s in seeds]
+
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+
+        def sign(seed, m):
+            return Ed25519PrivateKey.from_private_bytes(seed).sign(m)
+
+    except Exception:  # pragma: no cover
+        sign = ref.sign
+
+    msgs = np.zeros((CAP, N), np.uint8)
+    lens = np.full(N, MSG_LEN, np.int32)
+    pks = np.zeros((32, N), np.uint8)
+    rs = np.zeros((32, N), np.uint8)
+    ss = np.zeros((32, N), np.uint8)
+    host_items = []
+    for i in range(N):
+        k = i % n_keys
+        m = rng.bytes(MSG_LEN)
+        sig = sign(seeds[k], m)
+        msgs[:MSG_LEN, i] = np.frombuffer(m, np.uint8)
+        pks[:, i] = np.frombuffer(pubs[k], np.uint8)
+        rs[:, i] = np.frombuffer(sig[:32], np.uint8)
+        ss[:, i] = np.frombuffer(sig[32:], np.uint8)
+        host_items.append((pubs[k], m, sig))
+
+    args = [jax.device_put(jnp.asarray(a)) for a in (msgs, lens, pks, rs, ss)]
+    comp = jax.jit(ed._verify_core).lower(*args).compile()
+    out = np.asarray(comp(*args))  # warm-up + correctness
+    assert out.all(), "benchmark signatures must all verify"
+
+    times = []
+    for trial in range(3):
+        # touch an input so tunnel-side result caching cannot shortcut
+        msgs[0, 0] = trial
+        a0 = jax.device_put(jnp.asarray(msgs))
+        t0 = time.time()
+        got = np.asarray(comp(a0, *args[1:]))
+        times.append(time.time() - t0)
+        assert got[1:].all()
+    tpu_dt = min(times)
+    tpu_rate = N / tpu_dt
+
+    # CPU baseline: sequential OpenSSL verify on a sample, extrapolated
+    sample = min(N, 1500)
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PublicKey,
+        )
+
+        t0 = time.time()
+        for pk, m, sig in host_items[:sample]:
+            Ed25519PublicKey.from_public_bytes(pk).verify(sig, m)
+        cpu_dt = time.time() - t0
+        cpu_rate = sample / cpu_dt
+    except Exception:  # pragma: no cover
+        cpu_rate = float("nan")
+
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_batch_verify_throughput",
+                "value": round(tpu_rate, 1),
+                "unit": "verifies/sec",
+                "vs_baseline": round(tpu_rate / cpu_rate, 3)
+                if cpu_rate == cpu_rate
+                else None,
+                "detail": {
+                    "batch": N,
+                    "tpu_ms": round(tpu_dt * 1e3, 2),
+                    "cpu_baseline_rate": round(cpu_rate, 1),
+                    "total_bench_s": round(time.time() - t_start, 1),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+"""Batch signature verification dispatch: the framework's hottest seam.
+
+Mirrors the reference's injectable ``crypto.BatchVerifier``
+(crypto/crypto.go + crypto/batch/batch.go:10): callers accumulate
+(pubkey, msg, sig) triples and call ``verify()``. Two backends:
+
+- ``CpuBatchVerifier`` — sequential ZIP-215 on host (correctness
+  baseline + small-batch latency path, like the reference's per-vote
+  single verify).
+- ``TpuBatchVerifier`` — one XLA dispatch over signature lanes
+  (ops/ed25519). Returns per-signature verdicts, so unlike the
+  reference's random-linear-combination batch there is no second
+  fall-back pass on failure.
+
+Mixed-curve sets (north-star config #5): ed25519 items go to the TPU
+lanes, anything else verifies on host; verdicts are re-interleaved.
+The reference instead abandons batching entirely when key types are
+mixed (types/validation.go shouldBatchVerify).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .keys import Ed25519PubKey, PubKey
+
+_MIN_TPU_BATCH = 2
+
+
+class BatchVerifier:
+    """Accumulate signatures, verify all at once.
+
+    add() order is preserved; verify() returns (all_ok, per_item_ok).
+    """
+
+    def add(self, pk: PubKey, msg: bytes, sig: bytes) -> None:
+        raise NotImplementedError
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+
+class CpuBatchVerifier(BatchVerifier):
+    def __init__(self) -> None:
+        self.items: List[Tuple[PubKey, bytes, bytes]] = []
+
+    def add(self, pk: PubKey, msg: bytes, sig: bytes) -> None:
+        self.items.append((pk, msg, sig))
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        oks = [pk.verify(msg, sig) for pk, msg, sig in self.items]
+        return all(oks) and bool(oks), oks
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+
+class TpuBatchVerifier(BatchVerifier):
+    """Routes ed25519 lanes to the TPU kernel, everything else to host."""
+
+    def __init__(self) -> None:
+        self.items: List[Tuple[PubKey, bytes, bytes]] = []
+
+    def add(self, pk: PubKey, msg: bytes, sig: bytes) -> None:
+        self.items.append((pk, msg, sig))
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        ed_idx, ed_items, other_idx = [], [], []
+        for i, (pk, msg, sig) in enumerate(self.items):
+            if isinstance(pk, Ed25519PubKey):
+                ed_idx.append(i)
+                ed_items.append((msg, pk.key_bytes, sig))
+            else:
+                other_idx.append(i)
+        oks = [False] * len(self.items)
+        if len(ed_items) >= _MIN_TPU_BATCH:
+            from ..ops import ed25519 as _ed
+
+            verdicts = _ed.verify_batch(ed_items)
+            for i, v in zip(ed_idx, verdicts):
+                oks[i] = bool(v)
+        else:
+            for i in ed_idx:
+                pk, msg, sig = self.items[i]
+                oks[i] = pk.verify(msg, sig)
+        for i in other_idx:
+            pk, msg, sig = self.items[i]
+            oks[i] = pk.verify(msg, sig)
+        return all(oks) and bool(oks), oks
+
+
+_default_backend = "tpu"
+_lock = threading.Lock()
+
+
+def set_default_backend(name: str) -> None:
+    """'tpu' or 'cpu' (process-wide; mirrors config knobs)."""
+    global _default_backend
+    assert name in ("tpu", "cpu")
+    with _lock:
+        _default_backend = name
+
+
+def create_batch_verifier(
+    pks: Optional[Sequence[PubKey]] = None,
+) -> BatchVerifier:
+    """Factory mirroring crypto/batch.CreateBatchVerifier: returns the
+    configured backend (TPU by default)."""
+    if _default_backend == "cpu":
+        return CpuBatchVerifier()
+    return TpuBatchVerifier()
+
+
+def supports_batch_verification(pk: PubKey) -> bool:
+    """Mirrors crypto/batch.SupportsBatchVerifier — but note the TPU
+    verifier also absorbs mixed sets by splitting (see module doc)."""
+    return isinstance(pk, Ed25519PubKey)

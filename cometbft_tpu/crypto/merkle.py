@@ -1,0 +1,147 @@
+"""RFC 6962 merkle trees (host path) + inclusion proofs.
+
+Behavioral parity with the reference's crypto/merkle (tree.go, proof.go):
+leaf hash = SHA-256(0x00 || leaf), inner = SHA-256(0x01 || left || right),
+split point = largest power of two < n. Empty tree hashes to
+SHA-256(""). The TPU bulk path for hashing thousands of leaves lives in
+ops/ (device SHA-256); this module is the canonical host implementation
+used for block/header hashing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+LEAF_PREFIX = b"\x00"
+INNER_PREFIX = b"\x01"
+
+
+def _sha256(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+def leaf_hash(leaf: bytes) -> bytes:
+    return _sha256(LEAF_PREFIX + leaf)
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    return _sha256(INNER_PREFIX + left + right)
+
+
+def _split_point(n: int) -> int:
+    """Largest power of two strictly less than n."""
+    k = 1
+    while k * 2 < n:
+        k *= 2
+    return k
+
+
+def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
+    n = len(items)
+    if n == 0:
+        return _sha256(b"")
+    if n == 1:
+        return leaf_hash(items[0])
+    k = _split_point(n)
+    return inner_hash(
+        hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:])
+    )
+
+
+@dataclass
+class Proof:
+    total: int
+    index: int
+    leaf_hash: bytes
+    aunts: List[bytes] = field(default_factory=list)
+
+    def verify(self, root: bytes, leaf: bytes) -> bool:
+        if self.total < 0 or self.index < 0 or self.index >= self.total:
+            return False
+        if leaf_hash(leaf) != self.leaf_hash:
+            return False
+        computed = _compute_root(
+            self.total, self.index, self.leaf_hash, self.aunts
+        )
+        return computed == root
+
+
+def _compute_root(
+    total: int, index: int, lh: bytes, aunts: List[bytes]
+) -> Optional[bytes]:
+    if total == 0:
+        return None
+    if total == 1:
+        if aunts:
+            return None
+        return lh
+    if not aunts:
+        return None
+    k = _split_point(total)
+    if index < k:
+        left = _compute_root(k, index, lh, aunts[:-1])
+        if left is None:
+            return None
+        return inner_hash(left, aunts[-1])
+    right = _compute_root(total - k, index - k, lh, aunts[:-1])
+    if right is None:
+        return None
+    return inner_hash(aunts[-1], right)
+
+
+def proofs_from_byte_slices(items: Sequence[bytes]):
+    """Returns (root, [Proof per item])."""
+    trails, root_node = _trails_from_byte_slices(list(items))
+    root = root_node.hash if root_node else _sha256(b"")
+    proofs = []
+    for i, trail in enumerate(trails):
+        proofs.append(
+            Proof(
+                total=len(items),
+                index=i,
+                leaf_hash=trail.hash,
+                aunts=trail.flatten_aunts(),
+            )
+        )
+    return root, proofs
+
+
+class _Node:
+    __slots__ = ("hash", "parent", "left", "right")
+
+    def __init__(self, h: bytes):
+        self.hash = h
+        self.parent = None
+        self.left = None  # sibling pointers while building the trail
+        self.right = None
+
+    def flatten_aunts(self) -> List[bytes]:
+        out = []
+        node = self
+        while node is not None:
+            if node.left is not None:
+                out.append(node.left.hash)
+            elif node.right is not None:
+                out.append(node.right.hash)
+            node = node.parent
+        return out
+
+
+def _trails_from_byte_slices(items: List[bytes]):
+    n = len(items)
+    if n == 0:
+        return [], None
+    if n == 1:
+        node = _Node(leaf_hash(items[0]))
+        return [node], node
+    k = _split_point(n)
+    lefts, left_root = _trails_from_byte_slices(items[:k])
+    rights, right_root = _trails_from_byte_slices(items[k:])
+    root = _Node(inner_hash(left_root.hash, right_root.hash))
+    left_root.parent = root
+    left_root.right = right_root
+    right_root.parent = root
+    right_root.left = left_root
+    return lefts + rights, root

@@ -110,47 +110,59 @@ def _small_sigma1(h, l):
 
 
 def _compress(state, whi, wlo):
-    """One SHA-512 compression. state: tuple of 8 (hi, lo) pairs;
-    whi/wlo: (16, N...) message words of this block."""
-    # message schedule, statically unrolled to 80 words
-    ws_h = [whi[i] for i in range(16)]
-    ws_l = [wlo[i] for i in range(16)]
-    for j in range(16, 80):
-        s0 = _small_sigma0(ws_h[j - 15], ws_l[j - 15])
-        s1 = _small_sigma1(ws_h[j - 2], ws_l[j - 2])
-        h, l = _add64(ws_h[j - 16], ws_l[j - 16], *s0)
-        h, l = _add64(h, l, *s1)
-        h, l = _add64(h, l, ws_h[j - 7], ws_l[j - 7])
-        ws_h.append(h)
-        ws_l.append(l)
+    """One SHA-512 compression via two lax.scans (schedule + rounds).
 
-    a, b, c, d, e, f, g, hh = state
-    for j in range(80):
+    state: tuple of 8 (hi, lo) pairs; whi/wlo: (16, N...) block words.
+    Scans keep the HLO small (a statically unrolled 80-round body made
+    XLA compile time explode and fused poorly)."""
+    from jax import lax
+
+    # message schedule: rolling 16-word window, 64 steps -> W[16..80)
+    def sched(carry, _):
+        wh, wl = carry  # (16, N...)
+        s0 = _small_sigma0(wh[1], wl[1])
+        s1 = _small_sigma1(wh[14], wl[14])
+        h, l = _add64(wh[0], wl[0], *s0)
+        h, l = _add64(h, l, *s1)
+        h, l = _add64(h, l, wh[9], wl[9])
+        wh = jnp.concatenate([wh[1:], h[None]], axis=0)
+        wl = jnp.concatenate([wl[1:], l[None]], axis=0)
+        return (wh, wl), (h, l)
+
+    (_, _), (ext_h, ext_l) = lax.scan(sched, (whi, wlo), None, length=64)
+    w_h = jnp.concatenate([whi, ext_h], axis=0)  # (80, N...)
+    w_l = jnp.concatenate([wlo, ext_l], axis=0)
+
+    k_h = jnp.asarray(K_HI)
+    k_l = jnp.asarray(K_LO)
+    kb = (1,) * (whi.ndim - 1)
+
+    def round_(carry, xs):
+        a, b, c, d, e, f, g, hh = carry
+        wjh, wjl, kjh, kjl = xs
         t1 = _add64(hh[0], hh[1], *_big_sigma1(*e))
         ch = (
             (e[0] & f[0]) ^ (~e[0] & g[0]),
             (e[1] & f[1]) ^ (~e[1] & g[1]),
         )
         t1 = _add64(*t1, *ch)
-        t1 = _add64(*t1, jnp.uint32(K_HI[j]), jnp.uint32(K_LO[j]))
-        t1 = _add64(*t1, ws_h[j], ws_l[j])
+        t1 = _add64(*t1, kjh.reshape(kb), kjl.reshape(kb))
+        t1 = _add64(*t1, wjh, wjl)
         maj = (
             (a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
             (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]),
         )
         t2 = _add64(*_big_sigma0(*a), *maj)
-        hh = g
-        g = f
-        f = e
-        e = _add64(*d, *t1)
-        d = c
-        c = b
-        b = a
-        a = _add64(*t1, *t2)
-    out = []
-    for old, new in zip(state, (a, b, c, d, e, f, g, hh)):
-        out.append(_add64(*old, *new))
-    return tuple(out)
+        return (
+            (_add64(*t1, *t2), a, b, c, _add64(*d, *t1), e, f, g),
+            None,
+        )
+
+    init = state
+    final, _ = lax.scan(round_, init, (w_h, w_l, k_h, k_l))
+    return tuple(
+        (_add64(*old, *new)) for old, new in zip(state, final)
+    )
 
 
 def sha512(data, length, cap: int):

@@ -1,0 +1,24 @@
+"""Device mesh helpers: the framework's ICI-scaling axis.
+
+The reference scales commit verification with CPU batch verification
+(types/validation.go:261) — one core, SIMD lanes. The TPU-native
+equivalent shards signature lanes across a device mesh: each chip
+verifies its slice, and the weighted voting-power tally rides ICI as an
+``psum``. Consensus networking between hosts stays on DCN (p2p layer);
+ICI carries only the crypto data parallelism (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "sig"  # signature-lane data parallelism
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (DATA_AXIS,))

@@ -1,0 +1,67 @@
+"""Multi-chip commit verification: shard_map over signature lanes.
+
+One XLA program = the framework's full "step" for commit verification:
+
+  1. each device runs the ed25519 verify kernel on its shard of the
+     signature lanes (ops/ed25519, pure VPU work, no communication);
+  2. each device computes a partial voting-power tally of its valid
+     lanes (masked weighted sum);
+  3. a single ``psum`` over the mesh axis reduces the tally on ICI;
+  4. every device returns the quorum verdict (tally vs threshold) and
+     the gathered per-lane verdict mask.
+
+This mirrors the semantic of the reference's VerifyCommit
+(types/validation.go:30: sum voting power of valid signatures for the
+block, compare against 2/3 of total) — but the signature work is spread
+over chips instead of one Go routine's batch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..ops import ed25519 as ed
+from .mesh import DATA_AXIS
+
+
+def _local_step(msgs, lens, pks, rs, ss, powers, threshold):
+    """Per-device: verify local lanes, tally weighted power, psum."""
+    ok = ed._verify_core(msgs, lens, pks, rs, ss)
+    # int32 on-device tally: the authoritative (arbitrary-precision)
+    # tally is recomputed host-side in types/validation.py; this value
+    # drives the fast-path quorum verdict for realistic powers.
+    local_tally = jnp.sum(jnp.where(ok, powers, 0), dtype=jnp.int32)
+    tally = jax.lax.psum(local_tally, DATA_AXIS)
+    ok_all = jax.lax.all_gather(ok, DATA_AXIS, tiled=True)
+    return tally > threshold, tally, ok_all
+
+
+def make_sharded_verifier(mesh):
+    """Build the jitted multi-chip verify step for a mesh.
+
+    Input arrays are lane-sharded on their last axis; scalars replicated.
+    """
+    spec_lanes = P(None, DATA_AXIS)   # (bytes/limbs, N)
+    spec_vec = P(DATA_AXIS)           # (N,)
+
+    fn = shard_map(
+        _local_step,
+        mesh=mesh,
+        in_specs=(
+            spec_lanes,  # msgs (cap, N)
+            spec_vec,    # lens
+            spec_lanes,  # pks
+            spec_lanes,  # rs
+            spec_lanes,  # ss
+            spec_vec,    # powers
+            P(),         # threshold
+        ),
+        out_specs=(P(), P(), spec_vec),
+        check_rep=False,
+    )
+    return jax.jit(fn)

@@ -1,0 +1,79 @@
+"""Canonical sign-bytes encodings (reference types/canonical.go).
+
+Sign bytes are the *security-critical* encoding: every vote/proposal
+signature covers exactly these bytes, and the TPU verifier hashes them
+in-kernel. Format: protobuf wire encoding of CanonicalVote /
+CanonicalProposal, varint-length-delimited (libs/protoio), with
+sfixed64 height/round (canonical = fixed width) and the chain id last.
+"""
+
+from __future__ import annotations
+
+from ..utils import proto
+from .block import BlockID
+
+# SignedMsgType (proto/tendermint/types/types.proto)
+PREVOTE_TYPE = 1
+PRECOMMIT_TYPE = 2
+PROPOSAL_TYPE = 32
+
+
+def canonical_block_id(bid: BlockID) -> bytes:
+    if bid is None or bid.is_nil():
+        return None
+    psh = proto.field_varint(1, bid.part_set_header.total) + proto.field_bytes(
+        2, bid.part_set_header.hash
+    )
+    return proto.field_bytes(1, bid.hash) + proto.field_message(2, psh)
+
+
+def vote_sign_bytes(
+    chain_id: str,
+    type_: int,
+    height: int,
+    round_: int,
+    block_id: BlockID,
+    timestamp_ns: int,
+) -> bytes:
+    """CanonicalVote encoding, length-delimited (types/vote.go:152)."""
+    body = proto.field_varint(1, type_)
+    body += proto.field_sfixed64(2, height)
+    body += proto.field_sfixed64(3, round_)
+    cbid = canonical_block_id(block_id)
+    if cbid is not None:
+        body += proto.field_message(4, cbid)
+    body += proto.field_message(5, proto.timestamp(timestamp_ns))
+    body += proto.field_string(6, chain_id)
+    return proto.delimited(body)
+
+
+def proposal_sign_bytes(
+    chain_id: str,
+    height: int,
+    round_: int,
+    pol_round: int,
+    block_id: BlockID,
+    timestamp_ns: int,
+) -> bytes:
+    """CanonicalProposal encoding, length-delimited (types/proposal.go)."""
+    body = proto.field_varint(1, PROPOSAL_TYPE)
+    body += proto.field_sfixed64(2, height)
+    body += proto.field_sfixed64(3, round_)
+    body += proto.field_sfixed64(4, pol_round)
+    cbid = canonical_block_id(block_id)
+    if cbid is not None:
+        body += proto.field_message(5, cbid)
+    body += proto.field_message(6, proto.timestamp(timestamp_ns))
+    body += proto.field_string(7, chain_id)
+    return proto.delimited(body)
+
+
+def vote_extension_sign_bytes(
+    chain_id: str, height: int, round_: int, extension: bytes
+) -> bytes:
+    """CanonicalVoteExtension (vote extensions, ABCI 2.0)."""
+    body = proto.field_bytes(1, extension)
+    body += proto.field_sfixed64(2, height)
+    body += proto.field_sfixed64(3, round_)
+    body += proto.field_string(4, chain_id)
+    return proto.delimited(body)
