@@ -1,0 +1,44 @@
+"""Core consensus data model (the lingua franca of every layer).
+
+Reference parity: types/ package of CometBFT — Block/Header/Commit,
+Vote/Proposal, ValidatorSet, VoteSet, PartSet, commit verification with
+TPU batch dispatch, signature cache.
+"""
+
+from .block import (  # noqa: F401
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    BLOCK_ID_FLAG_NIL,
+    Block,
+    BlockID,
+    Commit,
+    CommitSig,
+    Data,
+    Header,
+    NIL_BLOCK_ID,
+    PartSetHeader,
+)
+from .canonical import (  # noqa: F401
+    PRECOMMIT_TYPE,
+    PREVOTE_TYPE,
+    PROPOSAL_TYPE,
+    proposal_sign_bytes,
+    vote_sign_bytes,
+)
+from .part_set import BLOCK_PART_SIZE, Part, PartSet  # noqa: F401
+from .signature_cache import SignatureCache  # noqa: F401
+from .validation import (  # noqa: F401
+    CommitVerifyError,
+    ErrInvalidSignature,
+    ErrNotEnoughVotingPower,
+    verify_commit,
+    verify_commit_light,
+    verify_commit_light_trusting,
+)
+from .validator_set import (  # noqa: F401
+    Validator,
+    ValidatorSet,
+    random_validator_set,
+)
+from .vote import PRECOMMIT, PREVOTE, Proposal, Vote  # noqa: F401
+from .vote_set import ErrVoteConflictingVotes, VoteSet  # noqa: F401

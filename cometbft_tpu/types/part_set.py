@@ -1,0 +1,83 @@
+"""PartSet: merkle-chunked block propagation unit (types/part_set.go).
+
+Blocks gossip as fixed-size parts (64KB, reference BlockPartSizeBytes)
+each carrying a merkle inclusion proof against the PartSetHeader hash,
+so peers can verify chunks independently before the block is whole.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..crypto import merkle
+from .block import PartSetHeader
+
+BLOCK_PART_SIZE = 65536
+
+
+@dataclass
+class Part:
+    index: int
+    bytes_: bytes
+    proof: merkle.Proof
+
+    def validate_basic(self) -> None:
+        if self.index < 0:
+            raise ValueError("negative part index")
+        if len(self.bytes_) > BLOCK_PART_SIZE:
+            raise ValueError("part too big")
+        if self.proof.index != self.index:
+            raise ValueError("part proof index mismatch")
+
+
+class PartSet:
+    """Either built full from data (proposer) or assembled from a header
+    (receiver adding verified parts)."""
+
+    def __init__(self, header: PartSetHeader):
+        self.header = header
+        self.parts: List[Optional[Part]] = [None] * header.total
+        self.count = 0
+        self.byte_size = 0
+
+    @classmethod
+    def from_data(cls, data: bytes, part_size: int = BLOCK_PART_SIZE):
+        chunks = [
+            data[i : i + part_size] for i in range(0, len(data), part_size)
+        ] or [b""]
+        root, proofs = merkle.proofs_from_byte_slices(chunks)
+        ps = cls(PartSetHeader(total=len(chunks), hash=root))
+        for i, (c, pr) in enumerate(zip(chunks, proofs)):
+            ps.parts[i] = Part(index=i, bytes_=c, proof=pr)
+        ps.count = len(chunks)
+        ps.byte_size = len(data)
+        return ps
+
+    def add_part(self, part: Part) -> bool:
+        """Verify proof against the header and insert. Returns False for
+        duplicates; raises on invalid proof."""
+        part.validate_basic()
+        if part.index >= self.header.total:
+            raise ValueError("part index out of range")
+        if self.parts[part.index] is not None:
+            return False
+        if not part.proof.verify(self.header.hash, part.bytes_):
+            raise ValueError("invalid part proof")
+        self.parts[part.index] = part
+        self.count += 1
+        self.byte_size += len(part.bytes_)
+        return True
+
+    def is_complete(self) -> bool:
+        return self.count == self.header.total
+
+    def get_part(self, i: int) -> Optional[Part]:
+        return self.parts[i] if 0 <= i < len(self.parts) else None
+
+    def bit_array(self) -> List[bool]:
+        return [p is not None for p in self.parts]
+
+    def assemble(self) -> bytes:
+        assert self.is_complete()
+        return b"".join(p.bytes_ for p in self.parts)

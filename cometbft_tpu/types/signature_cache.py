@@ -1,0 +1,51 @@
+"""LRU cache of already-verified signatures (fork feature).
+
+Parity with reference types/signature_cache.go: key = (sign bytes,
+signature, pubkey), used by light-client / statesync verification to
+dedup across overlapping valsets and bisection hops
+(types/validation.go:82-91, light/verifier.go:57).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+
+DEFAULT_CACHE_SIZE = 10_000
+
+
+class SignatureCache:
+    def __init__(self, size: int = DEFAULT_CACHE_SIZE):
+        self.size = size
+        self._od: OrderedDict[bytes, None] = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def key(sign_bytes: bytes, sig: bytes, pubkey: bytes) -> bytes:
+        return hashlib.sha256(
+            len(sign_bytes).to_bytes(4, "big") + sign_bytes + sig + pubkey
+        ).digest()
+
+    def contains(self, sign_bytes: bytes, sig: bytes, pubkey: bytes) -> bool:
+        k = self.key(sign_bytes, sig, pubkey)
+        with self._lock:
+            if k in self._od:
+                self._od.move_to_end(k)
+                self.hits += 1
+                return True
+            self.misses += 1
+            return False
+
+    def add(self, sign_bytes: bytes, sig: bytes, pubkey: bytes) -> None:
+        k = self.key(sign_bytes, sig, pubkey)
+        with self._lock:
+            self._od[k] = None
+            self._od.move_to_end(k)
+            while len(self._od) > self.size:
+                self._od.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._od)

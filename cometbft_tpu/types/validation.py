@@ -1,0 +1,227 @@
+"""Commit verification — the seam every sync path funnels through.
+
+Parity with reference types/validation.go: VerifyCommit (:30),
+VerifyCommitLight (:65), VerifyCommitLightTrusting (:148), the
+``*AllSignatures`` and ``*WithCache`` variants, with an injectable batch
+verifier (reference :270). Consumers: blocksync replay, adaptive
+ingest, light-client bisection, evidence checks (SURVEY.md §2.3).
+
+TPU-first departure: the reference dispatches between a sequential path
+and a random-linear-combination CPU batch; here every multi-signature
+verification builds one lane batch for the TPU kernel
+(crypto/batch.TpuBatchVerifier), which returns per-lane verdicts — the
+"light" early-exit at +2/3 is pointless on SIMD lanes, so light mode
+just restricts *which* signatures are checked (the ones counted toward
+the tally), identically to the reference's semantics.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import Optional
+
+from ..crypto import batch as crypto_batch
+from .block import BLOCK_ID_FLAG_COMMIT, BlockID, Commit
+from .canonical import PRECOMMIT_TYPE, vote_sign_bytes
+from .signature_cache import SignatureCache
+from .validator_set import ValidatorSet
+
+
+class CommitVerifyError(Exception):
+    pass
+
+
+class ErrNotEnoughVotingPower(CommitVerifyError):
+    pass
+
+
+class ErrInvalidSignature(CommitVerifyError):
+    pass
+
+
+def _commit_sign_bytes(chain_id: str, commit: Commit, cs) -> bytes:
+    return vote_sign_bytes(
+        chain_id,
+        PRECOMMIT_TYPE,
+        commit.height,
+        commit.round,
+        cs.block_id(commit.block_id),
+        cs.timestamp_ns,
+    )
+
+
+def _basic_checks(
+    vals: ValidatorSet, commit: Commit, height: int, block_id: Optional[BlockID]
+) -> None:
+    if commit is None:
+        raise CommitVerifyError("nil commit")
+    if vals.size() != commit.size():
+        raise CommitVerifyError(
+            f"validator set size {vals.size()} != commit size {commit.size()}"
+        )
+    if height != commit.height:
+        raise CommitVerifyError(
+            f"height {height} != commit height {commit.height}"
+        )
+    if block_id is not None and block_id.key() != commit.block_id.key():
+        raise CommitVerifyError("wrong BlockID in commit")
+
+
+def _run_batch(items, cache: Optional[SignatureCache]):
+    """items: list of (pubkey, sign_bytes, sig). Returns list[bool]."""
+    if not items:
+        return []
+    to_verify = []
+    skip = [False] * len(items)
+    if cache is not None:
+        for i, (pk, sb, sig) in enumerate(items):
+            if cache.contains(sb, sig, pk.key_bytes):
+                skip[i] = True
+    verifier = crypto_batch.create_batch_verifier()
+    for i, (pk, sb, sig) in enumerate(items):
+        if not skip[i]:
+            verifier.add(pk, sb, sig)
+            to_verify.append(i)
+    oks = [True] * len(items)
+    if len(verifier):
+        _, verdicts = verifier.verify()
+        for i, ok in zip(to_verify, verdicts):
+            oks[i] = ok
+            if ok and cache is not None:
+                pk, sb, sig = items[i]
+                cache.add(sb, sig, pk.key_bytes)
+    return oks
+
+
+def verify_commit(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: BlockID,
+    height: int,
+    commit: Commit,
+    cache: Optional[SignatureCache] = None,
+) -> None:
+    """Full verification: every non-absent signature must be valid
+    (including nil votes), and >2/3 of power must have signed block_id.
+    (reference types/validation.go:30; used by blocksync + ingest)."""
+    _basic_checks(vals, commit, height, block_id)
+    items = []
+    tally_idx = []
+    for i, cs in enumerate(commit.signatures):
+        if cs.is_absent():
+            continue
+        val = vals.get_by_index(i)
+        if val.address != cs.validator_address:
+            raise CommitVerifyError(
+                f"commit sig {i} address mismatch with validator set"
+            )
+        items.append(
+            (val.pub_key, _commit_sign_bytes(chain_id, commit, cs), cs.signature)
+        )
+        tally_idx.append(i)
+    oks = _run_batch(items, cache)
+    tallied = 0
+    for (i, ok) in zip(tally_idx, oks):
+        if not ok:
+            raise ErrInvalidSignature(f"invalid signature for validator {i}")
+        cs = commit.signatures[i]
+        if cs.for_block():
+            tallied += vals.get_by_index(i).voting_power
+    if not tallied * 3 > vals.total_voting_power() * 2:
+        raise ErrNotEnoughVotingPower(
+            f"tallied {tallied} <= 2/3 of {vals.total_voting_power()}"
+        )
+
+
+def verify_commit_light(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: BlockID,
+    height: int,
+    commit: Commit,
+    cache: Optional[SignatureCache] = None,
+    all_signatures: bool = False,
+) -> None:
+    """Light verification: only signatures for block_id are checked and
+    tallied up to the 2/3 threshold (reference :65; all_signatures=True
+    checks every block signature — evidence mode, reference :96)."""
+    _basic_checks(vals, commit, height, block_id)
+    total = vals.total_voting_power()
+    items, tally_idx = [], []
+    tallied_known = 0
+    for i, cs in enumerate(commit.signatures):
+        if not cs.for_block():
+            continue
+        val = vals.get_by_index(i)
+        if val.address != cs.validator_address:
+            raise CommitVerifyError(f"commit sig {i} address mismatch")
+        items.append(
+            (val.pub_key, _commit_sign_bytes(chain_id, commit, cs), cs.signature)
+        )
+        tally_idx.append(i)
+        tallied_known += val.voting_power
+        if not all_signatures and tallied_known * 3 > total * 2:
+            break  # enough power collected; verify just these lanes
+    oks = _run_batch(items, cache)
+    tallied = 0
+    for (i, ok) in zip(tally_idx, oks):
+        if not ok:
+            raise ErrInvalidSignature(f"invalid signature for validator {i}")
+        tallied += vals.get_by_index(i).voting_power
+    if not tallied * 3 > total * 2:
+        raise ErrNotEnoughVotingPower(
+            f"tallied {tallied} <= 2/3 of {total}"
+        )
+
+
+def verify_commit_light_trusting(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    trust_level: Fraction = Fraction(1, 3),
+    cache: Optional[SignatureCache] = None,
+    all_signatures: bool = False,
+) -> None:
+    """Trusting verification against an *old* validator set: tally power
+    of trusted validators who signed; require > trust_level of trusted
+    total (reference :148; used by light bisection + evidence)."""
+    if commit is None:
+        raise CommitVerifyError("nil commit")
+    if trust_level.numerator * 3 < trust_level.denominator or (
+        trust_level.numerator > trust_level.denominator
+    ):
+        raise CommitVerifyError("trust level must be in [1/3, 1]")
+    total = vals.total_voting_power()
+    need = total * trust_level.numerator
+    items, powers = [], []
+    seen = set()
+    tallied_known = 0
+    for cs in commit.signatures:
+        if not cs.for_block():
+            continue
+        idx, val = vals.get_by_address(cs.validator_address)
+        if idx < 0:
+            continue
+        if idx in seen:
+            raise CommitVerifyError("double vote from same validator")
+        seen.add(idx)
+        items.append(
+            (val.pub_key, _commit_sign_bytes(chain_id, commit, cs), cs.signature)
+        )
+        powers.append(val.voting_power)
+        tallied_known += val.voting_power
+        if (
+            not all_signatures
+            and tallied_known * trust_level.denominator > need
+        ):
+            break
+    oks = _run_batch(items, cache)
+    tallied = 0
+    for ok, p in zip(oks, powers):
+        if not ok:
+            raise ErrInvalidSignature("invalid signature in trusted commit")
+        tallied += p
+    if not tallied * trust_level.denominator > need:
+        raise ErrNotEnoughVotingPower(
+            f"trusted tally {tallied} <= {trust_level} of {total}"
+        )

@@ -1,0 +1,177 @@
+"""VoteSet: quorum tracking for one (height, round, type).
+
+Behavioral parity with reference types/vote_set.go: one vote per
+validator (conflicts tracked for evidence), weighted 2/3 majority per
+BlockID, peer-claimed majorities ("maj23") tracking, commit extraction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .block import (
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    BLOCK_ID_FLAG_NIL,
+    BlockID,
+    Commit,
+    CommitSig,
+)
+from .validator_set import ValidatorSet
+from .vote import PRECOMMIT, Vote, is_vote_type_valid
+
+
+class ErrVoteConflictingVotes(Exception):
+    def __init__(self, existing: Vote, new: Vote):
+        super().__init__("conflicting votes from validator")
+        self.existing = existing
+        self.new = new
+
+
+@dataclass
+class _BlockVotes:
+    votes_by_index: Dict[int, Vote] = field(default_factory=dict)
+    sum_power: int = 0
+
+
+class VoteSet:
+    def __init__(
+        self,
+        chain_id: str,
+        height: int,
+        round_: int,
+        type_: int,
+        val_set: ValidatorSet,
+        verify_signatures: bool = True,
+    ):
+        assert is_vote_type_valid(type_)
+        self.chain_id = chain_id
+        self.height = height
+        self.round = round_
+        self.type_ = type_
+        self.val_set = val_set
+        self.verify = verify_signatures
+        self.votes: List[Optional[Vote]] = [None] * val_set.size()
+        self.sum = 0
+        self.maj23: Optional[BlockID] = None
+        self.votes_by_block: Dict[bytes, _BlockVotes] = {}
+        self.peer_maj23s: Dict[str, BlockID] = {}
+
+    def size(self) -> int:
+        return self.val_set.size()
+
+    def add_vote(self, vote: Vote) -> bool:
+        """Returns True if the vote was added. Raises on conflict
+        (evidence!) or invalid signature."""
+        if vote is None:
+            raise ValueError("nil vote")
+        vote.validate_basic()
+        if (
+            vote.height != self.height
+            or vote.round != self.round
+            or vote.type_ != self.type_
+        ):
+            raise ValueError(
+                f"vote {vote.height}/{vote.round}/{vote.type_} does not "
+                f"match VoteSet {self.height}/{self.round}/{self.type_}"
+            )
+        idx = vote.validator_index
+        val = self.val_set.get_by_index(idx)
+        if val is None:
+            raise ValueError(f"validator index {idx} out of range")
+        if val.address != vote.validator_address:
+            raise ValueError("vote address does not match validator index")
+
+        existing = self.votes[idx]
+        if existing is not None:
+            if existing.block_id.key() == vote.block_id.key():
+                return False  # duplicate
+            # conflicting vote: verify before raising as evidence
+            if self.verify and not vote.verify(self.chain_id, val.pub_key):
+                raise ValueError("invalid signature on conflicting vote")
+            raise ErrVoteConflictingVotes(existing, vote)
+
+        if self.verify and not vote.verify(self.chain_id, val.pub_key):
+            raise ValueError("invalid vote signature")
+
+        self.votes[idx] = vote
+        self.sum += val.voting_power
+        bk = vote.block_id.key()
+        bv = self.votes_by_block.setdefault(bk, _BlockVotes())
+        bv.votes_by_index[idx] = vote
+        bv.sum_power += val.voting_power
+        if (
+            self.maj23 is None
+            and bv.sum_power * 3 > self.val_set.total_voting_power() * 2
+        ):
+            self.maj23 = vote.block_id
+        return True
+
+    def get_vote(self, idx: int) -> Optional[Vote]:
+        return self.votes[idx]
+
+    def get_vote_by_address(self, addr: bytes) -> Optional[Vote]:
+        i, _ = self.val_set.get_by_address(addr)
+        return None if i < 0 else self.votes[i]
+
+    def has_two_thirds_majority(self) -> bool:
+        return self.maj23 is not None
+
+    def two_thirds_majority(self) -> Optional[BlockID]:
+        return self.maj23
+
+    def has_two_thirds_any(self) -> bool:
+        return self.sum * 3 > self.val_set.total_voting_power() * 2
+
+    def has_all(self) -> bool:
+        return self.sum == self.val_set.total_voting_power()
+
+    def bit_array(self) -> List[bool]:
+        return [v is not None for v in self.votes]
+
+    def bit_array_by_block_id(self, block_id: BlockID) -> List[bool]:
+        bv = self.votes_by_block.get(block_id.key())
+        out = [False] * self.size()
+        if bv:
+            for i in bv.votes_by_index:
+                out[i] = True
+        return out
+
+    def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
+        """Record a peer's claim that +2/3 voted for block_id
+        (drives targeted vote gossip; types/vote_set.go SetPeerMaj23)."""
+        prev = self.peer_maj23s.get(peer_id)
+        if prev is not None and prev.key() != block_id.key():
+            raise ValueError("conflicting peer maj23 claims")
+        self.peer_maj23s[peer_id] = block_id
+
+    def make_commit(self) -> Commit:
+        assert self.type_ == PRECOMMIT, "commit only from precommits"
+        if self.maj23 is None or self.maj23.is_nil():
+            raise ValueError("no +2/3 majority for a block")
+        sigs = []
+        for i, vote in enumerate(self.votes):
+            if vote is None:
+                sigs.append(CommitSig.absent())
+                continue
+            if vote.block_id.key() == self.maj23.key():
+                flag = BLOCK_ID_FLAG_COMMIT
+            elif vote.block_id.is_nil():
+                flag = BLOCK_ID_FLAG_NIL
+            else:
+                flag = BLOCK_ID_FLAG_NIL  # vote for other block counts nil
+            sigs.append(
+                CommitSig(
+                    block_id_flag=flag,
+                    validator_address=vote.validator_address,
+                    timestamp_ns=vote.timestamp_ns,
+                    signature=vote.signature,
+                )
+            )
+        return Commit(
+            height=self.height,
+            round=self.round,
+            block_id=self.maj23,
+            signatures=sigs,
+        )

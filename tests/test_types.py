@@ -1,0 +1,263 @@
+"""Types layer: validator sets, vote sets, commits, verification."""
+
+import time
+from fractions import Fraction
+
+import pytest
+
+from cometbft_tpu import types as T
+from cometbft_tpu.crypto import merkle
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.types import validation
+
+CHAIN = "test-chain"
+NOW = int(time.time() * 1e9)
+
+
+def make_block_id(tag: bytes = b"block") -> T.BlockID:
+    import hashlib
+
+    h = hashlib.sha256(tag).digest()
+    return T.BlockID(h, T.PartSetHeader(1, hashlib.sha256(tag + b"p").digest()))
+
+
+def make_commit(vs, privs, height=3, round_=1, block_id=None, nil_frac=0.0):
+    block_id = block_id or make_block_id()
+    votes = T.VoteSet(CHAIN, height, round_, T.PRECOMMIT, vs)
+    n = len(privs)
+    for i, priv in enumerate(privs):
+        bid = block_id
+        if i < int(n * nil_frac):
+            bid = T.NIL_BLOCK_ID
+        v = T.Vote(
+            type_=T.PRECOMMIT,
+            height=height,
+            round=round_,
+            block_id=bid,
+            timestamp_ns=NOW + i,
+            validator_address=priv.pub_key().address(),
+            validator_index=i,
+        )
+        v.signature = priv.sign(v.sign_bytes(CHAIN))
+        votes.add_vote(v)
+    return votes.make_commit(), block_id
+
+
+@pytest.fixture(scope="module")
+def valset():
+    return T.random_validator_set(7)
+
+
+def test_proposer_rotation_weighted(valset=None):
+    vs, _ = T.random_validator_set(3, power=1)
+    # give one validator 3x power; over 5 rounds it proposes 3 times
+    vs.validators[0].voting_power = 3
+    vs = T.ValidatorSet(vs.validators)
+    heavy = vs.validators[0].address
+    seen = []
+    work = vs.copy()
+    for _ in range(5):
+        work.increment_proposer_priority(1)
+        seen.append(work.get_proposer().address)
+    assert seen.count(heavy) == 3
+
+
+def test_valset_hash_changes_with_update():
+    vs, privs = T.random_validator_set(4)
+    h1 = vs.hash()
+    vs2 = vs.copy()
+    vs2.update_with_change_set(
+        [T.Validator(privs[0].pub_key(), 555)]
+    )
+    assert vs2.hash() != h1
+    _, v = vs2.get_by_address(privs[0].pub_key().address())
+    assert v.voting_power == 555
+    # removal
+    vs3 = vs2.copy()
+    vs3.update_with_change_set([T.Validator(privs[1].pub_key(), 0)])
+    assert vs3.size() == 3
+
+
+def test_vote_set_quorum(valset):
+    vs, privs = valset
+    bid = make_block_id()
+    votes = T.VoteSet(CHAIN, 5, 0, T.PREVOTE, vs)
+    for i, priv in enumerate(privs):
+        v = T.Vote(
+            type_=T.PREVOTE,
+            height=5,
+            round=0,
+            block_id=bid,
+            timestamp_ns=NOW,
+            validator_address=priv.pub_key().address(),
+            validator_index=i,
+        )
+        v.signature = priv.sign(v.sign_bytes(CHAIN))
+        assert votes.add_vote(v)
+        has = votes.has_two_thirds_majority()
+        assert has == ((i + 1) * 3 > len(privs) * 2)
+    assert votes.two_thirds_majority().key() == bid.key()
+
+
+def test_vote_set_rejects_bad_sig(valset):
+    vs, privs = valset
+    votes = T.VoteSet(CHAIN, 5, 0, T.PREVOTE, vs)
+    v = T.Vote(
+        type_=T.PREVOTE,
+        height=5,
+        round=0,
+        block_id=make_block_id(),
+        timestamp_ns=NOW,
+        validator_address=privs[0].pub_key().address(),
+        validator_index=0,
+    )
+    v.signature = b"\x01" * 64
+    with pytest.raises(ValueError):
+        votes.add_vote(v)
+
+
+def test_vote_set_conflicting_votes_evidence(valset):
+    vs, privs = valset
+    votes = T.VoteSet(CHAIN, 5, 0, T.PREVOTE, vs)
+    for tag in (b"a", b"b"):
+        v = T.Vote(
+            type_=T.PREVOTE,
+            height=5,
+            round=0,
+            block_id=make_block_id(tag),
+            timestamp_ns=NOW,
+            validator_address=privs[0].pub_key().address(),
+            validator_index=0,
+        )
+        v.signature = privs[0].sign(v.sign_bytes(CHAIN))
+        if tag == b"a":
+            votes.add_vote(v)
+        else:
+            with pytest.raises(T.ErrVoteConflictingVotes):
+                votes.add_vote(v)
+
+
+def test_verify_commit_roundtrip(valset):
+    vs, privs = valset
+    commit, bid = make_commit(vs, privs)
+    T.verify_commit(CHAIN, vs, bid, 3, commit)
+    T.verify_commit_light(CHAIN, vs, bid, 3, commit)
+    T.verify_commit_light_trusting(CHAIN, vs, commit)
+
+
+def test_verify_commit_with_nil_votes(valset):
+    vs, privs = valset
+    # 2 of 7 vote nil: still 5/7 > 2/3
+    commit, bid = make_commit(vs, privs, nil_frac=0.29)
+    T.verify_commit(CHAIN, vs, bid, 3, commit)
+    T.verify_commit_light(CHAIN, vs, bid, 3, commit)
+
+
+def test_verify_commit_insufficient_power(valset):
+    vs, privs = valset
+    votes = T.VoteSet(CHAIN, 3, 1, T.PRECOMMIT, vs)
+    bid = make_block_id()
+    # exactly 5 of 7 vote (> 2/3); then strip two sigs to force failure
+    for i, priv in enumerate(privs[:5]):
+        v = T.Vote(
+            type_=T.PRECOMMIT,
+            height=3,
+            round=1,
+            block_id=bid,
+            timestamp_ns=NOW,
+            validator_address=priv.pub_key().address(),
+            validator_index=i,
+        )
+        v.signature = priv.sign(v.sign_bytes(CHAIN))
+        votes.add_vote(v)
+    commit = votes.make_commit()
+    commit.signatures[0] = T.CommitSig.absent()
+    commit.signatures[1] = T.CommitSig.absent()
+    with pytest.raises(validation.ErrNotEnoughVotingPower):
+        T.verify_commit(CHAIN, vs, bid, 3, commit)
+
+
+def test_verify_commit_bad_signature(valset):
+    vs, privs = valset
+    commit, bid = make_commit(vs, privs)
+    sigs = list(commit.signatures)
+    cs = sigs[2]
+    sigs[2] = T.CommitSig(
+        cs.block_id_flag,
+        cs.validator_address,
+        cs.timestamp_ns,
+        bytes([cs.signature[0] ^ 1]) + cs.signature[1:],
+    )
+    bad = T.Commit(commit.height, commit.round, commit.block_id, sigs)
+    with pytest.raises(validation.ErrInvalidSignature):
+        T.verify_commit(CHAIN, vs, bid, 3, bad)
+
+
+def test_verify_commit_light_trusting_subset(valset):
+    vs, privs = valset
+    commit, bid = make_commit(vs, privs)
+    # trusted set = 4 of the 7 validators (> 1/3 overlap by power)
+    trusted = T.ValidatorSet(vs.validators[:4])
+    T.verify_commit_light_trusting(CHAIN, trusted, commit)
+    # trust level 1: requires every trusted validator signed
+    T.verify_commit_light_trusting(
+        CHAIN, trusted, commit, trust_level=Fraction(3, 4)
+    )
+
+
+def test_signature_cache_dedups(valset):
+    vs, privs = valset
+    commit, bid = make_commit(vs, privs)
+    cache = T.SignatureCache()
+    T.verify_commit(CHAIN, vs, bid, 3, commit, cache=cache)
+    assert len(cache) == 7
+    before_hits = cache.hits
+    T.verify_commit(CHAIN, vs, bid, 3, commit, cache=cache)
+    assert cache.hits >= before_hits + 7
+
+
+def test_part_set_roundtrip():
+    data = bytes(range(256)) * 1000  # 256 KB -> 4 parts
+    ps = T.PartSet.from_data(data)
+    assert ps.header.total == 4
+    ps2 = T.PartSet(ps.header)
+    for i in reversed(range(4)):
+        assert ps2.add_part(ps.get_part(i))
+    assert ps2.is_complete()
+    assert ps2.assemble() == data
+    # corrupt part fails proof
+    p = ps.get_part(0)
+    bad = T.Part(0, b"x" + p.bytes_[1:], p.proof)
+    ps3 = T.PartSet(ps.header)
+    with pytest.raises(ValueError):
+        ps3.add_part(bad)
+
+
+def test_merkle_proofs():
+    items = [b"a", b"b", b"c", b"d", b"e"]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    assert root == merkle.hash_from_byte_slices(items)
+    for i, item in enumerate(items):
+        assert proofs[i].verify(root, item)
+        assert not proofs[i].verify(root, item + b"!")
+
+
+def test_header_hash_sensitivity():
+    vs, _ = T.random_validator_set(2)
+    h = T.Header(
+        chain_id=CHAIN,
+        height=9,
+        time_ns=NOW,
+        validators_hash=vs.hash(),
+        next_validators_hash=vs.hash(),
+        proposer_address=vs.validators[0].address,
+    )
+    h2 = T.Header(
+        chain_id=CHAIN,
+        height=10,
+        time_ns=NOW,
+        validators_hash=vs.hash(),
+        next_validators_hash=vs.hash(),
+        proposer_address=vs.validators[0].address,
+    )
+    assert h.hash() != h2.hash()
