@@ -1,0 +1,119 @@
+"""ABCI clients: in-process local client (reference abci/client/local_client.go).
+
+The local client wraps an Application with a mutex, preserving the
+reference's guarantee that ABCI calls on one connection are serialized.
+Async semantics (callback pipelining of the socket client) are provided
+by `check_tx_async` returning a future resolved inline — the asyncio
+socket client lives in abci/server.py for the process boundary.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Callable, List, Optional
+
+from . import types as abci
+
+
+class LocalClient:
+    def __init__(self, app: abci.Application, lock: Optional[threading.RLock] = None):
+        self.app = app
+        # one shared lock across the 4 "connections" mirrors the local
+        # client's global mutex in the reference
+        self._lock = lock or threading.RLock()
+
+    # consensus connection
+    def init_chain(self, req):
+        with self._lock:
+            return self.app.init_chain(req)
+
+    def prepare_proposal(self, req):
+        with self._lock:
+            return self.app.prepare_proposal(req)
+
+    def process_proposal(self, req):
+        with self._lock:
+            return self.app.process_proposal(req)
+
+    def extend_vote(self, req):
+        with self._lock:
+            return self.app.extend_vote(req)
+
+    def verify_vote_extension(self, req):
+        with self._lock:
+            return self.app.verify_vote_extension(req)
+
+    def finalize_block(self, req):
+        with self._lock:
+            return self.app.finalize_block(req)
+
+    def commit(self):
+        with self._lock:
+            return self.app.commit()
+
+    # mempool connection
+    def check_tx(self, req):
+        with self._lock:
+            return self.app.check_tx(req)
+
+    def check_tx_async(self, req) -> Future:
+        f: Future = Future()
+        try:
+            f.set_result(self.check_tx(req))
+        except Exception as e:  # pragma: no cover
+            f.set_exception(e)
+        return f
+
+    def insert_tx(self, tx: bytes) -> bool:
+        with self._lock:
+            return self.app.insert_tx(tx)
+
+    def reap_txs(self, max_bytes: int, max_gas: int) -> List[bytes]:
+        with self._lock:
+            return self.app.reap_txs(max_bytes, max_gas)
+
+    # info connection
+    def info(self, req):
+        with self._lock:
+            return self.app.info(req)
+
+    def query(self, req):
+        with self._lock:
+            return self.app.query(req)
+
+    def echo(self, msg):
+        with self._lock:
+            return self.app.echo(msg)
+
+    # snapshot connection
+    def list_snapshots(self):
+        with self._lock:
+            return self.app.list_snapshots()
+
+    def offer_snapshot(self, snapshot, app_hash):
+        with self._lock:
+            return self.app.offer_snapshot(snapshot, app_hash)
+
+    def load_snapshot_chunk(self, height, format_, chunk):
+        with self._lock:
+            return self.app.load_snapshot_chunk(height, format_, chunk)
+
+    def apply_snapshot_chunk(self, index, chunk, sender):
+        with self._lock:
+            return self.app.apply_snapshot_chunk(index, chunk, sender)
+
+
+class AppConns:
+    """Four named logical connections sharing one client (reference
+    proxy/multi_app_conn.go:21-62: consensus/mempool/query/snapshot)."""
+
+    def __init__(self, client):
+        self.consensus = client
+        self.mempool = client
+        self.query = client
+        self.snapshot = client
+
+    @classmethod
+    def local(cls, app: abci.Application) -> "AppConns":
+        return cls(LocalClient(app))

@@ -1,0 +1,137 @@
+"""Evidence types (reference types/evidence.go).
+
+DuplicateVoteEvidence: two conflicting votes by one validator.
+LightClientAttackEvidence: a conflicting light block + common height.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..utils import proto
+from .. import types as T
+
+
+@dataclass
+class DuplicateVoteEvidence:
+    vote_a: "T.Vote"
+    vote_b: "T.Vote"
+    total_voting_power: int = 0
+    validator_power: int = 0
+    timestamp_ns: int = 0
+
+    TYPE = 1
+
+    @classmethod
+    def from_votes(cls, a, b, val_power, total_power, time_ns):
+        # canonical order: lexicographic by block id key (types/evidence.go)
+        if a.block_id.key() > b.block_id.key():
+            a, b = b, a
+        return cls(a, b, total_power, val_power, time_ns)
+
+    def height(self) -> int:
+        return self.vote_a.height
+
+    def addresses(self) -> List[bytes]:
+        return [self.vote_a.validator_address]
+
+    def encode(self) -> bytes:
+        from ..utils import codec
+
+        return (
+            proto.field_varint(1, self.TYPE)
+            + proto.field_message(2, codec.encode_vote(self.vote_a))
+            + proto.field_message(3, codec.encode_vote(self.vote_b))
+            + proto.field_varint(4, self.total_voting_power)
+            + proto.field_varint(5, self.validator_power)
+            + proto.field_message(6, proto.timestamp(self.timestamp_ns))
+        )
+
+    def hash(self) -> bytes:
+        return hashlib.sha256(self.encode()).digest()
+
+    def validate_basic(self) -> None:
+        a, b = self.vote_a, self.vote_b
+        if a is None or b is None:
+            raise ValueError("missing vote")
+        if a.block_id.key() >= b.block_id.key():
+            raise ValueError("votes not in canonical order / identical")
+        if (a.height, a.round, a.type_, a.validator_address) != (
+            b.height,
+            b.round,
+            b.type_,
+            b.validator_address,
+        ):
+            raise ValueError("votes do not conflict (different HRS/validator)")
+
+
+@dataclass
+class LightClientAttackEvidence:
+    conflicting_block: object  # light.LightBlock
+    common_height: int
+    byzantine_validators: list = field(default_factory=list)
+    total_voting_power: int = 0
+    timestamp_ns: int = 0
+
+    TYPE = 2
+
+    def height(self) -> int:
+        return self.common_height
+
+    def encode(self) -> bytes:
+        from ..utils import codec
+
+        body = proto.field_varint(1, self.TYPE)
+        lb = self.conflicting_block
+        sh = proto.field_message(
+            1, codec.encode_header(lb.header)
+        ) + proto.field_message(2, codec.encode_commit(lb.commit))
+        body += proto.field_message(2, sh)
+        body += proto.field_message(
+            3, codec.encode_validator_set(lb.validator_set)
+        )
+        body += proto.field_varint(4, self.common_height)
+        body += proto.field_varint(5, self.total_voting_power)
+        body += proto.field_message(6, proto.timestamp(self.timestamp_ns))
+        return body
+
+    def hash(self) -> bytes:
+        return hashlib.sha256(self.encode()).digest()
+
+    def validate_basic(self) -> None:
+        if self.common_height < 1:
+            raise ValueError("invalid common height")
+        if self.conflicting_block is None:
+            raise ValueError("missing conflicting block")
+
+
+def decode_evidence(b: bytes):
+    from ..utils import codec
+    from ..light.types import LightBlock
+
+    m = proto.parse(b)
+    t = proto.get1(m, 1, 0)
+    if t == DuplicateVoteEvidence.TYPE:
+        return DuplicateVoteEvidence(
+            vote_a=codec.decode_vote(proto.get1(m, 2, b"")),
+            vote_b=codec.decode_vote(proto.get1(m, 3, b"")),
+            total_voting_power=proto.get1(m, 4, 0),
+            validator_power=proto.get1(m, 5, 0),
+            timestamp_ns=proto.parse_timestamp(proto.get1(m, 6, b"")),
+        )
+    if t == LightClientAttackEvidence.TYPE:
+        shm = proto.parse(proto.get1(m, 2, b""))
+        lb = LightBlock(
+            header=codec.decode_header(proto.get1(shm, 1, b"")),
+            commit=codec.decode_commit(proto.get1(shm, 2, b"")),
+            validator_set=codec.decode_validator_set(proto.get1(m, 3, b"")),
+        )
+        return LightClientAttackEvidence(
+            conflicting_block=lb,
+            common_height=proto.get1(m, 4, 0),
+            total_voting_power=proto.get1(m, 5, 0),
+            timestamp_ns=proto.parse_timestamp(proto.get1(m, 6, b"")),
+        )
+    raise ValueError(f"unknown evidence type {t}")

@@ -1,0 +1,253 @@
+"""kvstore: the universal fake application (reference abci/example/kvstore).
+
+A replicated key=value store: txs are "key=value" bytes; state is a dict
+with a deterministic app hash; supports validator-update txs
+("val:pubkey_b64!power" in the reference — here "val:<hex pubkey>!<power>"),
+queries, and snapshots over the full state. Used by every in-process
+consensus/blocksync/statesync test.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, List
+
+from ..abci import types as abci
+
+VALIDATOR_TX_PREFIX = b"val:"
+
+
+class KVStoreApplication(abci.Application):
+    def __init__(self):
+        self.state: Dict[bytes, bytes] = {}
+        self.height = 0
+        self.app_hash = self._compute_hash()
+        self.staged: Dict[bytes, bytes] = {}
+        self.val_updates: List[abci.ValidatorUpdate] = []
+        self.snapshots: Dict[int, bytes] = {}
+        self._restore_buf: List[bytes] = []
+        self._restore_target = None
+
+    # --- hashing ------------------------------------------------------
+
+    def _compute_hash(self) -> bytes:
+        h = hashlib.sha256()
+        h.update(self.height.to_bytes(8, "big"))
+        for k in sorted(self.state):
+            h.update(len(k).to_bytes(4, "big") + k)
+            h.update(len(self.state[k]).to_bytes(4, "big") + self.state[k])
+        return h.digest()
+
+    # --- info/query ---------------------------------------------------
+
+    def info(self, req):
+        return abci.ResponseInfo(
+            data=json.dumps({"size": len(self.state)}),
+            version="kvstore-tpu-0.1",
+            app_version=1,
+            last_block_height=self.height,
+            last_block_app_hash=self.app_hash,
+        )
+
+    def query(self, req):
+        if req.path == "/store" or req.path == "":
+            v = self.state.get(req.data)
+            return abci.ResponseQuery(
+                code=abci.CODE_TYPE_OK if v is not None else 1,
+                key=req.data,
+                value=v or b"",
+                height=self.height,
+            )
+        return abci.ResponseQuery(code=1, log=f"unknown path {req.path}")
+
+    # --- mempool ------------------------------------------------------
+
+    @staticmethod
+    def _valid_tx(tx: bytes) -> bool:
+        if tx.startswith(VALIDATOR_TX_PREFIX):
+            try:
+                body = tx[len(VALIDATOR_TX_PREFIX) :]
+                pk, power = body.split(b"!", 1)
+                bytes.fromhex(pk.decode())
+                int(power)
+                return True
+            except Exception:
+                return False
+        return b"=" in tx
+
+    def check_tx(self, req):
+        if not self._valid_tx(req.tx):
+            return abci.ResponseCheckTx(code=1, log="invalid tx format")
+        return abci.ResponseCheckTx(gas_wanted=1)
+
+    # --- consensus ----------------------------------------------------
+
+    def init_chain(self, req):
+        self.height = req.initial_height - 1
+        if req.app_state_bytes:
+            st = json.loads(req.app_state_bytes)
+            self.state = {
+                bytes.fromhex(k): bytes.fromhex(v) for k, v in st.items()
+            }
+        self.app_hash = self._compute_hash()
+        return abci.ResponseInitChain(app_hash=self.app_hash)
+
+    def process_proposal(self, req):
+        for tx in req.txs:
+            if not self._valid_tx(tx):
+                return abci.ResponseProcessProposal(
+                    status=abci.PROCESS_PROPOSAL_REJECT
+                )
+        return abci.ResponseProcessProposal()
+
+    def _exec_tx(self, tx: bytes) -> abci.ExecTxResult:
+        if not self._valid_tx(tx):
+            return abci.ExecTxResult(code=1, log="invalid tx")
+        if tx.startswith(VALIDATOR_TX_PREFIX):
+            body = tx[len(VALIDATOR_TX_PREFIX) :]
+            pk, power = body.split(b"!", 1)
+            self.val_updates.append(
+                abci.ValidatorUpdate(
+                    pub_key_type="ed25519",
+                    pub_key_bytes=bytes.fromhex(pk.decode()),
+                    power=int(power),
+                )
+            )
+            return abci.ExecTxResult(
+                events=[abci.Event("val_update", [("power", power.decode(), True)])]
+            )
+        k, v = tx.split(b"=", 1)
+        self.staged[k] = v
+        return abci.ExecTxResult(
+            events=[
+                abci.Event(
+                    "app",
+                    [("creator", "kvstore", True), ("key", k.decode(errors="replace"), True)],
+                )
+            ]
+        )
+
+    def finalize_block(self, req):
+        self.staged = {}
+        self.val_updates = []
+        results = [self._exec_tx(tx) for tx in req.txs]
+        # stage, compute prospective hash
+        pending = dict(self.state)
+        pending.update(self.staged)
+        h = hashlib.sha256()
+        h.update(req.height.to_bytes(8, "big"))
+        for k in sorted(pending):
+            h.update(len(k).to_bytes(4, "big") + k)
+            h.update(len(pending[k]).to_bytes(4, "big") + pending[k])
+        self._pending = (req.height, pending, h.digest())
+        return abci.ResponseFinalizeBlock(
+            tx_results=results,
+            validator_updates=list(self.val_updates),
+            app_hash=h.digest(),
+        )
+
+    def commit(self):
+        height, pending, app_hash = self._pending
+        self.height = height
+        self.state = pending
+        self.app_hash = app_hash
+        self.staged = {}
+        if self.height % 10 == 0:
+            self._take_snapshot()
+        return abci.ResponseCommit(retain_height=0)
+
+    # --- snapshots ----------------------------------------------------
+
+    SNAPSHOT_CHUNK = 1024
+
+    def _take_snapshot(self):
+        blob = json.dumps(
+            {
+                "height": self.height,
+                "state": {
+                    k.hex(): v.hex() for k, v in sorted(self.state.items())
+                },
+            }
+        ).encode()
+        self.snapshots[self.height] = blob
+        while len(self.snapshots) > 4:
+            del self.snapshots[min(self.snapshots)]
+
+    def list_snapshots(self):
+        out = []
+        for h, blob in sorted(self.snapshots.items()):
+            nchunks = (len(blob) + self.SNAPSHOT_CHUNK - 1) // self.SNAPSHOT_CHUNK
+            out.append(
+                abci.Snapshot(
+                    height=h,
+                    format=1,
+                    chunks=nchunks,
+                    hash=hashlib.sha256(blob).digest(),
+                )
+            )
+        return out
+
+    def load_snapshot_chunk(self, height, format_, chunk):
+        blob = self.snapshots.get(height, b"")
+        off = chunk * self.SNAPSHOT_CHUNK
+        return blob[off : off + self.SNAPSHOT_CHUNK]
+
+    def offer_snapshot(self, snapshot, app_hash):
+        if snapshot.format != 1:
+            return abci.ResponseOfferSnapshot(
+                result=abci.OFFER_SNAPSHOT_REJECT_FORMAT
+            )
+        self._restore_buf = []
+        self._restore_target = (snapshot, app_hash)
+        return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_ACCEPT)
+
+    def apply_snapshot_chunk(self, index, chunk, sender):
+        self._restore_buf.append(chunk)
+        snapshot, app_hash = self._restore_target
+        if len(self._restore_buf) == snapshot.chunks:
+            blob = b"".join(self._restore_buf)
+            if hashlib.sha256(blob).digest() != snapshot.hash:
+                return abci.ResponseApplySnapshotChunk(
+                    result=abci.APPLY_CHUNK_REJECT_SNAPSHOT
+                )
+            st = json.loads(blob)
+            self.height = st["height"]
+            self.state = {
+                bytes.fromhex(k): bytes.fromhex(v)
+                for k, v in st["state"].items()
+            }
+            self.app_hash = self._compute_hash()
+        return abci.ResponseApplySnapshotChunk(result=abci.APPLY_CHUNK_ACCEPT)
+
+
+class AppMempoolKVStore(KVStoreApplication):
+    """kvstore variant owning its mempool (fork feature: InsertTx/ReapTxs,
+    reference mempool/app_mempool.go)."""
+
+    def __init__(self):
+        super().__init__()
+        self.pool: List[bytes] = []
+
+    def insert_tx(self, tx: bytes) -> bool:
+        if not self._valid_tx(tx) or tx in self.pool:
+            return False
+        self.pool.append(tx)
+        return True
+
+    def reap_txs(self, max_bytes: int, max_gas: int) -> List[bytes]:
+        out, total = [], 0
+        for tx in self.pool:
+            if max_bytes >= 0 and total + len(tx) > max_bytes:
+                break
+            out.append(tx)
+            total += len(tx)
+        return out
+
+    def commit(self):
+        resp = super().commit()
+        committed = set()
+        for k, v in self.state.items():
+            committed.add(k + b"=" + v)
+        self.pool = [tx for tx in self.pool if tx not in committed]
+        return resp

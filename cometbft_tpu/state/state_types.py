@@ -1,0 +1,148 @@
+"""State snapshot + consensus params (reference state/state.go, types/params.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import List, Optional
+
+from ..crypto import merkle
+from ..types.block import BlockID, Header
+from ..types.validator_set import ValidatorSet
+from ..utils import proto
+
+BLOCK_VERSION = 11
+
+
+@dataclass
+class BlockParams:
+    max_bytes: int = 4 * 1024 * 1024  # 4MB east of reference's 21MB cap
+    max_gas: int = -1
+
+
+@dataclass
+class EvidenceParams:
+    max_age_num_blocks: int = 100_000
+    max_age_duration_ns: int = 48 * 3600 * 10**9
+    max_bytes: int = 1024 * 1024
+
+
+@dataclass
+class ValidatorParams:
+    pub_key_types: List[str] = field(default_factory=lambda: ["ed25519"])
+
+
+@dataclass
+class ABCIParams:
+    vote_extensions_enable_height: int = 0
+
+
+@dataclass
+class ConsensusParams:
+    block: BlockParams = field(default_factory=BlockParams)
+    evidence: EvidenceParams = field(default_factory=EvidenceParams)
+    validator: ValidatorParams = field(default_factory=ValidatorParams)
+    abci: ABCIParams = field(default_factory=ABCIParams)
+
+    def hash(self) -> bytes:
+        return merkle.hash_from_byte_slices([self.encode()])
+
+    def vote_extensions_enabled(self, height: int) -> bool:
+        h = self.abci.vote_extensions_enable_height
+        return h > 0 and height >= h
+
+    def encode(self) -> bytes:
+        b = proto.field_varint(1, self.block.max_bytes) + proto.field_sfixed64(
+            2, self.block.max_gas
+        )
+        e = (
+            proto.field_varint(1, self.evidence.max_age_num_blocks)
+            + proto.field_varint(2, self.evidence.max_age_duration_ns)
+            + proto.field_varint(3, self.evidence.max_bytes)
+        )
+        v = b"".join(
+            proto.field_string(1, t) for t in self.validator.pub_key_types
+        )
+        a = proto.field_varint(1, self.abci.vote_extensions_enable_height)
+        return (
+            proto.field_message(1, b)
+            + proto.field_message(2, e)
+            + proto.field_message(3, v)
+            + proto.field_message(4, a)
+        )
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "ConsensusParams":
+        m = proto.parse(raw)
+        bm = proto.parse(proto.get1(m, 1, b""))
+        em = proto.parse(proto.get1(m, 2, b""))
+        vm = proto.parse(proto.get1(m, 3, b""))
+        am = proto.parse(proto.get1(m, 4, b""))
+        return cls(
+            block=BlockParams(
+                max_bytes=proto.get1(bm, 1, 4 * 1024 * 1024),
+                max_gas=proto.get1(bm, 2, -1),
+            ),
+            evidence=EvidenceParams(
+                max_age_num_blocks=proto.get1(em, 1, 100_000),
+                max_age_duration_ns=proto.get1(em, 2, 48 * 3600 * 10**9),
+                max_bytes=proto.get1(em, 3, 1024 * 1024),
+            ),
+            validator=ValidatorParams(
+                pub_key_types=[x.decode() for x in vm.get(1, [])] or ["ed25519"]
+            ),
+            abci=ABCIParams(
+                vote_extensions_enable_height=proto.get1(am, 1, 0)
+            ),
+        )
+
+
+@dataclass
+class State:
+    """Everything needed to validate + execute the next block
+    (reference state/state.go:38-80)."""
+
+    chain_id: str = ""
+    initial_height: int = 1
+    last_block_height: int = 0
+    last_block_id: BlockID = field(default_factory=BlockID)
+    last_block_time_ns: int = 0
+    validators: Optional[ValidatorSet] = None
+    next_validators: Optional[ValidatorSet] = None
+    last_validators: Optional[ValidatorSet] = None
+    last_height_validators_changed: int = 0
+    consensus_params: ConsensusParams = field(default_factory=ConsensusParams)
+    last_height_consensus_params_changed: int = 0
+    last_results_hash: bytes = b""
+    app_hash: bytes = b""
+
+    def is_empty(self) -> bool:
+        return self.validators is None
+
+    def copy(self) -> "State":
+        return replace(
+            self,
+            validators=self.validators.copy() if self.validators else None,
+            next_validators=(
+                self.next_validators.copy() if self.next_validators else None
+            ),
+            last_validators=(
+                self.last_validators.copy() if self.last_validators else None
+            ),
+        )
+
+    def make_header_template(
+        self, height: int, time_ns: int, proposer_address: bytes
+    ) -> Header:
+        return Header(
+            version_block=BLOCK_VERSION,
+            chain_id=self.chain_id,
+            height=height,
+            time_ns=time_ns,
+            last_block_id=self.last_block_id,
+            validators_hash=self.validators.hash(),
+            next_validators_hash=self.next_validators.hash(),
+            consensus_hash=self.consensus_params.hash(),
+            app_hash=self.app_hash,
+            last_results_hash=self.last_results_hash,
+            proposer_address=proposer_address,
+        )

@@ -1,0 +1,166 @@
+"""State store: per-height state, validators, params, ABCI responses
+(reference state/store.go).
+
+Key layout:
+  S:state            -> latest State
+  S:vals:<height>    -> ValidatorSet active AT height
+  S:params:<height>  -> ConsensusParams active at height (only when changed)
+  S:abci:<height>    -> FinalizeBlockResponse (tx results etc.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..types.validator_set import ValidatorSet
+from ..utils import codec, kv, proto
+from .state_types import ConsensusParams, State
+
+
+def _h(prefix: bytes, height: int) -> bytes:
+    return prefix + height.to_bytes(8, "big")
+
+
+def encode_state(s: State) -> bytes:
+    out = proto.field_string(1, s.chain_id)
+    out += proto.field_varint(2, s.initial_height)
+    out += proto.field_varint(3, s.last_block_height)
+    out += proto.field_message(4, s.last_block_id.encode())
+    out += proto.field_varint(5, s.last_block_time_ns)
+    if s.validators:
+        out += proto.field_message(6, codec.encode_validator_set(s.validators))
+    if s.next_validators:
+        out += proto.field_message(
+            7, codec.encode_validator_set(s.next_validators)
+        )
+    if s.last_validators and s.last_validators.size() > 0:
+        out += proto.field_message(
+            8, codec.encode_validator_set(s.last_validators)
+        )
+    out += proto.field_varint(9, s.last_height_validators_changed)
+    out += proto.field_message(10, s.consensus_params.encode())
+    out += proto.field_varint(11, s.last_height_consensus_params_changed)
+    out += proto.field_bytes(12, s.last_results_hash)
+    out += proto.field_bytes(13, s.app_hash)
+    return out
+
+
+def decode_state(b: bytes) -> State:
+    m = proto.parse(b)
+
+    def vs(f):
+        raw = proto.get1(m, f)
+        return codec.decode_validator_set(raw) if raw else None
+
+    return State(
+        chain_id=proto.get1(m, 1, b"").decode(),
+        initial_height=proto.get1(m, 2, 1),
+        last_block_height=proto.get1(m, 3, 0),
+        last_block_id=codec.decode_block_id(proto.get1(m, 4, b"")),
+        last_block_time_ns=proto.get1(m, 5, 0),
+        validators=vs(6),
+        next_validators=vs(7),
+        last_validators=vs(8) or ValidatorSet.__new__(ValidatorSet),
+        last_height_validators_changed=proto.get1(m, 9, 0),
+        consensus_params=ConsensusParams.decode(proto.get1(m, 10, b"")),
+        last_height_consensus_params_changed=proto.get1(m, 11, 0),
+        last_results_hash=proto.get1(m, 12, b""),
+        app_hash=proto.get1(m, 13, b""),
+    )
+
+
+class Store:
+    def __init__(self, db: kv.KV):
+        self.db = db
+
+    def load(self) -> Optional[State]:
+        b = self.db.get(b"S:state")
+        if b is None:
+            return None
+        st = decode_state(b)
+        if st.last_validators is not None and not hasattr(
+            st.last_validators, "validators"
+        ):
+            st.last_validators = None
+        return st
+
+    def save(self, state: State) -> None:
+        next_height = state.last_block_height + 1
+        if next_height == state.initial_height:
+            # genesis: record both current and next valsets
+            self.db.set(
+                _h(b"S:vals:", next_height),
+                codec.encode_validator_set(state.validators),
+            )
+        sets = [
+            (b"S:state", encode_state(state)),
+            (
+                _h(b"S:vals:", next_height + 1),
+                codec.encode_validator_set(state.next_validators),
+            ),
+            (
+                _h(b"S:params:", next_height),
+                state.consensus_params.encode(),
+            ),
+        ]
+        self.db.write_batch(sets)
+
+    def bootstrap(self, state: State) -> None:
+        """Save a state obtained out-of-band (statesync), with history
+        gaps (reference state/store.go Bootstrap)."""
+        h = state.last_block_height
+        sets = [(b"S:state", encode_state(state))]
+        if state.last_validators is not None and getattr(
+            state.last_validators, "validators", None
+        ):
+            sets.append(
+                (
+                    _h(b"S:vals:", h),
+                    codec.encode_validator_set(state.last_validators),
+                )
+            )
+        sets.append(
+            (_h(b"S:vals:", h + 1), codec.encode_validator_set(state.validators))
+        )
+        sets.append(
+            (
+                _h(b"S:vals:", h + 2),
+                codec.encode_validator_set(state.next_validators),
+            )
+        )
+        sets.append((_h(b"S:params:", h + 1), state.consensus_params.encode()))
+        self.db.write_batch(sets)
+
+    def load_validators(self, height: int) -> Optional[ValidatorSet]:
+        b = self.db.get(_h(b"S:vals:", height))
+        return codec.decode_validator_set(b) if b else None
+
+    def load_consensus_params(self, height: int) -> Optional[ConsensusParams]:
+        b = self.db.get(_h(b"S:params:", height))
+        if b is not None:
+            return ConsensusParams.decode(b)
+        # walk back to the last change checkpoint
+        for hh in range(height, 0, -1):
+            b = self.db.get(_h(b"S:params:", hh))
+            if b is not None:
+                return ConsensusParams.decode(b)
+        return None
+
+    def save_finalize_block_response(self, height: int, encoded: bytes) -> None:
+        self.db.set(_h(b"S:abci:", height), encoded)
+
+    def load_finalize_block_response(self, height: int) -> Optional[bytes]:
+        return self.db.get(_h(b"S:abci:", height))
+
+    def prune_states(self, retain_height: int) -> None:
+        deletes = []
+        for k, _ in self.db.iter_prefix(b"S:vals:"):
+            h = int.from_bytes(k[len(b"S:vals:") :], "big")
+            if h < retain_height:
+                deletes.append(k)
+        for k, _ in self.db.iter_prefix(b"S:abci:"):
+            h = int.from_bytes(k[len(b"S:abci:") :], "big")
+            if h < retain_height:
+                deletes.append(k)
+        if deletes:
+            self.db.write_batch([], deletes)

@@ -1,0 +1,1 @@
+from .block_store import BlockStore  # noqa: F401

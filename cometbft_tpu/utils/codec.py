@@ -1,0 +1,295 @@
+"""Round-trip serialization for consensus artifacts (storage + wire).
+
+Proto wire format via utils.proto (writer + reader). This is the
+framework's own deterministic codec — behavioral parity with the
+reference's gogoproto-generated types (proto/tendermint/types/*.pb.go)
+without codegen. Field numbers are stable; changing them is a
+chain-breaking change.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..crypto.keys import (
+    ED25519_KEY_TYPE,
+    SECP256K1_KEY_TYPE,
+    Ed25519PubKey,
+    PubKey,
+    Secp256k1PubKey,
+    pubkey_from_type_bytes,
+)
+from ..types.block import (
+    Block,
+    BlockID,
+    Commit,
+    CommitSig,
+    Data,
+    Header,
+    PartSetHeader,
+)
+from ..types.validator_set import Validator, ValidatorSet
+from ..types.vote import Proposal, Vote
+from . import proto
+
+# --- pubkeys ------------------------------------------------------------
+
+
+def encode_pubkey(pk: PubKey) -> bytes:
+    if isinstance(pk, Ed25519PubKey):
+        return proto.field_bytes(1, pk.key_bytes)
+    if isinstance(pk, Secp256k1PubKey):
+        return proto.field_bytes(2, pk.key_bytes)
+    raise ValueError("unknown pubkey type")
+
+
+def decode_pubkey(b: bytes) -> PubKey:
+    m = proto.parse(b)
+    if 1 in m:
+        return pubkey_from_type_bytes(ED25519_KEY_TYPE, m[1][0])
+    if 2 in m:
+        return pubkey_from_type_bytes(SECP256K1_KEY_TYPE, m[2][0])
+    raise ValueError("empty pubkey")
+
+
+# --- block id -----------------------------------------------------------
+
+
+def encode_block_id(bid: BlockID) -> bytes:
+    return bid.encode()
+
+
+def decode_block_id(b: bytes) -> BlockID:
+    m = proto.parse(b)
+    pshb = proto.get1(m, 2, b"")
+    psh = PartSetHeader()
+    if pshb:
+        pm = proto.parse(pshb)
+        psh = PartSetHeader(proto.get1(pm, 1, 0), proto.get1(pm, 2, b""))
+    return BlockID(proto.get1(m, 1, b""), psh)
+
+
+# --- header -------------------------------------------------------------
+
+
+def encode_header(h: Header) -> bytes:
+    ver = proto.field_varint(1, h.version_block) + proto.field_varint(
+        2, h.version_app
+    )
+    return b"".join(
+        [
+            proto.field_message(1, ver),
+            proto.field_string(2, h.chain_id),
+            proto.field_varint(3, h.height),
+            proto.field_message(4, proto.timestamp(h.time_ns)),
+            proto.field_message(5, h.last_block_id.encode()),
+            proto.field_bytes(6, h.last_commit_hash),
+            proto.field_bytes(7, h.data_hash),
+            proto.field_bytes(8, h.validators_hash),
+            proto.field_bytes(9, h.next_validators_hash),
+            proto.field_bytes(10, h.consensus_hash),
+            proto.field_bytes(11, h.app_hash),
+            proto.field_bytes(12, h.last_results_hash),
+            proto.field_bytes(13, h.evidence_hash),
+            proto.field_bytes(14, h.proposer_address),
+        ]
+    )
+
+
+def decode_header(b: bytes) -> Header:
+    m = proto.parse(b)
+    vb = va = 0
+    if 1 in m:
+        vm = proto.parse(m[1][0])
+        vb, va = proto.get1(vm, 1, 0), proto.get1(vm, 2, 0)
+    return Header(
+        version_block=vb,
+        version_app=va,
+        chain_id=proto.get1(m, 2, b"").decode(),
+        height=proto.get1(m, 3, 0),
+        time_ns=proto.parse_timestamp(proto.get1(m, 4, b"")),
+        last_block_id=decode_block_id(proto.get1(m, 5, b"")),
+        last_commit_hash=proto.get1(m, 6, b""),
+        data_hash=proto.get1(m, 7, b""),
+        validators_hash=proto.get1(m, 8, b""),
+        next_validators_hash=proto.get1(m, 9, b""),
+        consensus_hash=proto.get1(m, 10, b""),
+        app_hash=proto.get1(m, 11, b""),
+        last_results_hash=proto.get1(m, 12, b""),
+        evidence_hash=proto.get1(m, 13, b""),
+        proposer_address=proto.get1(m, 14, b""),
+    )
+
+
+# --- commit -------------------------------------------------------------
+
+
+def encode_commit_sig(cs: CommitSig) -> bytes:
+    return (
+        proto.field_varint(1, cs.block_id_flag)
+        + proto.field_bytes(2, cs.validator_address)
+        + proto.field_message(3, proto.timestamp(cs.timestamp_ns))
+        + proto.field_bytes(4, cs.signature)
+    )
+
+
+def decode_commit_sig(b: bytes) -> CommitSig:
+    m = proto.parse(b)
+    return CommitSig(
+        block_id_flag=proto.get1(m, 1, 0),
+        validator_address=proto.get1(m, 2, b""),
+        timestamp_ns=proto.parse_timestamp(proto.get1(m, 3, b"")),
+        signature=proto.get1(m, 4, b""),
+    )
+
+
+def encode_commit(c: Commit) -> bytes:
+    out = proto.field_varint(1, c.height) + proto.field_varint(2, c.round)
+    out += proto.field_message(3, c.block_id.encode())
+    for cs in c.signatures:
+        out += proto.field_message(4, encode_commit_sig(cs))
+    return out
+
+
+def decode_commit(b: bytes) -> Commit:
+    m = proto.parse(b)
+    return Commit(
+        height=proto.get1(m, 1, 0),
+        round=proto.get1(m, 2, 0),
+        block_id=decode_block_id(proto.get1(m, 3, b"")),
+        signatures=[decode_commit_sig(x) for x in m.get(4, [])],
+    )
+
+
+# --- vote / proposal ----------------------------------------------------
+
+
+def encode_vote(v: Vote) -> bytes:
+    return b"".join(
+        [
+            proto.field_varint(1, v.type_),
+            proto.field_varint(2, v.height),
+            proto.field_varint(3, v.round),
+            proto.field_message(4, v.block_id.encode()),
+            proto.field_message(5, proto.timestamp(v.timestamp_ns)),
+            proto.field_bytes(6, v.validator_address),
+            proto.field_varint(7, v.validator_index + 1),  # +1: 0 realizable
+            proto.field_bytes(8, v.signature),
+            proto.field_bytes(9, v.extension),
+            proto.field_bytes(10, v.extension_signature),
+        ]
+    )
+
+
+def decode_vote(b: bytes) -> Vote:
+    m = proto.parse(b)
+    return Vote(
+        type_=proto.get1(m, 1, 0),
+        height=proto.get1(m, 2, 0),
+        round=proto.get1(m, 3, 0),
+        block_id=decode_block_id(proto.get1(m, 4, b"")),
+        timestamp_ns=proto.parse_timestamp(proto.get1(m, 5, b"")),
+        validator_address=proto.get1(m, 6, b""),
+        validator_index=proto.get1(m, 7, 0) - 1,
+        signature=proto.get1(m, 8, b""),
+        extension=proto.get1(m, 9, b""),
+        extension_signature=proto.get1(m, 10, b""),
+    )
+
+
+def encode_proposal(p: Proposal) -> bytes:
+    return b"".join(
+        [
+            proto.field_varint(1, p.height),
+            proto.field_varint(2, p.round),
+            proto.field_varint(3, p.pol_round + 2),  # offset: -1 realizable
+            proto.field_message(4, p.block_id.encode()),
+            proto.field_message(5, proto.timestamp(p.timestamp_ns)),
+            proto.field_bytes(6, p.signature),
+        ]
+    )
+
+
+def decode_proposal(b: bytes) -> Proposal:
+    m = proto.parse(b)
+    return Proposal(
+        height=proto.get1(m, 1, 0),
+        round=proto.get1(m, 2, 0),
+        pol_round=proto.get1(m, 3, 2) - 2,
+        block_id=decode_block_id(proto.get1(m, 4, b"")),
+        timestamp_ns=proto.parse_timestamp(proto.get1(m, 5, b"")),
+        signature=proto.get1(m, 6, b""),
+    )
+
+
+# --- block --------------------------------------------------------------
+
+
+def encode_block(blk: Block) -> bytes:
+    out = proto.field_message(1, encode_header(blk.header))
+    data = b"".join(proto.field_bytes(1, tx) for tx in blk.data.txs)
+    out += proto.field_message(2, data)
+    if blk.last_commit is not None:
+        out += proto.field_message(3, encode_commit(blk.last_commit))
+    for ev in blk.evidence:
+        out += proto.field_message(4, ev.encode())
+    return out
+
+
+def decode_block(b: bytes) -> Block:
+    from ..evidence.types import decode_evidence
+
+    m = proto.parse(b)
+    datab = proto.get1(m, 2, b"")
+    txs = proto.parse(datab).get(1, []) if datab else []
+    lc = proto.get1(m, 3)
+    return Block(
+        header=decode_header(proto.get1(m, 1, b"")),
+        data=Data(txs=txs),
+        last_commit=decode_commit(lc) if lc is not None else None,
+        evidence=[decode_evidence(e) for e in m.get(4, [])],
+    )
+
+
+# --- validators ---------------------------------------------------------
+
+
+def encode_validator(v: Validator) -> bytes:
+    return (
+        proto.field_bytes(1, v.address)
+        + proto.field_message(2, encode_pubkey(v.pub_key))
+        + proto.field_varint(3, v.voting_power)
+        + proto.field_sfixed64(4, v.proposer_priority)
+    )
+
+
+def decode_validator(b: bytes) -> Validator:
+    m = proto.parse(b)
+    return Validator(
+        pub_key=decode_pubkey(proto.get1(m, 2, b"")),
+        voting_power=proto.get1(m, 3, 0),
+        address=proto.get1(m, 1, b""),
+        proposer_priority=proto.get1(m, 4, 0),
+    )
+
+
+def encode_validator_set(vs: ValidatorSet) -> bytes:
+    out = b"".join(
+        proto.field_message(1, encode_validator(v)) for v in vs.validators
+    )
+    if vs.proposer is not None:
+        out += proto.field_bytes(2, vs.proposer.address)
+    return out
+
+
+def decode_validator_set(b: bytes) -> ValidatorSet:
+    m = proto.parse(b)
+    vals = [decode_validator(x) for x in m.get(1, [])]
+    vs = ValidatorSet.__new__(ValidatorSet)
+    vs.validators = vals
+    vs._by_address = {v.address: i for i, v in enumerate(vals)}
+    prop_addr = proto.get1(m, 2, b"")
+    vs.proposer = None
+    if prop_addr and prop_addr in vs._by_address:
+        vs.proposer = vals[vs._by_address[prop_addr]]
+    return vs

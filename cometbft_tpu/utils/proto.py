@@ -75,3 +75,78 @@ def timestamp(ns: int) -> bytes:
     """google.protobuf.Timestamp from integer unix nanoseconds."""
     secs, nanos = divmod(ns, 1_000_000_000)
     return field_varint(1, secs) + field_varint(2, nanos)
+
+
+# --- reader side --------------------------------------------------------
+
+
+def read_varint(buf: bytes, pos: int):
+    """Returns (value, new_pos); value fit to signed 64-bit."""
+    shift = 0
+    out = 0
+    while True:
+        if pos >= len(buf):
+            raise ValueError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long")
+    if out >= 1 << 63:
+        out -= 1 << 64
+    return out, pos
+
+
+def parse(buf: bytes):
+    """Parse a proto message into {field: [value, ...]} preserving order.
+
+    varint/fixed -> int, length-delimited -> bytes. Unknown wire types
+    raise (we only ever parse our own writer's output)."""
+    import struct as _s
+
+    out = {}
+    pos = 0
+    while pos < len(buf):
+        key, pos = read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == WIRE_VARINT:
+            v, pos = read_varint(buf, pos)
+        elif wire == WIRE_FIXED64:
+            (v,) = _s.unpack_from("<q", buf, pos)
+            pos += 8
+        elif wire == WIRE_BYTES:
+            ln, pos = read_varint(buf, pos)
+            v = bytes(buf[pos : pos + ln])
+            if len(v) != ln:
+                raise ValueError("truncated bytes field")
+            pos += ln
+        elif wire == WIRE_FIXED32:
+            (v,) = _s.unpack_from("<i", buf, pos)
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        out.setdefault(field, []).append(v)
+    return out
+
+
+def get1(msg, field, default=None):
+    vs = msg.get(field)
+    return vs[0] if vs else default
+
+
+def parse_timestamp(b: bytes) -> int:
+    if not b:
+        return 0
+    m = parse(b)
+    return get1(m, 1, 0) * 1_000_000_000 + get1(m, 2, 0)
+
+
+def read_delimited(buf: bytes, pos: int = 0):
+    """Inverse of delimited(): returns (payload, new_pos)."""
+    ln, pos = read_varint(buf, pos)
+    if ln < 0 or pos + ln > len(buf):
+        raise ValueError("truncated delimited message")
+    return bytes(buf[pos : pos + ln]), pos + ln

@@ -1,0 +1,225 @@
+"""Storage round-trips + ABCI local client + kvstore app."""
+
+import time
+
+import pytest
+
+from cometbft_tpu import types as T
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.client import AppConns
+from cometbft_tpu.models.kvstore import KVStoreApplication
+from cometbft_tpu.state.state_types import ConsensusParams, State
+from cometbft_tpu.state.store import Store, decode_state, encode_state
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.utils import codec, kv
+
+NOW = int(time.time() * 1e9)
+CHAIN = "store-chain"
+
+
+def make_block(vs, privs, height, prev_bid, app_hash=b"\x01" * 32):
+    header = T.Header(
+        chain_id=CHAIN,
+        height=height,
+        time_ns=NOW + height,
+        last_block_id=prev_bid,
+        validators_hash=vs.hash(),
+        next_validators_hash=vs.hash(),
+        app_hash=app_hash,
+        proposer_address=vs.validators[0].address,
+    )
+    data = T.Data(txs=[b"k%d=v%d" % (height, height)])
+    last_commit = None
+    if height > 1:
+        last_commit = T.Commit(height - 1, 0, prev_bid, [])
+    header = T.Header(
+        **{
+            **header.__dict__,
+            "data_hash": data.hash(),
+            "last_commit_hash": last_commit.hash() if last_commit else b"",
+        }
+    )
+    blk = T.Block(header=header, data=data, last_commit=last_commit)
+    return blk
+
+
+def test_codec_roundtrips():
+    vs, privs = T.random_validator_set(4)
+    blk = make_block(vs, privs, 1, T.BlockID())
+    enc = codec.encode_block(blk)
+    dec = codec.decode_block(enc)
+    assert dec.hash() == blk.hash()
+    assert dec.data.txs == blk.data.txs
+    # vote round trip
+    v = T.Vote(
+        type_=T.PRECOMMIT,
+        height=5,
+        round=2,
+        block_id=T.BlockID(b"\x02" * 32, T.PartSetHeader(3, b"\x03" * 32)),
+        timestamp_ns=NOW,
+        validator_address=privs[0].pub_key().address(),
+        validator_index=0,
+        signature=b"\x05" * 64,
+    )
+    v2 = codec.decode_vote(codec.encode_vote(v))
+    assert v2 == v
+    assert v2.sign_bytes(CHAIN) == v.sign_bytes(CHAIN)
+    # validator set round trip preserves order + proposer + priorities
+    vs.increment_proposer_priority(3)
+    vs2 = codec.decode_validator_set(codec.encode_validator_set(vs))
+    assert [x.address for x in vs2.validators] == [
+        x.address for x in vs.validators
+    ]
+    assert vs2.proposer.address == vs.proposer.address
+    assert vs2.hash() == vs.hash()
+    assert [x.proposer_priority for x in vs2.validators] == [
+        x.proposer_priority for x in vs.validators
+    ]
+
+
+def test_block_store_save_load(tmp_path):
+    db = kv.SqliteKV(str(tmp_path / "blocks.db"))
+    bs = BlockStore(db)
+    vs, privs = T.random_validator_set(4)
+    prev = T.BlockID()
+    blocks = []
+    for h in (1, 2, 3):
+        blk = make_block(vs, privs, h, prev)
+        ps = T.PartSet.from_data(codec.encode_block(blk))
+        seen = T.Commit(h, 0, T.BlockID(blk.hash(), ps.header), [])
+        bs.save_block(blk, ps, seen)
+        prev = T.BlockID(blk.hash(), ps.header)
+        blocks.append(blk)
+    assert bs.height() == 3
+    assert bs.base() == 1
+    got = bs.load_block(2)
+    assert got.hash() == blocks[1].hash()
+    assert bs.load_block_by_hash(blocks[0].hash()).height == 1
+    meta = bs.load_block_meta(3)
+    assert meta.header.height == 3
+    sc = bs.load_seen_commit(3)
+    assert sc.height == 3
+    lc = bs.load_block_commit(1)  # commit FOR height 1 came with block 2
+    assert lc.height == 1
+    # non-contiguous save rejected
+    blk5 = make_block(vs, privs, 5, prev)
+    ps5 = T.PartSet.from_data(codec.encode_block(blk5))
+    with pytest.raises(ValueError):
+        bs.save_block(blk5, ps5, T.Commit(5, 0, T.BlockID(), []))
+    # reopen from disk
+    bs2 = BlockStore(db)
+    assert bs2.height() == 3
+    assert bs2.load_block(1).hash() == blocks[0].hash()
+    # prune
+    assert bs2.prune_blocks(3) == 2
+    assert bs2.base() == 3
+    assert bs2.load_block(1) is None
+
+
+def test_state_store_roundtrip():
+    vs, _ = T.random_validator_set(3)
+    st = State(
+        chain_id=CHAIN,
+        initial_height=1,
+        last_block_height=7,
+        last_block_id=T.BlockID(b"\x09" * 32, T.PartSetHeader(1, b"\x0a" * 32)),
+        last_block_time_ns=NOW,
+        validators=vs,
+        next_validators=vs.copy(),
+        last_validators=vs.copy(),
+        consensus_params=ConsensusParams(),
+        app_hash=b"\x0b" * 32,
+        last_results_hash=b"\x0c" * 32,
+    )
+    dec = decode_state(encode_state(st))
+    assert dec.chain_id == CHAIN
+    assert dec.last_block_height == 7
+    assert dec.validators.hash() == vs.hash()
+    assert dec.app_hash == st.app_hash
+    db = kv.MemKV()
+    store = Store(db)
+    store.save(st)
+    assert store.load().last_block_height == 7
+    assert store.load_validators(9).hash() == vs.hash()
+
+
+def test_kvstore_app_lifecycle():
+    app = KVStoreApplication()
+    conns = AppConns.local(app)
+    info = conns.query.info(abci.RequestInfo())
+    assert info.last_block_height == 0
+    conns.consensus.init_chain(abci.RequestInitChain(chain_id=CHAIN))
+    # check + finalize + commit
+    assert conns.mempool.check_tx(abci.RequestCheckTx(tx=b"a=1")).is_ok()
+    assert not conns.mempool.check_tx(abci.RequestCheckTx(tx=b"junk")).is_ok()
+    resp = conns.consensus.finalize_block(
+        abci.RequestFinalizeBlock(txs=[b"a=1", b"b=2"], height=1)
+    )
+    assert all(r.is_ok() for r in resp.tx_results)
+    conns.consensus.commit()
+    q = conns.query.query(abci.RequestQuery(data=b"a"))
+    assert q.value == b"1"
+    assert app.height == 1
+    # determinism: same txs -> same app hash
+    app2 = KVStoreApplication()
+    app2.init_chain(abci.RequestInitChain(chain_id=CHAIN))
+    r2 = app2.finalize_block(
+        abci.RequestFinalizeBlock(txs=[b"a=1", b"b=2"], height=1)
+    )
+    assert r2.app_hash == resp.app_hash
+
+
+def test_kvstore_snapshots():
+    app = KVStoreApplication()
+    app.init_chain(abci.RequestInitChain(chain_id=CHAIN))
+    for h in range(1, 11):
+        app.finalize_block(
+            abci.RequestFinalizeBlock(txs=[b"k%d=v%d" % (h, h)], height=h)
+        )
+        app.commit()
+    snaps = app.list_snapshots()
+    assert snaps and snaps[-1].height == 10
+    # restore into a fresh app
+    app2 = KVStoreApplication()
+    s = snaps[-1]
+    app2.offer_snapshot(s, app.app_hash)
+    for c in range(s.chunks):
+        chunk = app.load_snapshot_chunk(s.height, 1, c)
+        app2.apply_snapshot_chunk(c, chunk, "peer")
+    assert app2.app_hash == app.app_hash
+    assert app2.height == 10
+
+
+def test_evidence_codec_roundtrip():
+    from cometbft_tpu.evidence.types import (
+        DuplicateVoteEvidence,
+        decode_evidence,
+    )
+
+    vs, privs = T.random_validator_set(2)
+    votes = []
+    for tag in (b"a", b"b"):
+        import hashlib
+
+        bid = T.BlockID(
+            hashlib.sha256(tag).digest(),
+            T.PartSetHeader(1, hashlib.sha256(tag + b"p").digest()),
+        )
+        v = T.Vote(
+            type_=T.PREVOTE,
+            height=4,
+            round=0,
+            block_id=bid,
+            timestamp_ns=NOW,
+            validator_address=privs[0].pub_key().address(),
+            validator_index=0,
+        )
+        v.signature = privs[0].sign(v.sign_bytes(CHAIN))
+        votes.append(v)
+    ev = DuplicateVoteEvidence.from_votes(
+        votes[0], votes[1], 100, 200, NOW
+    )
+    ev.validate_basic()
+    dec = decode_evidence(ev.encode())
+    assert dec.hash() == ev.hash()
+    assert dec.vote_a.signature == ev.vote_a.signature
