@@ -1,0 +1,11 @@
+from .config import (  # noqa: F401
+    BaseConfig,
+    BlockSyncConfig,
+    Config,
+    ConsensusConfig,
+    MempoolConfig,
+    P2PConfig,
+    RPCConfig,
+    StateSyncConfig,
+    default_config,
+)

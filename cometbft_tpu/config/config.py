@@ -1,0 +1,243 @@
+"""Node configuration (reference config/config.go, 12 sections + TOML).
+
+Dataclass-backed with TOML round-trip (tomllib read; simple writer).
+Includes the fork-added sections: BlockSync.adaptive_sync
+(config.go:1194) and the crypto backend selection for the TPU verifier.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import asdict, dataclass, field
+from typing import List, Optional
+
+try:
+    import tomllib
+except ImportError:  # pragma: no cover
+    tomllib = None
+
+
+@dataclass
+class BaseConfig:
+    chain_id: str = ""
+    moniker: str = "tpu-node"
+    db_backend: str = "sqlite"
+    db_dir: str = "data"
+    log_level: str = "info"
+    genesis_file: str = "config/genesis.json"
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    node_key_file: str = "config/node_key.json"
+    abci: str = "kvstore"
+    filter_peers: bool = False
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://127.0.0.1:26657"
+    max_open_connections: int = 900
+    max_subscription_clients: int = 100
+    timeout_broadcast_tx_commit_s: float = 10.0
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""
+    persistent_peers: str = ""
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    flush_throttle_ms: int = 10
+    max_packet_msg_payload_size: int = 1024
+    send_rate: int = 5_120_000
+    recv_rate: int = 5_120_000
+    pex: bool = True
+    seed_mode: bool = False
+    handshake_timeout_s: float = 20.0
+    dial_timeout_s: float = 3.0
+    use_libp2p_equivalent: bool = False  # fork: lp2p transport selection
+
+
+@dataclass
+class MempoolConfig:
+    type_: str = "clist"  # clist | nop | app (fork)
+    recheck: bool = True
+    broadcast: bool = True
+    size: int = 5000
+    cache_size: int = 10000
+    max_tx_bytes: int = 1024 * 1024
+    max_txs_bytes: int = 64 * 1024 * 1024
+
+
+@dataclass
+class StateSyncConfig:
+    enable: bool = False
+    rpc_servers: List[str] = field(default_factory=list)
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period_s: float = 168 * 3600.0
+    discovery_time_s: float = 15.0
+    chunk_request_timeout_s: float = 10.0
+
+
+@dataclass
+class BlockSyncConfig:
+    enable: bool = True
+    adaptive_sync: bool = False  # fork feature (config.go:1194)
+
+
+@dataclass
+class ConsensusConfig:
+    wal_file: str = "data/cs.wal/wal"
+    timeout_propose_s: float = 3.0
+    timeout_propose_delta_s: float = 0.5
+    timeout_prevote_s: float = 1.0
+    timeout_prevote_delta_s: float = 0.5
+    timeout_precommit_s: float = 1.0
+    timeout_precommit_delta_s: float = 0.5
+    timeout_commit_s: float = 1.0
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval_s: float = 0.0
+    peer_gossip_sleep_s: float = 0.1
+    peer_query_maj23_sleep_s: float = 2.0
+
+    def propose_timeout(self, round_: int) -> float:
+        return self.timeout_propose_s + self.timeout_propose_delta_s * round_
+
+    def prevote_timeout(self, round_: int) -> float:
+        return self.timeout_prevote_s + self.timeout_prevote_delta_s * round_
+
+    def precommit_timeout(self, round_: int) -> float:
+        return (
+            self.timeout_precommit_s + self.timeout_precommit_delta_s * round_
+        )
+
+
+@dataclass
+class StorageConfig:
+    discard_abci_responses: bool = False
+
+
+@dataclass
+class TxIndexConfig:
+    indexer: str = "kv"  # kv | null
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+
+
+@dataclass
+class CryptoConfig:
+    """TPU-native addition: signature-verification backend knobs."""
+
+    batch_backend: str = "tpu"  # tpu | cpu
+    min_batch_for_tpu: int = 2
+    coalesce_window_ms: float = 2.0
+    max_lanes: int = 32768
+
+
+@dataclass
+class Config:
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(
+        default_factory=InstrumentationConfig
+    )
+    crypto: CryptoConfig = field(default_factory=CryptoConfig)
+    root_dir: str = "."
+
+    def path(self, rel: str) -> str:
+        return os.path.join(self.root_dir, rel)
+
+
+def default_config(root_dir: str = ".") -> Config:
+    c = Config()
+    c.root_dir = root_dir
+    return c
+
+
+def test_config(root_dir: str = ".") -> Config:
+    """Short timeouts for in-process tests (reference config.TestConfig)."""
+    c = default_config(root_dir)
+    c.consensus.timeout_propose_s = 0.4
+    c.consensus.timeout_propose_delta_s = 0.1
+    c.consensus.timeout_prevote_s = 0.2
+    c.consensus.timeout_prevote_delta_s = 0.1
+    c.consensus.timeout_precommit_s = 0.2
+    c.consensus.timeout_precommit_delta_s = 0.1
+    c.consensus.timeout_commit_s = 0.1
+    c.consensus.peer_gossip_sleep_s = 0.01
+    c.base.db_backend = "memdb"
+    return c
+
+
+def load_toml(path: str) -> Config:
+    assert tomllib is not None
+    with open(path, "rb") as f:
+        raw = tomllib.load(f)
+    c = default_config(os.path.dirname(os.path.dirname(path)) or ".")
+    for section, cls_name in (
+        ("base", "base"),
+        ("rpc", "rpc"),
+        ("p2p", "p2p"),
+        ("mempool", "mempool"),
+        ("statesync", "statesync"),
+        ("blocksync", "blocksync"),
+        ("consensus", "consensus"),
+        ("storage", "storage"),
+        ("tx_index", "tx_index"),
+        ("instrumentation", "instrumentation"),
+        ("crypto", "crypto"),
+    ):
+        if section in raw:
+            obj = getattr(c, cls_name)
+            for k, v in raw[section].items():
+                if hasattr(obj, k):
+                    setattr(obj, k, v)
+    return c
+
+
+def write_toml(cfg: Config, path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def emit(name, obj):
+        lines = [f"[{name}]"]
+        for k, v in asdict(obj).items():
+            if isinstance(v, bool):
+                lines.append(f"{k} = {'true' if v else 'false'}")
+            elif isinstance(v, (int, float)):
+                lines.append(f"{k} = {v}")
+            elif isinstance(v, list):
+                inner = ", ".join(f'"{x}"' for x in v)
+                lines.append(f"{k} = [{inner}]")
+            else:
+                lines.append(f'{k} = "{v}"')
+        return "\n".join(lines)
+
+    sections = [
+        ("base", cfg.base),
+        ("rpc", cfg.rpc),
+        ("p2p", cfg.p2p),
+        ("mempool", cfg.mempool),
+        ("statesync", cfg.statesync),
+        ("blocksync", cfg.blocksync),
+        ("consensus", cfg.consensus),
+        ("storage", cfg.storage),
+        ("tx_index", cfg.tx_index),
+        ("instrumentation", cfg.instrumentation),
+        ("crypto", cfg.crypto),
+    ]
+    with open(path, "w") as f:
+        f.write("\n\n".join(emit(n, o) for n, o in sections) + "\n")

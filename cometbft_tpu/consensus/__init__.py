@@ -1,0 +1,10 @@
+from .state import (  # noqa: F401
+    BlockPartMessage,
+    ConsensusState,
+    ProposalMessage,
+    TimeoutInfo,
+    VoteMessage,
+)
+from .types import HeightVoteSet, RoundState, Step  # noqa: F401
+from .wal import WAL, WALMessage  # noqa: F401
+from .replay import Handshaker  # noqa: F401

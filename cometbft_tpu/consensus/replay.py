@@ -1,0 +1,114 @@
+"""Handshaker: sync the ABCI app with the block store on boot.
+
+Parity with reference consensus/replay.go: Info handshake (:241),
+ReplayBlocks (:288) — InitChain at genesis, then replay stored blocks
+[appHeight+1 .. storeHeight] through FinalizeBlock/Commit. This is the
+crash-recovery path: the store may be ahead of the app by any number of
+blocks (the WAL covers the in-flight height separately).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .. import types as T
+from ..abci import types as abci
+from ..state.state_types import State
+from ..state.execution import BlockExecutor, results_hash
+
+
+class Handshaker:
+    def __init__(self, state_store, state: State, block_store, genesis_doc):
+        self.state_store = state_store
+        self.state = state
+        self.block_store = block_store
+        self.genesis = genesis_doc
+        self.n_blocks_replayed = 0
+
+    def handshake(self, proxy_app) -> State:
+        info = proxy_app.query.info(abci.RequestInfo())
+        app_height = info.last_block_height
+        app_hash = info.last_block_app_hash
+        state = self.replay_blocks(proxy_app, self.state, app_height, app_hash)
+        return state
+
+    def replay_blocks(
+        self, proxy_app, state: State, app_height: int, app_hash: bytes
+    ) -> State:
+        store_height = self.block_store.height()
+        if app_height == 0:
+            # genesis: InitChain
+            vals = [
+                abci.ValidatorUpdate(
+                    pub_key_type=v.pub_key.type_,
+                    pub_key_bytes=v.pub_key.key_bytes,
+                    power=v.voting_power,
+                )
+                for v in self.genesis.validators
+            ]
+            resp = proxy_app.consensus.init_chain(
+                abci.RequestInitChain(
+                    time_ns=self.genesis.genesis_time_ns,
+                    chain_id=self.genesis.chain_id,
+                    validators=vals,
+                    app_state_bytes=self.genesis.app_state_bytes,
+                    initial_height=self.genesis.initial_height,
+                )
+            )
+            if state.last_block_height == 0:
+                if resp.validators:
+                    from ..crypto.keys import pubkey_from_type_bytes
+
+                    nv = [
+                        T.Validator(
+                            pubkey_from_type_bytes(
+                                u.pub_key_type, u.pub_key_bytes
+                            ),
+                            u.power,
+                        )
+                        for u in resp.validators
+                    ]
+                    vs = T.ValidatorSet(nv)
+                    state.validators = vs
+                    state.next_validators = vs.copy()
+                if resp.app_hash:
+                    state.app_hash = resp.app_hash
+                self.state_store.save(state)
+            app_hash = resp.app_hash or state.app_hash
+            app_height = self.genesis.initial_height - 1
+
+        if store_height == 0:
+            return state
+
+        # replay store blocks the app has not seen
+        for h in range(app_height + 1, store_height + 1):
+            block = self.block_store.load_block(h)
+            if block is None:
+                raise RuntimeError(f"missing block {h} during replay")
+            req = abci.RequestFinalizeBlock(
+                txs=block.data.txs,
+                hash=block.hash(),
+                height=h,
+                time_ns=block.header.time_ns,
+                next_validators_hash=block.header.next_validators_hash,
+                proposer_address=block.header.proposer_address,
+            )
+            resp = proxy_app.consensus.finalize_block(req)
+            proxy_app.consensus.commit()
+            self.n_blocks_replayed += 1
+            app_hash = resp.app_hash
+
+        # state may lag the store by one block (crash between save_block
+        # and state save): re-derive it
+        if state.last_block_height < store_height:
+            meta = self.block_store.load_block_meta(store_height)
+            block = self.block_store.load_block(store_height)
+            raw = self.state_store.load_finalize_block_response(store_height)
+            from .execution_compat import rederive_state
+
+            state = rederive_state(
+                self.state_store, state, block, meta, raw
+            )
+        if state.app_hash != app_hash and app_hash:
+            state.app_hash = app_hash
+        return state

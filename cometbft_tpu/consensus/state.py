@@ -1,0 +1,829 @@
+"""The BFT consensus state machine (reference consensus/state.go).
+
+Tendermint rounds: propose -> prevote -> precommit -> commit, with
+locking/unlocking, POL (proof-of-lock) tracking, WAL-before-act
+persistence and crash replay.
+
+Architecture (TPU-host-native, not a goroutine port): one asyncio task
+(`_receive_routine`) is the single writer over RoundState — peers,
+internal messages and timeouts all arrive on one queue, mirroring the
+reference's single-threaded receiveRoutine (consensus/state.go:789)
+without its mutex web. Timeouts are asyncio timers that enqueue; the
+block executor + TPU signature verification run inline (they are the
+actual work); gossip runs in reactor tasks reading RoundState snapshots.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import traceback
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+from .. import types as T
+from ..config import ConsensusConfig
+from ..state.state_types import State
+from ..types import events as ev
+from ..utils import codec
+from . import wal as walmod
+from .types import HeightVoteSet, RoundState, Step
+
+
+@dataclass
+class ProposalMessage:
+    proposal: T.Proposal
+
+
+@dataclass
+class BlockPartMessage:
+    height: int
+    round: int
+    part: T.Part
+
+
+@dataclass
+class VoteMessage:
+    vote: T.Vote
+
+
+@dataclass
+class TimeoutInfo:
+    duration_s: float
+    height: int
+    round: int
+    step: Step
+
+
+class ConsensusState:
+    def __init__(
+        self,
+        config: ConsensusConfig,
+        state: State,
+        block_exec,
+        block_store,
+        mempool,
+        priv_validator=None,
+        event_bus: Optional[ev.EventBus] = None,
+        wal_path: Optional[str] = None,
+        evidence_pool=None,
+        on_decided: Optional[Callable] = None,
+    ):
+        self.config = config
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.mempool = mempool
+        self.privval = priv_validator
+        self.event_bus = event_bus or ev.EventBus()
+        self.evpool = evidence_pool
+        self.on_decided = on_decided  # hook: (height, block_id, block)
+
+        self.rs = RoundState()
+        self.state: Optional[State] = None
+        self.queue: "asyncio.Queue" = None  # created in start()
+        self._timeout_task: Optional[asyncio.Task] = None
+        self._routine_task: Optional[asyncio.Task] = None
+        self._stopped = asyncio.Event() if False else None
+        self.wal: Optional[walmod.WAL] = None
+        self._wal_path = wal_path
+        self._broadcast_hooks: List[Callable] = []
+        self.decided_heights = 0
+
+        self.update_to_state(state)
+
+    # --- wiring -------------------------------------------------------
+
+    def add_broadcast_hook(self, fn: Callable) -> None:
+        """fn(kind, payload): called for every message this node emits
+        (proposal / block part / vote) — the reactor's gossip feed."""
+        self._broadcast_hooks.append(fn)
+
+    def _broadcast(self, kind: str, payload) -> None:
+        for fn in self._broadcast_hooks:
+            try:
+                fn(kind, payload)
+            except Exception:
+                traceback.print_exc()
+
+    # --- lifecycle ----------------------------------------------------
+
+    async def start(self) -> None:
+        self.queue = asyncio.Queue(maxsize=10000)
+        self.event_bus.set_loop(asyncio.get_running_loop())
+        if self._wal_path:
+            self.wal = walmod.WAL(self._wal_path)
+            self._catchup_replay()
+        self._routine_task = asyncio.create_task(self._receive_routine())
+        # kick off the first height
+        self._schedule_timeout(
+            0.0, self.rs.height, 0, Step.NEW_HEIGHT
+        )
+
+    async def stop(self) -> None:
+        if self._routine_task:
+            self._routine_task.cancel()
+            try:
+                await self._routine_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._timeout_task:
+            self._timeout_task.cancel()
+        if self.wal:
+            self.wal.close()
+
+    # --- state transitions --------------------------------------------
+
+    def update_to_state(self, state: State) -> None:
+        """Reset RoundState for the next height (reference updateToState)."""
+        if (
+            self.rs.commit_round > -1
+            and 0 < self.rs.height <= state.last_block_height
+        ):
+            pass  # committed by us; moving on
+        self.state = state
+        height = state.last_block_height + 1
+        if height == state.initial_height:
+            last_precommits = None
+        else:
+            last_precommits = self.rs.votes.precommits(
+                self.rs.commit_round
+            ) if self.rs.votes and self.rs.commit_round >= 0 else None
+        self.rs = RoundState(
+            height=height,
+            round=0,
+            step=Step.NEW_HEIGHT,
+            validators=state.validators.copy(),
+            votes=HeightVoteSet(state.chain_id, height, state.validators),
+            last_commit=last_precommits,
+            last_validators=state.last_validators.copy()
+            if state.last_validators and getattr(state.last_validators, "validators", None)
+            else None,
+            start_time_ns=time.time_ns(),
+        )
+
+    # --- receive routine (single writer) ------------------------------
+
+    async def _receive_routine(self) -> None:
+        while True:
+            item = await self.queue.get()
+            try:
+                kind, payload, peer_id = item
+                if kind == "timeout":
+                    self._wal_write(
+                        walmod.WALMessage(
+                            kind=walmod.MSG_TIMEOUT,
+                            height=payload.height,
+                            round=payload.round,
+                            step=str(int(payload.step)),
+                        ),
+                        sync=True,
+                    )
+                    self._handle_timeout(payload)
+                else:
+                    self._wal_write_msg(kind, payload, peer_id)
+                    self._handle_msg(kind, payload, peer_id)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                traceback.print_exc()
+
+    def _handle_msg(self, kind: str, payload, peer_id: str) -> None:
+        if kind == "proposal":
+            if self._set_proposal(payload.proposal) and peer_id != "":
+                self._broadcast("proposal", payload)
+        elif kind == "block_part":
+            added = self._add_proposal_block_part(
+                payload.height, payload.round, payload.part
+            )
+            if added and peer_id != "":
+                self._broadcast("block_part", payload)
+        elif kind == "vote":
+            self._try_add_vote(payload.vote, peer_id)
+
+    def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        rs = self.rs
+        if ti.height != rs.height or (
+            ti.round < rs.round
+            or (ti.round == rs.round and ti.step < rs.step and ti.step != Step.NEW_HEIGHT)
+        ):
+            return
+        if ti.step == Step.NEW_HEIGHT:
+            self._enter_new_round(ti.height, 0)
+        elif ti.step == Step.NEW_ROUND:
+            self._enter_propose(ti.height, 0)
+        elif ti.step == Step.PROPOSE:
+            self.event_bus.publish_type(ev.EVENT_TIMEOUT_PROPOSE, rs.height)
+            self._enter_prevote(ti.height, ti.round)
+        elif ti.step == Step.PREVOTE_WAIT:
+            self.event_bus.publish_type(ev.EVENT_TIMEOUT_WAIT, rs.height)
+            self._enter_precommit(ti.height, ti.round)
+        elif ti.step == Step.PRECOMMIT_WAIT:
+            self.event_bus.publish_type(ev.EVENT_TIMEOUT_WAIT, rs.height)
+            self._enter_precommit(ti.height, ti.round)
+            self._enter_new_round(ti.height, ti.round + 1)
+
+    # --- WAL ----------------------------------------------------------
+
+    def _wal_write_msg(self, kind: str, payload, peer_id: str) -> None:
+        if self.wal is None:
+            return
+        if kind == "proposal":
+            m = walmod.WALMessage(
+                kind=walmod.MSG_PROPOSAL,
+                height=payload.proposal.height,
+                round=payload.proposal.round,
+                data=codec.encode_proposal(payload.proposal),
+                peer_id=peer_id,
+            )
+        elif kind == "block_part":
+            from ..store.block_store import _encode_part
+
+            m = walmod.WALMessage(
+                kind=walmod.MSG_BLOCK_PART,
+                height=payload.height,
+                round=payload.round,
+                data=_encode_part(payload.part),
+                peer_id=peer_id,
+            )
+        elif kind == "vote":
+            m = walmod.WALMessage(
+                kind=walmod.MSG_VOTE,
+                height=payload.vote.height,
+                round=payload.vote.round,
+                data=codec.encode_vote(payload.vote),
+                peer_id=peer_id,
+            )
+        else:
+            return
+        # own messages (peer_id == "") are fsync barriers (state.go:881)
+        self._wal_write(m, sync=(peer_id == ""))
+
+    def _wal_write(self, m: walmod.WALMessage, sync: bool) -> None:
+        if self.wal is None:
+            return
+        if sync:
+            self.wal.write_sync(m)
+        else:
+            self.wal.write(m)
+
+    def _catchup_replay(self) -> None:
+        """Replay WAL messages for the current height after a crash
+        (reference consensus/replay.go:94)."""
+        path = self._wal_path
+        end_prev = walmod.WAL.search_for_end_height(
+            path, self.rs.height - 1
+        )
+        if end_prev is None and self.rs.height > self.state.initial_height:
+            return
+        replaying = []
+        if end_prev is not None:
+            msgs = list(walmod.WAL.iter_messages(path))[end_prev:]
+            replaying = msgs
+        else:
+            replaying = list(
+                walmod.WAL.iter_messages(path)
+            )
+        for m in replaying:
+            try:
+                self._replay_one(m)
+            except Exception:
+                traceback.print_exc()
+
+    def _replay_one(self, m: walmod.WALMessage) -> None:
+        from ..store.block_store import _decode_part
+
+        if m.kind == walmod.MSG_PROPOSAL:
+            self._set_proposal(codec.decode_proposal(m.data))
+        elif m.kind == walmod.MSG_BLOCK_PART:
+            self._add_proposal_block_part(
+                m.height, m.round, _decode_part(m.data)
+            )
+        elif m.kind == walmod.MSG_VOTE:
+            self._try_add_vote(codec.decode_vote(m.data), m.peer_id)
+
+    # --- timeout scheduling -------------------------------------------
+
+    def _schedule_timeout(
+        self, duration_s: float, height: int, round_: int, step: Step
+    ) -> None:
+        if self._timeout_task is not None:
+            self._timeout_task.cancel()
+        ti = TimeoutInfo(duration_s, height, round_, step)
+
+        async def fire():
+            try:
+                if duration_s > 0:
+                    await asyncio.sleep(duration_s)
+                await self.queue.put(("timeout", ti, ""))
+            except asyncio.CancelledError:
+                pass
+
+        self._timeout_task = asyncio.create_task(fire())
+
+    # --- round entry functions ----------------------------------------
+
+    def _enter_new_round(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step != Step.NEW_HEIGHT
+        ):
+            return
+        if round_ > rs.round:
+            vals = rs.validators.copy()
+            vals.increment_proposer_priority(round_ - rs.round)
+            rs.validators = vals
+        rs.round = round_
+        rs.step = Step.NEW_ROUND
+        if round_ > 0:
+            # new round: reset proposal (keep locked/valid blocks)
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_)
+        rs.triggered_timeout_precommit = False
+        self.event_bus.publish_type(
+            ev.EVENT_NEW_ROUND, {"height": height, "round": round_}
+        )
+        self._new_step()
+        # wait for txs? (create_empty_blocks interval) — proceed directly
+        self._enter_propose(height, round_)
+
+    def _enter_propose(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= Step.PROPOSE
+        ):
+            return
+        rs.step = Step.PROPOSE
+        self._new_step()
+        self._schedule_timeout(
+            self.config.propose_timeout(round_), height, round_, Step.PROPOSE
+        )
+        if self.privval is None:
+            self._maybe_finish_propose(height, round_)
+            return
+        our_addr = self.privval.pub_key().address()
+        if not rs.validators.has_address(our_addr):
+            self._maybe_finish_propose(height, round_)
+            return
+        proposer = rs.validators.get_proposer()
+        if proposer.address == our_addr:
+            self._decide_proposal(height, round_)
+        self._maybe_finish_propose(height, round_)
+
+    def _maybe_finish_propose(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.proposal_block is not None and rs.proposal is not None:
+            self._enter_prevote(height, round_)
+
+    def _decide_proposal(self, height: int, round_: int) -> None:
+        """We are the proposer (reference defaultDecideProposal :1246)."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, parts = rs.valid_block, rs.valid_block_parts
+        else:
+            last_commit = None
+            if height > self.state.initial_height:
+                seen = self.block_store.load_seen_commit(height - 1)
+                last_commit = seen
+                if last_commit is None and rs.last_commit is not None:
+                    last_commit = rs.last_commit.make_commit()
+                if last_commit is None:
+                    return  # cannot propose without last commit
+            try:
+                block, parts = self.block_exec.create_proposal_block(
+                    height,
+                    self.state,
+                    last_commit,
+                    self.privval.pub_key().address(),
+                )
+            except Exception:
+                traceback.print_exc()
+                return
+        bid = T.BlockID(block.hash(), parts.header)
+        prop = T.Proposal(
+            height=height,
+            round=round_,
+            pol_round=rs.valid_round,
+            block_id=bid,
+            timestamp_ns=time.time_ns(),
+        )
+        try:
+            self.privval.sign_proposal(self.state.chain_id, prop)
+        except Exception:
+            traceback.print_exc()
+            return
+        # feed to ourselves through the internal queue path (synchronously
+        # here: we ARE the single writer)
+        self._wal_write_msg("proposal", ProposalMessage(prop), "")
+        self._set_proposal(prop)
+        self._broadcast("proposal", ProposalMessage(prop))
+        for i in range(parts.header.total):
+            part = parts.get_part(i)
+            msg = BlockPartMessage(height, round_, part)
+            self._wal_write_msg("block_part", msg, "")
+            self._add_proposal_block_part(height, round_, part)
+            self._broadcast("block_part", msg)
+
+    def _set_proposal(self, proposal: T.Proposal) -> bool:
+        rs = self.rs
+        if rs.proposal is not None:
+            return False
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return False
+        proposal.validate_basic()
+        proposer = rs.validators.get_proposer()
+        if not proposal.verify(self.state.chain_id, proposer.pub_key):
+            raise ValueError("invalid proposal signature")
+        rs.proposal = proposal
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = T.PartSet(proposal.block_id.part_set_header)
+        return True
+
+    def _add_proposal_block_part(
+        self, height: int, round_: int, part: T.Part
+    ) -> bool:
+        rs = self.rs
+        if height != rs.height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False
+        added = rs.proposal_block_parts.add_part(part)
+        if not added:
+            return False
+        if rs.proposal_block_parts.is_complete():
+            data = rs.proposal_block_parts.assemble()
+            block = codec.decode_block(data)
+            rs.proposal_block = block
+            self.event_bus.publish_type(
+                ev.EVENT_COMPLETE_PROPOSAL,
+                {"height": height, "block_id": rs.proposal.block_id if rs.proposal else None},
+            )
+            # prevotes may already have a polka for this block
+            prevotes = rs.votes.prevotes(rs.round)
+            bid = prevotes.two_thirds_majority()
+            if bid is not None and not bid.is_nil() and rs.valid_round < rs.round:
+                if block.hash() == bid.hash:
+                    rs.valid_round = rs.round
+                    rs.valid_block = block
+                    rs.valid_block_parts = rs.proposal_block_parts
+            if rs.step <= Step.PROPOSE and rs.proposal is not None:
+                self._enter_prevote(height, rs.round)
+            elif rs.step == Step.COMMIT:
+                self._try_finalize_commit(height)
+        return True
+
+    # --- prevote ------------------------------------------------------
+
+    def _enter_prevote(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= Step.PREVOTE
+        ):
+            return
+        rs.step = Step.PREVOTE
+        self._new_step()
+        self._do_prevote(height, round_)
+
+    def _do_prevote(self, height: int, round_: int) -> None:
+        rs = self.rs
+        # locked block? vote for it (reference defaultDoPrevote :1387)
+        if rs.locked_block is not None:
+            self._sign_add_vote(
+                T.PREVOTE,
+                rs.locked_block.hash(),
+                rs.locked_block_parts.header,
+            )
+            return
+        if rs.proposal_block is None:
+            self._sign_add_vote(T.PREVOTE, None, None)
+            return
+        # validate
+        try:
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+            accepted = self.block_exec.process_proposal(
+                rs.proposal_block, self.state
+            )
+        except Exception:
+            accepted = False
+        if accepted:
+            self._sign_add_vote(
+                T.PREVOTE,
+                rs.proposal_block.hash(),
+                rs.proposal_block_parts.header,
+            )
+        else:
+            self._sign_add_vote(T.PREVOTE, None, None)
+
+    # --- precommit ----------------------------------------------------
+
+    def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= Step.PREVOTE_WAIT
+        ):
+            return
+        rs.step = Step.PREVOTE_WAIT
+        self._new_step()
+        self._schedule_timeout(
+            self.config.prevote_timeout(round_),
+            height,
+            round_,
+            Step.PREVOTE_WAIT,
+        )
+
+    def _enter_precommit(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= Step.PRECOMMIT
+        ):
+            return
+        rs.step = Step.PRECOMMIT
+        self._new_step()
+        prevotes = rs.votes.prevotes(round_)
+        bid = prevotes.two_thirds_majority()
+        if bid is None:
+            # no polka: precommit nil
+            self._sign_add_vote(T.PRECOMMIT, None, None)
+            return
+        self.event_bus.publish_type(
+            ev.EVENT_POLKA, {"height": height, "round": round_}
+        )
+        if bid.is_nil():
+            # polka for nil: unlock
+            rs.locked_round = -1
+            rs.locked_block = None
+            rs.locked_block_parts = None
+            self._sign_add_vote(T.PRECOMMIT, None, None)
+            return
+        # polka for a block
+        if rs.locked_block is not None and rs.locked_block.hash() == bid.hash:
+            rs.locked_round = round_
+            self._sign_add_vote(T.PRECOMMIT, bid.hash, bid.part_set_header)
+            return
+        if rs.proposal_block is not None and rs.proposal_block.hash() == bid.hash:
+            try:
+                self.block_exec.validate_block(self.state, rs.proposal_block)
+                rs.locked_round = round_
+                rs.locked_block = rs.proposal_block
+                rs.locked_block_parts = rs.proposal_block_parts
+                self.event_bus.publish_type(
+                    ev.EVENT_LOCK, {"height": height, "round": round_}
+                )
+                self._sign_add_vote(
+                    T.PRECOMMIT, bid.hash, bid.part_set_header
+                )
+                return
+            except Exception:
+                traceback.print_exc()
+        # polka for a block we don't have: unlock, precommit nil
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        self._sign_add_vote(T.PRECOMMIT, None, None)
+
+    def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.triggered_timeout_precommit
+        ):
+            return
+        rs.triggered_timeout_precommit = True
+        self._new_step()
+        self._schedule_timeout(
+            self.config.precommit_timeout(round_),
+            height,
+            round_,
+            Step.PRECOMMIT_WAIT,
+        )
+
+    # --- commit -------------------------------------------------------
+
+    def _enter_commit(self, height: int, commit_round: int) -> None:
+        rs = self.rs
+        if rs.height != height or rs.step >= Step.COMMIT:
+            return
+        rs.step = Step.COMMIT
+        rs.commit_round = commit_round
+        rs.commit_time_ns = time.time_ns()
+        self._new_step()
+        bid = rs.votes.precommits(commit_round).two_thirds_majority()
+        assert bid is not None and not bid.is_nil()
+        # if we have the block already as locked/proposal, stage it
+        if rs.locked_block is not None and rs.locked_block.hash() == bid.hash:
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        if (
+            rs.proposal_block is None
+            or rs.proposal_block.hash() != bid.hash
+        ):
+            # we're missing the block: reset parts to fetch it
+            if (
+                rs.proposal_block_parts is None
+                or rs.proposal_block_parts.header.hash != bid.part_set_header.hash
+            ):
+                rs.proposal_block = None
+                rs.proposal_block_parts = T.PartSet(bid.part_set_header)
+            return
+        self._try_finalize_commit(height)
+
+    def _try_finalize_commit(self, height: int) -> None:
+        rs = self.rs
+        if rs.height != height or rs.step != Step.COMMIT:
+            return
+        bid = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        if bid is None or bid.is_nil():
+            return
+        if rs.proposal_block is None or rs.proposal_block.hash() != bid.hash:
+            return
+        self._finalize_commit(height)
+
+    def _finalize_commit(self, height: int) -> None:
+        rs = self.rs
+        block, parts = rs.proposal_block, rs.proposal_block_parts
+        bid = T.BlockID(block.hash(), parts.header)
+        precommits = rs.votes.precommits(rs.commit_round)
+        seen_commit = precommits.make_commit()
+        # 1. save block
+        if self.block_store.height() < height:
+            self.block_store.save_block(block, parts, seen_commit)
+        else:
+            self.block_store.save_seen_commit(height, seen_commit)
+        # 2. WAL end-height barrier (reference :1801)
+        if self.wal:
+            self.wal.write_end_height(height)
+        # 3. apply (commit already verified by consensus itself)
+        try:
+            new_state = self.block_exec.apply_verified_block(
+                self.state, bid, block
+            )
+        except Exception:
+            traceback.print_exc()
+            raise
+        self.decided_heights += 1
+        if self.on_decided:
+            try:
+                self.on_decided(height, bid, block)
+            except Exception:
+                traceback.print_exc()
+        # 4. next height
+        self.update_to_state(new_state)
+        self._schedule_timeout(
+            0.0 if self.config.skip_timeout_commit else self.config.timeout_commit_s,
+            self.rs.height,
+            0,
+            Step.NEW_HEIGHT,
+        )
+
+    # --- votes --------------------------------------------------------
+
+    def _sign_add_vote(
+        self,
+        type_: int,
+        block_hash: Optional[bytes],
+        psh: Optional[T.PartSetHeader],
+    ) -> None:
+        rs = self.rs
+        if self.privval is None:
+            return
+        addr = self.privval.pub_key().address()
+        if not rs.validators.has_address(addr):
+            return
+        idx, _ = rs.validators.get_by_address(addr)
+        bid = (
+            T.BlockID(block_hash, psh)
+            if block_hash is not None
+            else T.NIL_BLOCK_ID
+        )
+        vote = T.Vote(
+            type_=type_,
+            height=rs.height,
+            round=rs.round,
+            block_id=bid,
+            timestamp_ns=time.time_ns(),
+            validator_address=addr,
+            validator_index=idx,
+        )
+        try:
+            self.privval.sign_vote(self.state.chain_id, vote)
+            if (
+                type_ == T.PRECOMMIT
+                and not bid.is_nil()
+                and self.state.consensus_params.vote_extensions_enabled(rs.height)
+            ):
+                self.privval.sign_vote_extension(self.state.chain_id, vote)
+        except Exception:
+            traceback.print_exc()
+            return
+        self._wal_write_msg("vote", VoteMessage(vote), "")
+        self._try_add_vote(vote, "")
+        self._broadcast("vote", VoteMessage(vote))
+
+    def _try_add_vote(self, vote: T.Vote, peer_id: str) -> None:
+        rs = self.rs
+        try:
+            if vote.height + 1 == rs.height and vote.type_ == T.PRECOMMIT:
+                # late precommit for the previous height
+                if rs.last_commit is not None:
+                    try:
+                        rs.last_commit.add_vote(vote)
+                    except Exception:
+                        pass
+                return
+            if vote.height != rs.height:
+                return
+            added = rs.votes.add_vote(vote)
+            if not added:
+                return
+        except T.ErrVoteConflictingVotes as e:
+            if self.evpool is not None and peer_id != "":
+                _, val = rs.validators.get_by_address(vote.validator_address)
+                if val is not None:
+                    from ..evidence.types import DuplicateVoteEvidence
+
+                    evd = DuplicateVoteEvidence.from_votes(
+                        e.existing,
+                        e.new,
+                        val.voting_power,
+                        rs.validators.total_voting_power(),
+                        time.time_ns(),
+                    )
+                    try:
+                        self.evpool.add_evidence(evd)
+                    except Exception:
+                        pass
+            return
+        except Exception:
+            return
+        self.event_bus.publish_type(ev.EVENT_VOTE, vote)
+        if peer_id != "":
+            self._broadcast("vote", VoteMessage(vote))
+        height, round_ = rs.height, rs.round
+        if vote.type_ == T.PREVOTE:
+            prevotes = rs.votes.prevotes(vote.round)
+            bid = prevotes.two_thirds_majority()
+            if bid is not None and not bid.is_nil():
+                # unlock if POL for something else (reference :2274)
+                if (
+                    rs.locked_block is not None
+                    and rs.locked_round < vote.round <= rs.round
+                    and rs.locked_block.hash() != bid.hash
+                ):
+                    rs.locked_round = -1
+                    rs.locked_block = None
+                    rs.locked_block_parts = None
+                # update valid block
+                if (
+                    rs.valid_round < vote.round <= rs.round
+                    and rs.proposal_block is not None
+                    and rs.proposal_block.hash() == bid.hash
+                ):
+                    rs.valid_round = vote.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+            if vote.round == round_:
+                if prevotes.has_two_thirds_majority():
+                    self._enter_precommit(height, vote.round)
+                elif (
+                    rs.step == Step.PREVOTE and prevotes.has_two_thirds_any()
+                ):
+                    self._enter_prevote_wait(height, vote.round)
+            elif vote.round > round_ and rs.votes.prevotes(
+                vote.round
+            ).has_two_thirds_any():
+                self._enter_new_round(height, vote.round)
+        else:  # PRECOMMIT
+            precommits = rs.votes.precommits(vote.round)
+            bid = precommits.two_thirds_majority()
+            if bid is not None:
+                self._enter_new_round(height, vote.round)
+                self._enter_precommit(height, vote.round)
+                if not bid.is_nil():
+                    self._enter_commit(height, vote.round)
+                    self._try_finalize_commit(height)
+                    if self.config.skip_timeout_commit and precommits.has_all():
+                        pass
+                else:
+                    self._enter_precommit_wait(height, vote.round)
+            elif vote.round >= round_ and precommits.has_two_thirds_any():
+                self._enter_new_round(height, vote.round)
+                self._enter_precommit_wait(height, vote.round)
+
+    # --- misc ---------------------------------------------------------
+
+    def _new_step(self) -> None:
+        self.event_bus.publish_type(
+            ev.EVENT_NEW_ROUND_STEP,
+            {
+                "height": self.rs.height,
+                "round": self.rs.round,
+                "step": int(self.rs.step),
+            },
+        )
+
+    # external API for reactors
+    async def enqueue(self, kind: str, payload, peer_id: str) -> None:
+        await self.queue.put((kind, payload, peer_id))
+
+    def enqueue_nowait(self, kind: str, payload, peer_id: str) -> None:
+        self.queue.put_nowait((kind, payload, peer_id))

@@ -27,7 +27,16 @@ import numpy as np
 
 from .keys import Ed25519PubKey, PubKey
 
-_MIN_TPU_BATCH = 2
+# Below this many signatures the host path wins: one XLA dispatch has
+# fixed latency (and a first-call compile), while host ed25519 verify is
+# ~60us/sig. Consensus-round commits (tens of sigs) stay on host; bulk
+# paths (blocksync replay, light bisection, 150-val commits) go to TPU.
+_MIN_TPU_BATCH = 64
+
+
+def set_min_tpu_batch(n: int) -> None:
+    global _MIN_TPU_BATCH
+    _MIN_TPU_BATCH = n
 
 
 class BatchVerifier:

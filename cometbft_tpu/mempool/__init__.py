@@ -1,0 +1,7 @@
+from .mempool import (  # noqa: F401
+    AppMempool,
+    CListMempool,
+    Mempool,
+    NopMempool,
+    TxCache,
+)

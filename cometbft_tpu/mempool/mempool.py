@@ -1,0 +1,291 @@
+"""Mempools: clist-equivalent, nop (ADR-111), app-side (fork feature).
+
+CListMempool parity (reference mempool/clist_mempool.go): CheckTx
+through the mempool ABCI connection, LRU tx cache, ordered pool, reap
+by max bytes/gas, post-commit update with recheck, TxsAvailable
+notification. The reference's concurrent linked list becomes an
+insertion-ordered dict under one lock — the Python runtime serializes
+reactor callbacks anyway; gossip iterates over snapshots.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..abci import types as abci
+
+
+def tx_key(tx: bytes) -> bytes:
+    return hashlib.sha256(tx).digest()
+
+
+class TxCache:
+    """LRU of recently seen tx keys (reference mempool/cache.go)."""
+
+    def __init__(self, size: int = 10000):
+        self.size = size
+        self._od: "OrderedDict[bytes, None]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def push(self, tx: bytes) -> bool:
+        """False if already present."""
+        k = tx_key(tx)
+        with self._lock:
+            if k in self._od:
+                self._od.move_to_end(k)
+                return False
+            self._od[k] = None
+            while len(self._od) > self.size:
+                self._od.popitem(last=False)
+            return True
+
+    def remove(self, tx: bytes) -> None:
+        with self._lock:
+            self._od.pop(tx_key(tx), None)
+
+    def has(self, tx: bytes) -> bool:
+        with self._lock:
+            return tx_key(tx) in self._od
+
+
+@dataclass
+class MempoolTx:
+    tx: bytes
+    height: int  # height when entering the pool
+    gas_wanted: int = 0
+    senders: set = field(default_factory=set)
+
+
+class Mempool:
+    """Interface (reference mempool/mempool.go Mempool)."""
+
+    def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
+        raise NotImplementedError
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
+        raise NotImplementedError
+
+    def update(self, height, txs, results) -> None:
+        raise NotImplementedError
+
+    def lock(self):
+        raise NotImplementedError
+
+    def unlock(self):
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def txs_available(self) -> threading.Event:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        raise NotImplementedError
+
+    def iter_txs(self) -> List[bytes]:
+        raise NotImplementedError
+
+
+class CListMempool(Mempool):
+    def __init__(
+        self,
+        proxy_app,
+        height: int = 0,
+        cache_size: int = 10000,
+        max_tx_bytes: int = 1024 * 1024,
+        max_txs: int = 5000,
+        recheck: bool = True,
+        notify: Optional[Callable[[], None]] = None,
+    ):
+        self.proxy = proxy_app
+        self.height = height
+        self.cache = TxCache(cache_size)
+        self.pool: "OrderedDict[bytes, MempoolTx]" = OrderedDict()
+        self.max_tx_bytes = max_tx_bytes
+        self.max_txs = max_txs
+        self.recheck = recheck
+        self._lock = threading.RLock()
+        self._txs_available = threading.Event()
+        self._notify = notify
+
+    # --- ingress ------------------------------------------------------
+
+    def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
+        if len(tx) > self.max_tx_bytes:
+            return abci.ResponseCheckTx(code=1, log="tx too large")
+        if not self.cache.push(tx):
+            k = tx_key(tx)
+            with self._lock:
+                if k in self.pool and sender:
+                    self.pool[k].senders.add(sender)
+            return abci.ResponseCheckTx(code=1, log="tx already in cache")
+        res = self.proxy.check_tx(abci.RequestCheckTx(tx=tx))
+        if res.is_ok():
+            with self._lock:
+                if len(self.pool) >= self.max_txs:
+                    self.cache.remove(tx)
+                    return abci.ResponseCheckTx(code=1, log="mempool full")
+                mt = MempoolTx(tx=tx, height=self.height, gas_wanted=res.gas_wanted)
+                if sender:
+                    mt.senders.add(sender)
+                self.pool[tx_key(tx)] = mt
+                self._txs_available.set()
+            if self._notify:
+                self._notify()
+        else:
+            self.cache.remove(tx)
+        return res
+
+    # --- egress -------------------------------------------------------
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
+        out, total_b, total_g = [], 0, 0
+        with self._lock:
+            for mt in self.pool.values():
+                nb = total_b + len(mt.tx)
+                ng = total_g + mt.gas_wanted
+                if max_bytes >= 0 and nb > max_bytes:
+                    break
+                if max_gas >= 0 and ng > max_gas:
+                    break
+                out.append(mt.tx)
+                total_b, total_g = nb, ng
+        return out
+
+    def iter_txs(self) -> List[bytes]:
+        with self._lock:
+            return [mt.tx for mt in self.pool.values()]
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self.pool)
+
+    # --- post-commit --------------------------------------------------
+
+    def lock(self):
+        self._lock.acquire()
+
+    def unlock(self):
+        self._lock.release()
+
+    def update(self, height: int, txs: List[bytes], results) -> None:
+        """Called with the mempool LOCKED, between FinalizeBlock and
+        releasing consensus (reference clist_mempool.go:583)."""
+        self.height = height
+        for tx, res in zip(txs, results):
+            if res.is_ok():
+                self.cache.push(tx)  # keep committed txs in cache
+            else:
+                self.cache.remove(tx)
+            self.pool.pop(tx_key(tx), None)
+        if self.recheck and self.pool:
+            self._recheck_txs()
+        if self.pool:
+            self._txs_available.set()
+            if self._notify:
+                self._notify()
+        else:
+            self._txs_available.clear()
+
+    def _recheck_txs(self) -> None:
+        for k in list(self.pool.keys()):
+            mt = self.pool[k]
+            res = self.proxy.check_tx(
+                abci.RequestCheckTx(
+                    tx=mt.tx, type_=abci.CHECK_TX_TYPE_RECHECK
+                )
+            )
+            if not res.is_ok():
+                del self.pool[k]
+                self.cache.remove(mt.tx)
+
+    def txs_available(self) -> threading.Event:
+        return self._txs_available
+
+    def flush(self) -> None:
+        with self._lock:
+            self.pool.clear()
+            self._txs_available.clear()
+
+
+class NopMempool(Mempool):
+    """ADR-111: mempool disabled (reference mempool/nop_mempool.go)."""
+
+    def check_tx(self, tx, sender=""):
+        return abci.ResponseCheckTx(code=1, log="mempool disabled")
+
+    def reap_max_bytes_max_gas(self, max_bytes, max_gas):
+        return []
+
+    def update(self, height, txs, results):
+        pass
+
+    def lock(self):
+        pass
+
+    def unlock(self):
+        pass
+
+    def size(self):
+        return 0
+
+    def txs_available(self) -> threading.Event:
+        return threading.Event()  # never set
+
+    def flush(self):
+        pass
+
+    def iter_txs(self):
+        return []
+
+
+class AppMempool(Mempool):
+    """Fork feature: the application owns the pool; the node only relays
+    InsertTx / ReapTxs (reference mempool/app_mempool.go:23-50) with a
+    TTL'd dedup guard in front (internal/guard)."""
+
+    def __init__(self, proxy_app, guard_ttl_s: float = 60.0, guard_size: int = 100_000):
+        from ..utils.guard import TTLGuard
+
+        self.proxy = proxy_app
+        self.guard = TTLGuard(ttl_s=guard_ttl_s, max_size=guard_size)
+        self._txs_available = threading.Event()
+
+    def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
+        if not self.guard.check_and_set(tx_key(tx)):
+            return abci.ResponseCheckTx(code=1, log="duplicate (guard)")
+        ok = self.proxy.insert_tx(tx)
+        if ok:
+            self._txs_available.set()
+        return abci.ResponseCheckTx(
+            code=abci.CODE_TYPE_OK if ok else 1
+        )
+
+    def reap_max_bytes_max_gas(self, max_bytes, max_gas):
+        return self.proxy.reap_txs(max_bytes, max_gas)
+
+    def update(self, height, txs, results):
+        self._txs_available.clear()
+
+    def lock(self):
+        pass
+
+    def unlock(self):
+        pass
+
+    def size(self):
+        return -1  # unknown: app-owned
+
+    def txs_available(self) -> threading.Event:
+        return self._txs_available
+
+    def flush(self):
+        pass
+
+    def iter_txs(self):
+        return self.proxy.reap_txs(-1, -1)

@@ -1,0 +1,203 @@
+"""In-process node construction + local test networks.
+
+The reference's consensus test fixtures (consensus/common_test.go
+randConsensusNet) as a first-class module: build N fully-wired
+consensus nodes around local ABCI apps and connect them with in-memory
+message delivery — deterministic multi-node consensus on one host, no
+sockets. Also the assembly core reused by the real networked node.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from .. import types as T
+from ..abci.client import AppConns
+from ..config import Config, ConsensusConfig
+from ..config.config import test_config
+from ..consensus import ConsensusState, Handshaker
+from ..crypto.keys import Ed25519PrivKey
+from ..mempool import CListMempool
+from ..models.kvstore import KVStoreApplication
+from ..privval import FilePV
+from ..state.execution import BlockExecutor
+from ..state.store import Store as StateStore
+from ..state.state_types import State
+from ..store import BlockStore
+from ..types import events as ev
+from ..types.genesis import GenesisDoc
+from ..utils import kv
+
+
+@dataclass
+class NodeParts:
+    """Everything a running node is made of (pre-networking)."""
+
+    config: Config
+    genesis: GenesisDoc
+    privval: Optional[FilePV]
+    app: object
+    proxy: AppConns
+    block_db: kv.KV
+    state_db: kv.KV
+    block_store: BlockStore
+    state_store: StateStore
+    state: State
+    mempool: CListMempool
+    event_bus: ev.EventBus
+    block_exec: BlockExecutor
+    cs: ConsensusState
+    evpool: object = None
+
+
+def build_node(
+    genesis: GenesisDoc,
+    privval: Optional[FilePV],
+    app=None,
+    config: Optional[Config] = None,
+    home: Optional[str] = None,
+    wal: bool = False,
+) -> NodeParts:
+    config = config or test_config(home or ".")
+    app = app or KVStoreApplication()
+    proxy = AppConns.local(app)
+    block_db = kv.open_kv(
+        config.base.db_backend,
+        None
+        if config.base.db_backend == "memdb"
+        else os.path.join(home, "blockstore.db"),
+    )
+    state_db = kv.open_kv(
+        config.base.db_backend,
+        None
+        if config.base.db_backend == "memdb"
+        else os.path.join(home, "state.db"),
+    )
+    block_store = BlockStore(block_db)
+    state_store = StateStore(state_db)
+
+    state = state_store.load()
+    if state is None:
+        state = genesis.make_genesis_state()
+        state_store.save(state)
+
+    # ABCI handshake: InitChain at genesis / replay stored blocks
+    hs = Handshaker(state_store, state, block_store, genesis)
+    state = hs.handshake(proxy)
+
+    event_bus = ev.EventBus()
+    from ..evidence.pool import EvidencePool
+
+    evpool = EvidencePool(kv.MemKV(), state_store, block_store)
+    mempool = CListMempool(proxy.mempool)
+    block_exec = BlockExecutor(
+        state_store,
+        proxy.consensus,
+        mempool,
+        evidence_pool=evpool,
+        event_bus=event_bus,
+        block_store=block_store,
+    )
+    wal_path = None
+    if wal:
+        wal_path = os.path.join(
+            home or tempfile.mkdtemp(), "cs.wal"
+        )
+    cs = ConsensusState(
+        config.consensus,
+        state,
+        block_exec,
+        block_store,
+        mempool,
+        priv_validator=privval,
+        event_bus=event_bus,
+        wal_path=wal_path,
+        evidence_pool=evpool,
+    )
+    return NodeParts(
+        config=config,
+        genesis=genesis,
+        privval=privval,
+        app=app,
+        proxy=proxy,
+        block_db=block_db,
+        state_db=state_db,
+        block_store=block_store,
+        state_store=state_store,
+        state=state,
+        mempool=mempool,
+        event_bus=event_bus,
+        block_exec=block_exec,
+        cs=cs,
+        evpool=evpool,
+    )
+
+
+def make_genesis(
+    n_validators: int, chain_id: str = "test-chain", power: int = 10
+):
+    """Returns (GenesisDoc, [FilePV-like in-memory signers])."""
+    privs = [Ed25519PrivKey.generate() for _ in range(n_validators)]
+    vals = [T.Validator(p.pub_key(), power) for p in privs]
+    gen = GenesisDoc(chain_id=chain_id, validators=vals)
+    pvs = []
+    for p in privs:
+        d = tempfile.mkdtemp(prefix="pv_")
+        pv = FilePV(
+            p, os.path.join(d, "key.json"), os.path.join(d, "state.json")
+        )
+        pv.save_key()
+        pv.save_state()
+        pvs.append(pv)
+    # order pvs to match sorted validator order for convenience
+    vs = gen.validator_set()
+    order = {v.address: i for i, v in enumerate(vs.validators)}
+    pvs.sort(key=lambda pv: order[pv.pub_key().address()])
+    return gen, pvs
+
+
+class LocalNet:
+    """Fully-connected in-memory delivery between consensus states."""
+
+    def __init__(self, nodes: List[NodeParts], drop: Optional[Callable] = None):
+        self.nodes = nodes
+        self.drop = drop  # (src_idx, dst_idx, kind, payload) -> bool
+        for i, n in enumerate(nodes):
+            n.cs.add_broadcast_hook(self._make_hook(i))
+
+    def _make_hook(self, src: int):
+        def hook(kind, payload):
+            for j, other in enumerate(self.nodes):
+                if j == src:
+                    continue
+                if self.drop and self.drop(src, j, kind, payload):
+                    continue
+                try:
+                    other.cs.enqueue_nowait(kind, payload, f"node{src}")
+                except asyncio.QueueFull:
+                    pass
+
+        return hook
+
+    async def start(self):
+        for n in self.nodes:
+            await n.cs.start()
+
+    async def stop(self):
+        for n in self.nodes:
+            await n.cs.stop()
+
+    async def wait_for_height(self, height: int, timeout: float = 30.0):
+        async def waiter():
+            while True:
+                if all(
+                    n.block_store.height() >= height for n in self.nodes
+                ):
+                    return
+                await asyncio.sleep(0.02)
+
+        await asyncio.wait_for(waiter(), timeout)

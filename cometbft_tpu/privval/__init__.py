@@ -1,0 +1,1 @@
+from .file_pv import FilePV, DoubleSignError  # noqa: F401

@@ -1,0 +1,309 @@
+"""BlockExecutor: create/validate/execute blocks against the ABCI app.
+
+Parity with reference state/execution.go: CreateProposalBlock (:114),
+ProcessProposal (:177), ValidateBlock (:205) with the fork's
+last-validated-block cache + block-time tolerance (:44-52,:261-274),
+ApplyBlock / ApplyVerifiedBlock (:258,:246), Commit + mempool update
+(:446-509), updateState (:694), fireEvents (:766).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import List, Optional, Tuple
+
+from .. import types as T
+from ..abci import types as abci
+from ..crypto import merkle
+from ..types import events as ev
+from ..utils import codec, proto
+from .state_types import BLOCK_VERSION, State
+from .validation import validate_block
+
+# fork feature: tolerate proposer clocks slightly ahead (execution.go:44)
+DEFAULT_BLOCK_TIME_TOLERANCE_NS = 5_000_000_000
+
+
+def results_hash(tx_results: List[abci.ExecTxResult]) -> bytes:
+    return merkle.hash_from_byte_slices([r.encode() for r in tx_results])
+
+
+def encode_finalize_response(resp: abci.ResponseFinalizeBlock) -> bytes:
+    out = b""
+    for r in resp.tx_results:
+        out += proto.field_message(1, r.encode())
+    for vu in resp.validator_updates:
+        out += proto.field_message(
+            2,
+            proto.field_string(1, vu.pub_key_type)
+            + proto.field_bytes(2, vu.pub_key_bytes)
+            + proto.field_varint(3, vu.power),
+        )
+    out += proto.field_bytes(3, resp.app_hash)
+    return out
+
+
+def decode_finalize_response(b: bytes) -> abci.ResponseFinalizeBlock:
+    m = proto.parse(b)
+    txrs = []
+    for rb in m.get(1, []):
+        rm = proto.parse(rb)
+        txrs.append(
+            abci.ExecTxResult(
+                code=proto.get1(rm, 1, 0),
+                data=proto.get1(rm, 2, b""),
+                gas_wanted=proto.get1(rm, 5, 0),
+                gas_used=proto.get1(rm, 6, 0),
+                codespace=proto.get1(rm, 8, b"").decode() if proto.get1(rm, 8) else "",
+            )
+        )
+    vus = []
+    for vb in m.get(2, []):
+        vm = proto.parse(vb)
+        vus.append(
+            abci.ValidatorUpdate(
+                pub_key_type=proto.get1(vm, 1, b"").decode(),
+                pub_key_bytes=proto.get1(vm, 2, b""),
+                power=proto.get1(vm, 3, 0),
+            )
+        )
+    return abci.ResponseFinalizeBlock(
+        tx_results=txrs,
+        validator_updates=vus,
+        app_hash=proto.get1(m, 3, b""),
+    )
+
+
+class BlockExecutor:
+    def __init__(
+        self,
+        state_store,
+        proxy_consensus,
+        mempool,
+        evidence_pool=None,
+        event_bus: Optional[ev.EventBus] = None,
+        block_store=None,
+        signature_cache: Optional[T.SignatureCache] = None,
+        block_time_tolerance_ns: int = DEFAULT_BLOCK_TIME_TOLERANCE_NS,
+    ):
+        self.store = state_store
+        self.proxy = proxy_consensus
+        self.mempool = mempool
+        self.evpool = evidence_pool
+        self.event_bus = event_bus
+        self.block_store = block_store
+        self.sig_cache = signature_cache or T.SignatureCache()
+        self.tolerance_ns = block_time_tolerance_ns
+        # fork feature: skip re-validating a block we already validated
+        self._last_validated: Optional[bytes] = None
+
+    # --- proposal creation (reference :114) ---------------------------
+
+    def create_proposal_block(
+        self,
+        height: int,
+        state: State,
+        last_commit: Optional[T.Commit],
+        proposer_addr: bytes,
+        time_ns: Optional[int] = None,
+    ) -> Tuple[T.Block, T.PartSet]:
+        max_bytes = state.consensus_params.block.max_bytes
+        max_gas = state.consensus_params.block.max_gas
+        evidence = (
+            self.evpool.pending_evidence(
+                state.consensus_params.evidence.max_bytes
+            )
+            if self.evpool
+            else []
+        )
+        txs = self.mempool.reap_max_bytes_max_gas(
+            max_bytes - 2048, max_gas
+        )
+        t = time_ns or time.time_ns()
+        req = abci.RequestPrepareProposal(
+            max_tx_bytes=max_bytes - 2048,
+            txs=txs,
+            height=height,
+            time_ns=t,
+            next_validators_hash=state.next_validators.hash(),
+            proposer_address=proposer_addr,
+        )
+        resp = self.proxy.prepare_proposal(req)
+        block = self._make_block(
+            height, state, resp.txs, last_commit, evidence, proposer_addr, t
+        )
+        ps = T.PartSet.from_data(codec.encode_block(block))
+        return block, ps
+
+    def _make_block(
+        self, height, state, txs, last_commit, evidence, proposer_addr, t
+    ) -> T.Block:
+        data = T.Data(txs=list(txs))
+        ev_hash = merkle.hash_from_byte_slices([e.hash() for e in evidence])
+        header = T.Header(
+            version_block=BLOCK_VERSION,
+            chain_id=state.chain_id,
+            height=height,
+            time_ns=t,
+            last_block_id=state.last_block_id,
+            last_commit_hash=last_commit.hash() if last_commit else b"",
+            data_hash=data.hash(),
+            validators_hash=state.validators.hash(),
+            next_validators_hash=state.next_validators.hash(),
+            consensus_hash=state.consensus_params.hash(),
+            app_hash=state.app_hash,
+            last_results_hash=state.last_results_hash,
+            evidence_hash=ev_hash,
+            proposer_address=proposer_addr,
+        )
+        return T.Block(
+            header=header, data=data, evidence=evidence, last_commit=last_commit
+        )
+
+    # --- proposal processing (reference :177) -------------------------
+
+    def process_proposal(self, block: T.Block, state: State) -> bool:
+        req = abci.RequestProcessProposal(
+            txs=block.data.txs,
+            hash=block.hash(),
+            height=block.height,
+            time_ns=block.header.time_ns,
+            next_validators_hash=block.header.next_validators_hash,
+            proposer_address=block.header.proposer_address,
+        )
+        return self.proxy.process_proposal(req).is_accepted()
+
+    # --- validation (reference :205) ----------------------------------
+
+    def validate_block(self, state: State, block: T.Block) -> None:
+        bh = block.hash()
+        if self._last_validated == bh:
+            return  # fork: last-validated-block cache (execution.go:261)
+        validate_block(state, block, cache=self.sig_cache)
+        # block-time tolerance: reject blocks too far in the future
+        if block.header.time_ns > time.time_ns() + self.tolerance_ns:
+            raise ValueError("block timestamp too far in the future")
+        self._last_validated = bh
+
+    # --- execution (reference :258-446) -------------------------------
+
+    def apply_block(
+        self, state: State, block_id: T.BlockID, block: T.Block,
+        verified: bool = False,
+    ) -> State:
+        if not verified:
+            self.validate_block(state, block)
+        req = abci.RequestFinalizeBlock(
+            txs=block.data.txs,
+            hash=block.hash(),
+            height=block.height,
+            time_ns=block.header.time_ns,
+            next_validators_hash=block.header.next_validators_hash,
+            proposer_address=block.header.proposer_address,
+        )
+        resp = self.proxy.finalize_block(req)
+        if len(resp.tx_results) != len(block.data.txs):
+            raise RuntimeError("app returned wrong number of tx results")
+        self.store.save_finalize_block_response(
+            block.height, encode_finalize_response(resp)
+        )
+        new_state = self._update_state(state, block_id, block, resp)
+        self._commit(new_state, block, resp)
+        if self.evpool:
+            self.evpool.update(new_state, block.evidence)
+        self._prune(new_state)
+        self._fire_events(block, block_id, resp)
+        return new_state
+
+    def apply_verified_block(
+        self, state: State, block_id: T.BlockID, block: T.Block
+    ) -> State:
+        """Skip validation: commit already verified (blocksync/ingest,
+        reference :246)."""
+        return self.apply_block(state, block_id, block, verified=True)
+
+    def _commit(self, state: State, block: T.Block, resp) -> None:
+        self.mempool.lock()
+        try:
+            cres = self.proxy.commit()
+            self.mempool.update(
+                block.height, block.data.txs, resp.tx_results
+            )
+            self._retain_height = getattr(cres, "retain_height", 0)
+        finally:
+            self.mempool.unlock()
+
+    def _prune(self, state: State) -> None:
+        rh = getattr(self, "_retain_height", 0)
+        if rh and self.block_store is not None:
+            try:
+                self.block_store.prune_blocks(rh)
+                self.store.prune_states(rh)
+            except Exception:
+                pass
+
+    def _update_state(
+        self, state: State, block_id: T.BlockID, block: T.Block, resp
+    ) -> State:
+        nvals = state.next_validators.copy()
+        changed = state.last_height_validators_changed
+        if resp.validator_updates:
+            changes = []
+            from ..crypto.keys import pubkey_from_type_bytes
+
+            for vu in resp.validator_updates:
+                pk = pubkey_from_type_bytes(vu.pub_key_type, vu.pub_key_bytes)
+                changes.append(T.Validator(pk, vu.power))
+            nvals.update_with_change_set(changes)
+            changed = block.height + 1
+        nvals.increment_proposer_priority(1)
+        params = state.consensus_params
+        params_changed = state.last_height_consensus_params_changed
+        if resp.consensus_param_updates is not None:
+            params = resp.consensus_param_updates
+            params_changed = block.height + 1
+        new_state = State(
+            chain_id=state.chain_id,
+            initial_height=state.initial_height,
+            last_block_height=block.height,
+            last_block_id=block_id,
+            last_block_time_ns=block.header.time_ns,
+            validators=state.next_validators.copy(),
+            next_validators=nvals,
+            last_validators=state.validators.copy(),
+            last_height_validators_changed=changed,
+            consensus_params=params,
+            last_height_consensus_params_changed=params_changed,
+            last_results_hash=results_hash(resp.tx_results),
+            app_hash=resp.app_hash,
+        )
+        self.store.save(new_state)
+        return new_state
+
+    def _fire_events(self, block, block_id, resp) -> None:
+        if self.event_bus is None:
+            return
+        self.event_bus.publish_type(
+            ev.EVENT_NEW_BLOCK,
+            {"block": block, "block_id": block_id},
+            height=block.height,
+        )
+        self.event_bus.publish_type(
+            ev.EVENT_NEW_BLOCK_HEADER, block.header, height=block.height
+        )
+        for i, tx in enumerate(block.data.txs):
+            self.event_bus.publish_type(
+                ev.EVENT_TX,
+                {
+                    "height": block.height,
+                    "index": i,
+                    "tx": tx,
+                    "result": resp.tx_results[i],
+                },
+                hash=hashlib.sha256(tx).hexdigest(),
+            )
+        if resp.validator_updates:
+            self.event_bus.publish_type(
+                ev.EVENT_VALIDATOR_SET_UPDATES, resp.validator_updates
+            )

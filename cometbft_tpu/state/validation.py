@@ -1,0 +1,61 @@
+"""Block validation against state (reference state/validation.go).
+
+The LastCommit signature check routes through the TPU batch verifier
+(types.verify_commit — reference state/validation.go:101-103), with the
+fork's last-validated-block cache + block-time tolerance handled by the
+executor (reference state/execution.go:44-52).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import types as T
+from .state_types import State
+
+
+def validate_block(state: State, block: T.Block, cache: Optional[T.SignatureCache] = None) -> None:
+    block.validate_basic()
+    h = block.header
+    if h.chain_id != state.chain_id:
+        raise ValueError(f"wrong chain id {h.chain_id}")
+    if h.height != state.last_block_height + 1:
+        raise ValueError(
+            f"wrong height {h.height}, expected {state.last_block_height + 1}"
+        )
+    if h.last_block_id.key() != state.last_block_id.key():
+        raise ValueError("wrong LastBlockID")
+    if h.validators_hash != state.validators.hash():
+        raise ValueError("wrong ValidatorsHash")
+    if h.next_validators_hash != state.next_validators.hash():
+        raise ValueError("wrong NextValidatorsHash")
+    if h.consensus_hash != state.consensus_params.hash():
+        raise ValueError("wrong ConsensusHash")
+    if h.app_hash != state.app_hash:
+        raise ValueError("wrong AppHash")
+    if h.last_results_hash != state.last_results_hash:
+        raise ValueError("wrong LastResultsHash")
+    if not state.validators.has_address(h.proposer_address):
+        raise ValueError("proposer not in validator set")
+
+    # LastCommit: [HOT] batch signature verification on TPU lanes
+    if h.height == state.initial_height:
+        if block.last_commit is not None and block.last_commit.size() > 0:
+            raise ValueError("initial block cannot have LastCommit")
+    else:
+        if block.last_commit is None:
+            raise ValueError("missing LastCommit")
+        if block.last_commit.size() != state.last_validators.size():
+            raise ValueError("wrong LastCommit size")
+        T.verify_commit(
+            state.chain_id,
+            state.last_validators,
+            state.last_block_id,
+            h.height - 1,
+            block.last_commit,
+            cache=cache,
+        )
+
+    # evidence
+    for ev in block.evidence:
+        ev.validate_basic()

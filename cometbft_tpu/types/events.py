@@ -1,0 +1,97 @@
+"""EventBus: typed pub/sub for consensus/tx events (reference types/event_bus.go).
+
+Subscriptions are predicate-filtered asyncio queues; synchronous
+fan-out mirrors the reference's evsw semantics for reactor-internal
+listeners (gossip wakeups must not miss events).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+EVENT_NEW_BLOCK = "NewBlock"
+EVENT_NEW_BLOCK_HEADER = "NewBlockHeader"
+EVENT_NEW_BLOCK_EVENTS = "NewBlockEvents"
+EVENT_TX = "Tx"
+EVENT_NEW_ROUND_STEP = "NewRoundStep"
+EVENT_NEW_ROUND = "NewRound"
+EVENT_COMPLETE_PROPOSAL = "CompleteProposal"
+EVENT_VOTE = "Vote"
+EVENT_POLKA = "Polka"
+EVENT_LOCK = "Lock"
+EVENT_VALIDATOR_SET_UPDATES = "ValidatorSetUpdates"
+EVENT_NEW_EVIDENCE = "NewEvidence"
+EVENT_TIMEOUT_PROPOSE = "TimeoutPropose"
+EVENT_TIMEOUT_WAIT = "TimeoutWait"
+
+
+@dataclass
+class Event:
+    type_: str
+    data: Any
+    attrs: Dict[str, str] = field(default_factory=dict)
+
+
+class Subscription:
+    def __init__(self, bus: "EventBus", match: Callable[[Event], bool]):
+        self._bus = bus
+        self._match = match
+        self.queue: "asyncio.Queue[Event]" = asyncio.Queue()
+
+    def unsubscribe(self):
+        self._bus._remove(self)
+
+
+class EventBus:
+    """Thread-safe publish; async + sync consumption."""
+
+    def __init__(self):
+        self._subs: List[Subscription] = []
+        self._sync_listeners: List[Callable[[Event], None]] = []
+        self._lock = threading.Lock()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+
+    def set_loop(self, loop: asyncio.AbstractEventLoop):
+        self._loop = loop
+
+    def subscribe(
+        self, match: Optional[Callable[[Event], bool]] = None
+    ) -> Subscription:
+        sub = Subscription(self, match or (lambda e: True))
+        with self._lock:
+            self._subs.append(sub)
+        return sub
+
+    def subscribe_type(self, type_: str) -> Subscription:
+        return self.subscribe(lambda e, t=type_: e.type_ == t)
+
+    def add_sync_listener(self, fn: Callable[[Event], None]) -> None:
+        with self._lock:
+            self._sync_listeners.append(fn)
+
+    def _remove(self, sub: Subscription):
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+
+    def publish(self, event: Event) -> None:
+        with self._lock:
+            subs = list(self._subs)
+            listeners = list(self._sync_listeners)
+        for fn in listeners:
+            fn(event)
+        for sub in subs:
+            if sub._match(event):
+                if self._loop is not None and not self._loop.is_closed():
+                    self._loop.call_soon_threadsafe(
+                        sub.queue.put_nowait, event
+                    )
+                else:
+                    sub.queue.put_nowait(event)
+
+    # convenience publishers (reference event_bus.go PublishEventX)
+    def publish_type(self, type_: str, data: Any, **attrs) -> None:
+        self.publish(Event(type_, data, {k: str(v) for k, v in attrs.items()}))
