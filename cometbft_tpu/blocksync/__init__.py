@@ -1,0 +1,2 @@
+from .pool import BlockPool, PeerError  # noqa: F401
+from .reactor import BlockSyncReactor  # noqa: F401

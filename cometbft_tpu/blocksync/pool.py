@@ -1,0 +1,196 @@
+"""Block pool: pipelined block download from peers (reference blocksync/pool.go).
+
+Requesters fetch a sliding window of heights concurrently; blocks are
+handed to the verify loop strictly in order. Peer quality feedback:
+timeouts and bad blocks ban the peer (fork feature: banned peers +
+adaptive peer sorting, reference blocksync/pool.go:79-84,504-522);
+faster peers get picked first (simple EWMA latency score).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+REQUEST_TIMEOUT_S = 10.0
+MAX_PENDING = 64
+BAN_DURATION_S = 60.0
+
+
+class PeerError(Exception):
+    def __init__(self, peer_id: str, msg: str):
+        super().__init__(msg)
+        self.peer_id = peer_id
+
+
+@dataclass
+class PoolPeer:
+    peer_id: str
+    client: object  # BlockSyncPeerClient: async request_block(h)
+    base: int = 0
+    height: int = 0
+    latency_ewma: float = 1.0
+    banned_until: float = 0.0
+    pending: int = 0
+
+    def available(self, height: int, now: float) -> bool:
+        return (
+            self.banned_until <= now
+            and self.base <= height <= self.height
+        )
+
+
+class BlockPool:
+    """Downloads [start_height ..] keeping MAX_PENDING in flight."""
+
+    def __init__(self, start_height: int):
+        self.start_height = start_height
+        self.height = start_height  # next height to hand to verify loop
+        self.peers: Dict[str, PoolPeer] = {}
+        self.blocks: Dict[int, Tuple[object, str]] = {}  # h -> (block, peer)
+        self._tasks: Dict[int, asyncio.Task] = {}
+        self._new_block = asyncio.Event()
+        self._stopped = False
+
+    # --- peers --------------------------------------------------------
+
+    def set_peer_range(self, peer_id: str, client, base: int, height: int):
+        p = self.peers.get(peer_id)
+        if p is None:
+            self.peers[peer_id] = PoolPeer(
+                peer_id, client, base=base, height=height
+            )
+        else:
+            p.base, p.height = base, height
+
+    def remove_peer(self, peer_id: str) -> None:
+        self.peers.pop(peer_id, None)
+        for h, (blk, pid) in list(self.blocks.items()):
+            if pid == peer_id and h >= self.height:
+                del self.blocks[h]
+                self._maybe_spawn(h)
+
+    def ban_peer(self, peer_id: str, reason: str = "") -> None:
+        p = self.peers.get(peer_id)
+        if p:
+            p.banned_until = time.monotonic() + BAN_DURATION_S
+
+    def max_peer_height(self) -> int:
+        return max((p.height for p in self.peers.values()), default=0)
+
+    def _pick_peer(self, height: int) -> Optional[PoolPeer]:
+        now = time.monotonic()
+        candidates = [
+            p for p in self.peers.values() if p.available(height, now)
+        ]
+        if not candidates:
+            return None
+        # adaptive sorting: prefer low latency, few pending requests
+        candidates.sort(
+            key=lambda p: (p.pending, p.latency_ewma, random.random())
+        )
+        return candidates[0]
+
+    # --- requesters ---------------------------------------------------
+
+    def start_requesters(self) -> None:
+        top = min(
+            self.height + MAX_PENDING - 1, self.max_peer_height()
+        )
+        for h in range(self.height, top + 1):
+            self._maybe_spawn(h)
+
+    def _maybe_spawn(self, height: int) -> None:
+        if (
+            self._stopped
+            or height in self.blocks
+            or height in self._tasks
+            or height < self.height
+            or height > self.max_peer_height()
+            or height >= self.height + MAX_PENDING
+        ):
+            return
+        self._tasks[height] = asyncio.create_task(self._fetch(height))
+
+    async def _fetch(self, height: int) -> None:
+        while not self._stopped:
+            peer = self._pick_peer(height)
+            if peer is None:
+                await asyncio.sleep(0.05)
+                continue
+            peer.pending += 1
+            t0 = time.monotonic()
+            try:
+                block = await asyncio.wait_for(
+                    peer.client.request_block(height), REQUEST_TIMEOUT_S
+                )
+                dt = time.monotonic() - t0
+                peer.latency_ewma = 0.8 * peer.latency_ewma + 0.2 * dt
+                if block is None:
+                    raise PeerError(peer.peer_id, f"no block {height}")
+                self.blocks[height] = (block, peer.peer_id)
+                self._tasks.pop(height, None)
+                self._new_block.set()
+                return
+            except (asyncio.TimeoutError, PeerError):
+                self.ban_peer(peer.peer_id)
+            finally:
+                peer.pending -= 1
+
+    # --- ordered consumption ------------------------------------------
+
+    def peek_two_blocks(self):
+        """(first, second, first_peer): blocks at pool.height and +1."""
+        f = self.blocks.get(self.height)
+        s = self.blocks.get(self.height + 1)
+        return (
+            f[0] if f else None,
+            s[0] if s else None,
+            f[1] if f else None,
+        )
+
+    def peek_window(self, n: int) -> List[Tuple[int, object, str]]:
+        """Contiguous run of up to n+1 buffered blocks from pool.height
+        (for coalesced commit verification across heights)."""
+        out = []
+        h = self.height
+        while len(out) <= n and h in self.blocks:
+            blk, pid = self.blocks[h]
+            out.append((h, blk, pid))
+            h += 1
+        return out
+
+    def pop_request(self) -> None:
+        self.blocks.pop(self.height, None)
+        self.height += 1
+        self.start_requesters()
+
+    def redo_request(self, height: int, ban_peer: Optional[str]) -> None:
+        """Invalid block: drop buffered blocks from this peer + refetch."""
+        if ban_peer:
+            self.ban_peer(ban_peer, "bad block")
+        for h, (blk, pid) in list(self.blocks.items()):
+            if pid == ban_peer and h >= self.height:
+                del self.blocks[h]
+        for h in range(self.height, self.height + MAX_PENDING):
+            self._maybe_spawn(h)
+
+    def is_caught_up(self) -> bool:
+        mx = self.max_peer_height()
+        return bool(self.peers) and (mx == 0 or self.height >= mx)
+
+    async def wait_for_block(self, timeout: float = 0.2) -> None:
+        try:
+            await asyncio.wait_for(self._new_block.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+        self._new_block.clear()
+
+    def stop(self) -> None:
+        self._stopped = True
+        for t in self._tasks.values():
+            t.cancel()
+        self._tasks.clear()

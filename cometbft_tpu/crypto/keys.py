@@ -68,8 +68,27 @@ class Ed25519PubKey(PubKey):
         return ED25519_KEY_TYPE
 
     def verify(self, msg: bytes, sig: bytes) -> bool:
+        """ZIP-215 verification.
+
+        Fast path: OpenSSL (accepts a strict subset of ZIP-215 — every
+        honestly-generated signature). Only if OpenSSL rejects do we run
+        the liberal pure-python cofactored check, so non-canonical /
+        small-order edge cases still validate exactly like the TPU
+        kernel and the reference's curve25519-voi."""
         if len(self.key_bytes) != 32 or len(sig) != 64:
             return False
+        if _HAVE_OSSL:
+            try:
+                from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+                    Ed25519PublicKey,
+                )
+
+                Ed25519PublicKey.from_public_bytes(self.key_bytes).verify(
+                    sig, msg
+                )
+                return True
+            except Exception:
+                pass  # fall through to the liberal ZIP-215 check
         return _ref.verify_zip215(self.key_bytes, msg, sig)
 
 

@@ -1,0 +1,5 @@
+from .client import Client, TrustOptions, SEQUENTIAL, SKIPPING  # noqa: F401
+from .provider import Provider, StoreBackedProvider  # noqa: F401
+from .store import LightStore  # noqa: F401
+from .types import LightBlock  # noqa: F401
+from . import verifier  # noqa: F401

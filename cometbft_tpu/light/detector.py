@@ -1,0 +1,49 @@
+"""Divergence detection against witness providers (reference light/detector.go).
+
+After verifying a header from the primary, compare it against every
+witness at the same height. A mismatching witness either proves a
+light-client attack (evidence is built and reported to all providers)
+or is itself lying (dropped by the caller's policy).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+from ..evidence.types import LightClientAttackEvidence
+from .provider import ProviderError
+from .types import LightBlock
+
+
+class DivergenceError(Exception):
+    def __init__(self, witness_idx: int, evidence):
+        super().__init__(f"witness {witness_idx} diverged")
+        self.witness_idx = witness_idx
+        self.evidence = evidence
+
+
+def check_against_witnesses(client, verified: LightBlock) -> None:
+    bad: List[int] = []
+    for i, w in enumerate(client.witnesses):
+        try:
+            wlb = w.light_block(verified.height)
+        except ProviderError:
+            continue
+        if wlb.hash() == verified.hash():
+            continue
+        # divergence: build LCA evidence from the witness's block against
+        # our last trusted common header
+        common = client.store.latest_before(verified.height)
+        ev = LightClientAttackEvidence(
+            conflicting_block=wlb,
+            common_height=common.height if common else verified.height - 1,
+            total_voting_power=verified.validator_set.total_voting_power(),
+            timestamp_ns=time.time_ns(),
+        )
+        for p in [client.primary] + list(client.witnesses):
+            try:
+                p.report_evidence(ev)
+            except Exception:
+                pass
+        raise DivergenceError(i, ev)

@@ -1,0 +1,41 @@
+"""Trusted light block store (reference light/store/db)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .types import LightBlock
+
+
+class LightStore:
+    def __init__(self):
+        self._by_height: Dict[int, LightBlock] = {}
+
+    def save(self, lb: LightBlock) -> None:
+        self._by_height[lb.height] = lb
+
+    def get(self, height: int) -> Optional[LightBlock]:
+        return self._by_height.get(height)
+
+    def latest(self) -> Optional[LightBlock]:
+        if not self._by_height:
+            return None
+        return self._by_height[max(self._by_height)]
+
+    def latest_before(self, height: int) -> Optional[LightBlock]:
+        hs = [h for h in self._by_height if h < height]
+        return self._by_height[max(hs)] if hs else None
+
+    def lowest(self) -> Optional[LightBlock]:
+        if not self._by_height:
+            return None
+        return self._by_height[min(self._by_height)]
+
+    def prune(self, keep: int) -> None:
+        if len(self._by_height) <= keep:
+            return
+        for h in sorted(self._by_height)[:-keep]:
+            del self._by_height[h]
+
+    def __len__(self) -> int:
+        return len(self._by_height)

@@ -1,0 +1,126 @@
+"""Light client verification (reference light/verifier.go).
+
+- VerifyAdjacent (:92): next header's valset hash must match trusted
+  next-valset; verify commit with the new valset (2/3).
+- VerifyNonAdjacent (:30): trusted valset must have signed with
+  > trust-level (default 1/3) power (VerifyCommitLightTrusting), then
+  the new valset with 2/3 (VerifyCommitLight).
+
+Both route through the TPU lane batch + SignatureCache (:57,:72 — the
+cache dedups overlapping valsets across bisection hops).
+"""
+
+from __future__ import annotations
+
+import time
+from fractions import Fraction
+from typing import Optional
+
+from .. import types as T
+from .types import LightBlock
+
+DEFAULT_TRUST_LEVEL = Fraction(1, 3)
+
+
+class LightClientError(Exception):
+    pass
+
+
+class ErrOldHeaderExpired(LightClientError):
+    pass
+
+
+class ErrNewValSetCantBeTrusted(LightClientError):
+    pass
+
+
+class ErrInvalidHeader(LightClientError):
+    pass
+
+
+def _header_expired(h, trusting_period_ns: int, now_ns: int) -> bool:
+    return h.time_ns + trusting_period_ns <= now_ns
+
+
+def verify_adjacent(
+    chain_id: str,
+    trusted: LightBlock,
+    untrusted: LightBlock,
+    untrusted_vals: T.ValidatorSet,
+    trusting_period_ns: int,
+    now_ns: Optional[int] = None,
+    max_clock_drift_ns: int = 10 * 10**9,
+    cache: Optional[T.SignatureCache] = None,
+) -> None:
+    now_ns = now_ns or time.time_ns()
+    if untrusted.height != trusted.height + 1:
+        raise ErrInvalidHeader("headers must be adjacent")
+    if _header_expired(trusted.header, trusting_period_ns, now_ns):
+        raise ErrOldHeaderExpired("trusted header expired")
+    _verify_new_header(
+        chain_id, trusted, untrusted, now_ns, max_clock_drift_ns
+    )
+    if untrusted.header.validators_hash != trusted.header.next_validators_hash:
+        raise ErrInvalidHeader(
+            "untrusted validators hash != trusted next validators hash"
+        )
+    T.verify_commit_light(
+        chain_id,
+        untrusted_vals,
+        untrusted.commit.block_id,
+        untrusted.height,
+        untrusted.commit,
+        cache=cache,
+    )
+
+
+def verify_non_adjacent(
+    chain_id: str,
+    trusted: LightBlock,
+    trusted_next_vals: T.ValidatorSet,
+    untrusted: LightBlock,
+    untrusted_vals: T.ValidatorSet,
+    trusting_period_ns: int,
+    now_ns: Optional[int] = None,
+    max_clock_drift_ns: int = 10 * 10**9,
+    trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+    cache: Optional[T.SignatureCache] = None,
+) -> None:
+    now_ns = now_ns or time.time_ns()
+    if untrusted.height == trusted.height + 1:
+        raise ErrInvalidHeader("use verify_adjacent for adjacent headers")
+    if _header_expired(trusted.header, trusting_period_ns, now_ns):
+        raise ErrOldHeaderExpired("trusted header expired")
+    _verify_new_header(
+        chain_id, trusted, untrusted, now_ns, max_clock_drift_ns
+    )
+    try:
+        T.verify_commit_light_trusting(
+            chain_id,
+            trusted_next_vals,
+            untrusted.commit,
+            trust_level=trust_level,
+            cache=cache,
+        )
+    except T.ErrNotEnoughVotingPower as e:
+        raise ErrNewValSetCantBeTrusted(str(e))
+    T.verify_commit_light(
+        chain_id,
+        untrusted_vals,
+        untrusted.commit.block_id,
+        untrusted.height,
+        untrusted.commit,
+        cache=cache,
+    )
+
+
+def _verify_new_header(
+    chain_id, trusted, untrusted, now_ns, max_clock_drift_ns
+) -> None:
+    untrusted.validate_basic(chain_id)
+    if untrusted.height <= trusted.height:
+        raise ErrInvalidHeader("untrusted height <= trusted height")
+    if untrusted.header.time_ns <= trusted.header.time_ns:
+        raise ErrInvalidHeader("untrusted time <= trusted time")
+    if untrusted.header.time_ns >= now_ns + max_clock_drift_ns:
+        raise ErrInvalidHeader("untrusted header from the future")

@@ -176,11 +176,16 @@ class BlockExecutor:
 
     # --- validation (reference :205) ----------------------------------
 
-    def validate_block(self, state: State, block: T.Block) -> None:
+    def validate_block(
+        self, state: State, block: T.Block, skip_commit_check: bool = False
+    ) -> None:
         bh = block.hash()
         if self._last_validated == bh:
             return  # fork: last-validated-block cache (execution.go:261)
-        validate_block(state, block, cache=self.sig_cache)
+        validate_block(
+            state, block, cache=self.sig_cache,
+            skip_commit_check=skip_commit_check,
+        )
         # block-time tolerance: reject blocks too far in the future
         if block.header.time_ns > time.time_ns() + self.tolerance_ns:
             raise ValueError("block timestamp too far in the future")

@@ -14,7 +14,14 @@ from .. import types as T
 from .state_types import State
 
 
-def validate_block(state: State, block: T.Block, cache: Optional[T.SignatureCache] = None) -> None:
+def validate_block(
+    state: State,
+    block: T.Block,
+    cache: Optional[T.SignatureCache] = None,
+    skip_commit_check: bool = False,
+) -> None:
+    """skip_commit_check: blocksync verified LastCommit already via the
+    coalesced batch path (reference blocksync SkipLastCommit flag)."""
     block.validate_basic()
     h = block.header
     if h.chain_id != state.chain_id:
@@ -47,14 +54,15 @@ def validate_block(state: State, block: T.Block, cache: Optional[T.SignatureCach
             raise ValueError("missing LastCommit")
         if block.last_commit.size() != state.last_validators.size():
             raise ValueError("wrong LastCommit size")
-        T.verify_commit(
-            state.chain_id,
-            state.last_validators,
-            state.last_block_id,
-            h.height - 1,
-            block.last_commit,
-            cache=cache,
-        )
+        if not skip_commit_check:
+            T.verify_commit(
+                state.chain_id,
+                state.last_validators,
+                state.last_block_id,
+                h.height - 1,
+                block.last_commit,
+                cache=cache,
+            )
 
     # evidence
     for ev in block.evidence:

@@ -174,6 +174,80 @@ def verify_commit_light(
         )
 
 
+def verify_commits_coalesced(
+    chain_id: str,
+    jobs,
+    cache: Optional[SignatureCache] = None,
+    light: bool = True,
+) -> list:
+    """Verify MANY commits in one TPU dispatch (cross-height coalescing).
+
+    jobs: list of (vals, block_id, height, commit). Returns a list of
+    None (success) or CommitVerifyError per job. This is the bulk seam
+    the reference cannot express: its batch verifier is per-commit
+    (types/validation.go:261); here blocksync/light coalesce whole
+    windows of heights into one signature-lane batch (BASELINE.json
+    north star: amortize thousands of validator sigs per XLA dispatch).
+    """
+    items = []         # global lane batch
+    job_lanes = []     # per job: list of (lane_idx, val_idx)
+    errors: list = [None] * len(jobs)
+    for j, (vals, block_id, height, commit) in enumerate(jobs):
+        lanes = []
+        try:
+            _basic_checks(vals, commit, height, block_id)
+            total = vals.total_voting_power()
+            tallied_known = 0
+            for i, cs in enumerate(commit.signatures):
+                want = cs.for_block() if light else not cs.is_absent()
+                if not want:
+                    continue
+                val = vals.get_by_index(i)
+                if val.address != cs.validator_address:
+                    raise CommitVerifyError(
+                        f"commit sig {i} address mismatch"
+                    )
+                lanes.append((len(items), i))
+                items.append(
+                    (
+                        val.pub_key,
+                        _commit_sign_bytes(chain_id, commit, cs),
+                        cs.signature,
+                    )
+                )
+                if light and cs.for_block():
+                    tallied_known += val.voting_power
+                    if tallied_known * 3 > total * 2:
+                        break
+        except CommitVerifyError as e:
+            errors[j] = e
+            lanes = []
+        job_lanes.append(lanes)
+
+    oks = _run_batch(items, cache)
+
+    for j, (vals, block_id, height, commit) in enumerate(jobs):
+        if errors[j] is not None:
+            continue
+        tallied = 0
+        bad = None
+        for lane, i in job_lanes[j]:
+            if not oks[lane]:
+                bad = ErrInvalidSignature(
+                    f"invalid signature for validator {i} at height {height}"
+                )
+                break
+            if commit.signatures[i].for_block():
+                tallied += vals.get_by_index(i).voting_power
+        if bad is not None:
+            errors[j] = bad
+        elif not tallied * 3 > vals.total_voting_power() * 2:
+            errors[j] = ErrNotEnoughVotingPower(
+                f"height {height}: tallied {tallied} <= 2/3"
+            )
+    return errors
+
+
 def verify_commit_light_trusting(
     chain_id: str,
     vals: ValidatorSet,

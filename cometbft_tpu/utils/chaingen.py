@@ -1,0 +1,117 @@
+"""Chain generator: build a valid chain directly (no consensus rounds).
+
+The reference generates test chains by running real consensus
+(consensus/wal_generator.go) — fine for 10 blocks, hopeless for the
+north-star 10k-block replay corpus. This builder signs real commits
+with the validators' keys and applies blocks through the real
+BlockExecutor, so the product is byte-for-byte a valid chain: every
+sync path (blocksync, light, statesync, handshake replay) can be
+exercised against it at scale.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from .. import types as T
+from ..node.inprocess import NodeParts, build_node
+from ..types.genesis import GenesisDoc
+
+
+def make_chain(
+    genesis: GenesisDoc,
+    privs,
+    n_blocks: int,
+    txs_per_block: int = 1,
+    node: Optional[NodeParts] = None,
+) -> NodeParts:
+    """Returns a NodeParts whose stores hold a `n_blocks`-high chain."""
+    node = node or build_node(genesis, None)
+    state = node.state_store.load()
+    chain_id = state.chain_id
+    t = state.last_block_time_ns or time.time_ns()
+    addr_to_priv = {p.pub_key().address(): p for p in privs}
+
+    for h in range(
+        state.last_block_height + 1, state.last_block_height + 1 + n_blocks
+    ):
+        proposer = state.validators.get_proposer()
+        last_commit = (
+            node.block_store.load_seen_commit(h - 1)
+            if h > state.initial_height
+            else None
+        )
+        for i in range(txs_per_block):
+            node.mempool.check_tx(b"h%d_%d=v%d" % (h, i, h))
+        t += 1_000_000_000
+        block, parts = node.block_exec.create_proposal_block(
+            h, state, last_commit, proposer.address, time_ns=t
+        )
+        bid = T.BlockID(block.hash(), parts.header)
+        # sign precommits from every validator
+        sigs = []
+        for i, val in enumerate(state.validators.validators):
+            priv = addr_to_priv[val.address]
+            vote = T.Vote(
+                type_=T.PRECOMMIT,
+                height=h,
+                round=0,
+                block_id=bid,
+                timestamp_ns=t,
+                validator_address=val.address,
+                validator_index=i,
+            )
+            vote.signature = priv.sign(vote.sign_bytes(chain_id))
+            sigs.append(
+                T.CommitSig(
+                    block_id_flag=T.BLOCK_ID_FLAG_COMMIT,
+                    validator_address=val.address,
+                    timestamp_ns=t,
+                    signature=vote.signature,
+                )
+            )
+        commit = T.Commit(height=h, round=0, block_id=bid, signatures=sigs)
+        node.block_store.save_block(block, parts, commit)
+        state = node.block_exec.apply_verified_block(state, bid, block)
+    node.state = state
+    return node
+
+
+class StorePeerClient:
+    """Blocksync peer client serving blocks from a node's store
+    (the in-memory stand-in for a network peer)."""
+
+    def __init__(self, node: NodeParts, delay_s: float = 0.0):
+        self.node = node
+        self.delay_s = delay_s
+
+    @property
+    def base(self) -> int:
+        return self.node.block_store.base()
+
+    @property
+    def height(self) -> int:
+        return self.node.block_store.height()
+
+    async def request_block(self, height: int):
+        if self.delay_s:
+            import asyncio
+
+            await asyncio.sleep(self.delay_s)
+        return self.node.block_store.load_block(height)
+
+
+class TamperingPeerClient(StorePeerClient):
+    """Serves a corrupted block at one height (bad-peer testing)."""
+
+    def __init__(self, node, bad_height: int):
+        super().__init__(node)
+        self.bad_height = bad_height
+
+    async def request_block(self, height: int):
+        blk = await super().request_block(height)
+        if blk is not None and height == self.bad_height:
+            blk.data.txs = list(blk.data.txs) + [b"evil=1"]
+            blk.data._hash = None
+        return blk

@@ -13,3 +13,12 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# This box has ONE cpu core: XLA-compiling the full verify kernel takes
+# minutes, so framework tests route signature batches to the host
+# verifier (identical dispatch/coalescing code, different backend). The
+# kernel itself is covered by the differential tests in
+# test_ed25519_verify.py, which budget for the compile.
+from cometbft_tpu.crypto import batch as _batch  # noqa: E402
+
+_batch.set_default_backend("cpu")
