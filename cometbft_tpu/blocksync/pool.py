@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import random
 import time
+import traceback
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -116,41 +117,40 @@ class BlockPool:
         self._tasks[height] = asyncio.create_task(self._fetch(height))
 
     async def _fetch(self, height: int) -> None:
-        while not self._stopped:
-            peer = self._pick_peer(height)
-            if peer is None:
-                await asyncio.sleep(0.05)
-                continue
-            peer.pending += 1
-            t0 = time.monotonic()
-            try:
-                block = await asyncio.wait_for(
-                    peer.client.request_block(height), REQUEST_TIMEOUT_S
-                )
-                dt = time.monotonic() - t0
-                peer.latency_ewma = 0.8 * peer.latency_ewma + 0.2 * dt
-                if block is None:
-                    raise PeerError(peer.peer_id, f"no block {height}")
-                self.blocks[height] = (block, peer.peer_id)
+        try:
+            while not self._stopped:
+                peer = self._pick_peer(height)
+                if peer is None:
+                    await asyncio.sleep(0.05)
+                    continue
+                peer.pending += 1
+                t0 = time.monotonic()
+                try:
+                    block = await asyncio.wait_for(
+                        peer.client.request_block(height), REQUEST_TIMEOUT_S
+                    )
+                    dt = time.monotonic() - t0
+                    peer.latency_ewma = 0.8 * peer.latency_ewma + 0.2 * dt
+                    if block is None:
+                        raise PeerError(peer.peer_id, f"no block {height}")
+                    self.blocks[height] = (block, peer.peer_id)
+                    self._new_block.set()
+                    return
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    # any client failure (timeout, missing block, broken
+                    # transport) bans the peer and retries elsewhere;
+                    # the requester itself must never die silently
+                    traceback.print_exc()
+                    self.ban_peer(peer.peer_id)
+                finally:
+                    peer.pending -= 1
+        finally:
+            if self._tasks.get(height) is asyncio.current_task():
                 self._tasks.pop(height, None)
-                self._new_block.set()
-                return
-            except (asyncio.TimeoutError, PeerError):
-                self.ban_peer(peer.peer_id)
-            finally:
-                peer.pending -= 1
 
     # --- ordered consumption ------------------------------------------
-
-    def peek_two_blocks(self):
-        """(first, second, first_peer): blocks at pool.height and +1."""
-        f = self.blocks.get(self.height)
-        s = self.blocks.get(self.height + 1)
-        return (
-            f[0] if f else None,
-            s[0] if s else None,
-            f[1] if f else None,
-        )
 
     def peek_window(self, n: int) -> List[Tuple[int, object, str]]:
         """Contiguous run of up to n+1 buffered blocks from pool.height
@@ -169,9 +169,12 @@ class BlockPool:
         self.start_requesters()
 
     def redo_request(self, height: int, ban_peer: Optional[str]) -> None:
-        """Invalid block: drop buffered blocks from this peer + refetch."""
+        """Invalid block: drop it + all buffered blocks from its peer,
+        ban the peer, refetch (reference pool.go
+        RemovePeerAndRedoAllPeerRequests)."""
         if ban_peer:
             self.ban_peer(ban_peer, "bad block")
+        self.blocks.pop(height, None)
         for h, (blk, pid) in list(self.blocks.items()):
             if pid == ban_peer and h >= self.height:
                 del self.blocks[h]

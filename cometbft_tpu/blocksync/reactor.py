@@ -95,12 +95,23 @@ class BlockSyncReactor:
 
     def _process_window(self, window) -> int:
         """Verify all verifiable heights in the window with ONE batch
-        dispatch, then apply them in order. Returns #applied."""
+        dispatch, then apply them in order. Returns #applied.
+
+        The batch uses the CURRENT state's validator set, so it must
+        stop at the first height whose header advertises a different
+        validators_hash (valset change mid-window): those heights are
+        verified on a later pass once the state has advanced. The hash
+        is only used to LIMIT the batch — each block is still fully
+        validated against the locally-derived valset when applied."""
         # block at window[i] is verified by window[i+1].last_commit
+        vals_hash = self.state.validators.hash()
         jobs = []
         for i in range(len(window) - 1):
             h, blk, peer = window[i]
             _, nxt, _ = window[i + 1]
+            if blk.header.validators_hash != vals_hash:
+                window = window[: i + 1]
+                break
             bid = T.BlockID(
                 blk.hash(),
                 nxt.last_commit.block_id.part_set_header,
@@ -108,18 +119,28 @@ class BlockSyncReactor:
             jobs.append(
                 (self.state.validators, bid, h, nxt.last_commit)
             )
+        if not jobs:
+            if len(window) >= 1:
+                # head block claims a different valset than our state
+                # derives -> it cannot validate; refetch elsewhere
+                h, _, peer = window[0]
+                self.pool.redo_request(h, peer)
+            return 0
         errors = verify_commits_coalesced(
             self.state.chain_id, jobs, cache=self.sig_cache
         )
         applied = 0
-        for i in range(len(window) - 1):
+        for i, _job in enumerate(jobs):
             h, blk, peer = window[i]
             _, nxt, _ = window[i + 1]
             if errors[i] is not None:
-                # bad commit: the NEXT block's LastCommit was invalid ->
-                # ban the peer who sent block h+1 and refetch
-                bad_peer = window[i + 1][2]
-                self.pool.redo_request(h + 1, bad_peer)
+                # bad commit: could be a corrupt block h (its hash feeds
+                # the expected BlockID) OR a corrupt h+1.LastCommit ->
+                # ban BOTH senders and refetch, like the reference's
+                # handleValidationFailure (blocksync/reactor.go:749).
+                self.pool.redo_request(h, peer)
+                if window[i + 1][2] != peer:
+                    self.pool.redo_request(h + 1, window[i + 1][2])
                 break
             bid = jobs[i][1]
             try:
@@ -132,8 +153,10 @@ class BlockSyncReactor:
             parts = T.PartSet.from_data(codec.encode_block(blk))
             if self.ingestor is not None:
                 # fork: adaptive sync — pipeline the verified block
-                # straight into the consensus state machine
-                self.ingestor.ingest_verified_block(
+                # straight into the consensus state machine. The
+                # ingestor applies the block and returns the post-apply
+                # state so subsequent window validation isn't stale.
+                self.state = self.ingestor.ingest_verified_block(
                     blk, parts, nxt.last_commit
                 )
             else:

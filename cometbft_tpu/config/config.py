@@ -102,6 +102,9 @@ class ConsensusConfig:
     create_empty_blocks_interval_s: float = 0.0
     peer_gossip_sleep_s: float = 0.1
     peer_query_maj23_sleep_s: float = 2.0
+    # max allowed difference between proposed block time and wall clock
+    # (reference config/config.go:1265-1286, default 60s; 0 disables)
+    block_time_tolerance_ns: int = 60_000_000_000
 
     def propose_timeout(self, round_: int) -> float:
         return self.timeout_propose_s + self.timeout_propose_delta_s * round_

@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import os
 import tempfile
+import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
@@ -101,6 +102,7 @@ def build_node(
         evidence_pool=evpool,
         event_bus=event_bus,
         block_store=block_store,
+        block_time_tolerance_ns=config.consensus.block_time_tolerance_ns,
     )
     wal_path = None
     if wal:
@@ -138,12 +140,23 @@ def build_node(
 
 
 def make_genesis(
-    n_validators: int, chain_id: str = "test-chain", power: int = 10
+    n_validators: int,
+    chain_id: str = "test-chain",
+    power: int = 10,
+    genesis_time_ns: int = 0,
 ):
-    """Returns (GenesisDoc, [FilePV-like in-memory signers])."""
+    """Returns (GenesisDoc, [FilePV-like in-memory signers]).
+
+    Genesis is backdated 1h by default so chains generated forward from
+    it (1s per block) stay in the past for wall-clock checks (block-time
+    tolerance, light-client drift)."""
     privs = [Ed25519PrivKey.generate() for _ in range(n_validators)]
     vals = [T.Validator(p.pub_key(), power) for p in privs]
-    gen = GenesisDoc(chain_id=chain_id, validators=vals)
+    gen = GenesisDoc(
+        chain_id=chain_id,
+        validators=vals,
+        genesis_time_ns=genesis_time_ns or time.time_ns() - 3_600_000_000_000,
+    )
     pvs = []
     for p in privs:
         d = tempfile.mkdtemp(prefix="pv_")

@@ -22,7 +22,10 @@ from .state_types import BLOCK_VERSION, State
 from .validation import validate_block
 
 # fork feature: tolerate proposer clocks slightly ahead (execution.go:44)
-DEFAULT_BLOCK_TIME_TOLERANCE_NS = 5_000_000_000
+# Opt-in like the reference (state/validation.go:124 checks only tol > 0):
+# 0 disables the wall-clock check so historical catch-up (blocksync /
+# replay) is never rejected for "future" timestamps.
+DEFAULT_BLOCK_TIME_TOLERANCE_NS = 0
 
 
 def results_hash(tx_results: List[abci.ExecTxResult]) -> bytes:
@@ -187,7 +190,11 @@ class BlockExecutor:
             skip_commit_check=skip_commit_check,
         )
         # block-time tolerance: reject blocks too far in the future
-        if block.header.time_ns > time.time_ns() + self.tolerance_ns:
+        # (only when enabled, reference state/validation.go:124)
+        if (
+            self.tolerance_ns > 0
+            and block.header.time_ns > time.time_ns() + self.tolerance_ns
+        ):
             raise ValueError("block timestamp too far in the future")
         self._last_validated = bh
 

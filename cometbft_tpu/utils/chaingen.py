@@ -30,7 +30,18 @@ def make_chain(
     node = node or build_node(genesis, None)
     state = node.state_store.load()
     chain_id = state.chain_id
-    t = state.last_block_time_ns or time.time_ns()
+    # Keep generated block times strictly increasing AND in the past:
+    # 1s per block when the genesis backdate allows it, else shrink the
+    # step so even a 10k-block corpus ends >=60s before "now" (wall
+    # clock checks: block-time tolerance, light-client drift).
+    now = time.time_ns()
+    margin_ns = 60 * 1_000_000_000
+    t = state.last_block_time_ns or (
+        now - margin_ns - (n_blocks + 1) * 1_000_000_000
+    )
+    step_ns = 1_000_000_000
+    if t + (n_blocks + 1) * step_ns > now - margin_ns:
+        step_ns = max(1, (now - margin_ns - t) // (n_blocks + 1))
     addr_to_priv = {p.pub_key().address(): p for p in privs}
 
     for h in range(
@@ -44,7 +55,7 @@ def make_chain(
         )
         for i in range(txs_per_block):
             node.mempool.check_tx(b"h%d_%d=v%d" % (h, i, h))
-        t += 1_000_000_000
+        t += step_ns
         block, parts = node.block_exec.create_proposal_block(
             h, state, last_commit, proposer.address, time_ns=t
         )
