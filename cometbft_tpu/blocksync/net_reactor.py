@@ -1,0 +1,184 @@
+"""Blocksync network reactor: channel 0x40 wire protocol around the
+BlockPool/BlockSyncReactor verify loop (reference blocksync/reactor.go,
+channel id :21).
+
+Messages: StatusRequest/StatusResponse(base, height),
+BlockRequest(height), BlockResponse(block, commit), NoBlockResponse.
+Peers answering requests serve blocks straight from their store; the
+local pool side is bridged through NetPeerClient, which satisfies the
+pool's async request_block(height) interface by pairing requests with
+responses arriving on the channel."""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import traceback
+from typing import Callable, Dict, Optional
+
+from ..p2p.node_info import ChannelDescriptor
+from ..p2p.reactor import Reactor
+from ..utils import codec, proto
+from .reactor import BlockSyncReactor
+
+BLOCKSYNC_CHANNEL = 0x40
+
+MSG_STATUS_REQUEST = 0x01
+MSG_STATUS_RESPONSE = 0x02
+MSG_BLOCK_REQUEST = 0x03
+MSG_BLOCK_RESPONSE = 0x04
+MSG_NO_BLOCK_RESPONSE = 0x05
+
+STATUS_POLL_INTERVAL_S = 2.0
+
+
+class NetPeerClient:
+    """Adapts one remote peer to the pool's request_block interface."""
+
+    def __init__(self, peer):
+        self.peer = peer
+        self.pending: Dict[int, asyncio.Future] = {}
+
+    async def request_block(self, height: int):
+        fut = asyncio.get_running_loop().create_future()
+        self.pending[height] = fut
+        try:
+            await self.peer.send(
+                BLOCKSYNC_CHANNEL,
+                bytes([MSG_BLOCK_REQUEST]) + struct.pack(">q", height),
+            )
+            return await fut
+        finally:
+            self.pending.pop(height, None)
+
+    def deliver(self, height: int, block) -> None:
+        fut = self.pending.get(height)
+        if fut and not fut.done():
+            fut.set_result(block)
+
+
+class BlockSyncNetReactor(Reactor):
+    name = "blocksync"
+
+    def __init__(
+        self,
+        state,
+        block_exec,
+        block_store,
+        on_caught_up: Optional[Callable] = None,
+        block_ingestor=None,  # fork: adaptive sync
+        active: bool = True,
+    ):
+        super().__init__()
+        self.block_store = block_store
+        self.inner = BlockSyncReactor(
+            state,
+            block_exec,
+            block_store,
+            on_caught_up=self._caught_up,
+            block_ingestor=block_ingestor,
+        )
+        self.on_caught_up = on_caught_up
+        # active=False: full node already caught up, only SERVES blocks
+        # (reference: blocksync reactor with blockSync=false)
+        self.active = active
+        self.clients: Dict[str, NetPeerClient] = {}
+        self._status_task: Optional[asyncio.Task] = None
+        self._started_pool = False
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(BLOCKSYNC_CHANNEL, priority=5, max_msg_size=1 << 22)
+        ]
+
+    def _caught_up(self, state) -> None:
+        self.active = False
+        if self.on_caught_up:
+            self.on_caught_up(state)
+
+    # --- lifecycle ----------------------------------------------------
+
+    async def start(self) -> None:
+        if self.active:
+            await self.inner.start()
+            self._started_pool = True
+        self._status_task = asyncio.create_task(self._status_routine())
+
+    async def stop(self) -> None:
+        if self._status_task:
+            self._status_task.cancel()
+        if self._started_pool:
+            await self.inner.stop()
+
+    async def _status_routine(self) -> None:
+        try:
+            while True:
+                if self.active and self.switch is not None:
+                    self.switch.broadcast(
+                        BLOCKSYNC_CHANNEL, bytes([MSG_STATUS_REQUEST])
+                    )
+                await asyncio.sleep(STATUS_POLL_INTERVAL_S)
+        except asyncio.CancelledError:
+            raise
+
+    # --- peers --------------------------------------------------------
+
+    def add_peer(self, peer) -> None:
+        self.clients[peer.peer_id] = NetPeerClient(peer)
+        # announce our status so the peer can request from us
+        peer.try_send(BLOCKSYNC_CHANNEL, self._status_response())
+        if self.active:
+            peer.try_send(BLOCKSYNC_CHANNEL, bytes([MSG_STATUS_REQUEST]))
+
+    def remove_peer(self, peer, reason) -> None:
+        self.clients.pop(peer.peer_id, None)
+        self.inner.pool.remove_peer(peer.peer_id)
+
+    # --- wire ---------------------------------------------------------
+
+    def _status_response(self) -> bytes:
+        return bytes([MSG_STATUS_RESPONSE]) + struct.pack(
+            ">qq", self.block_store.base(), self.block_store.height()
+        )
+
+    def receive(self, chan_id: int, peer, msg: bytes) -> None:
+        mtype = msg[0]
+        body = msg[1:]
+        if mtype == MSG_STATUS_REQUEST:
+            peer.try_send(BLOCKSYNC_CHANNEL, self._status_response())
+        elif mtype == MSG_STATUS_RESPONSE:
+            base, height = struct.unpack(">qq", body)
+            cli = self.clients.get(peer.peer_id)
+            if cli and self.active:
+                self.inner.pool.set_peer_range(
+                    peer.peer_id, cli, max(base, 1), height
+                )
+        elif mtype == MSG_BLOCK_REQUEST:
+            (height,) = struct.unpack(">q", body)
+            block = self.block_store.load_block(height)
+            if block is None:
+                peer.try_send(
+                    BLOCKSYNC_CHANNEL,
+                    bytes([MSG_NO_BLOCK_RESPONSE]) + struct.pack(">q", height),
+                )
+                return
+            asyncio.ensure_future(
+                peer.send(
+                    BLOCKSYNC_CHANNEL,
+                    bytes([MSG_BLOCK_RESPONSE])
+                    + proto.field_bytes(1, codec.encode_block(block)),
+                )
+            )
+        elif mtype == MSG_BLOCK_RESPONSE:
+            m = proto.parse(body)
+            block = codec.decode_block(proto.get1(m, 1, b""))
+            cli = self.clients.get(peer.peer_id)
+            if cli:
+                cli.deliver(block.height, block)
+        elif mtype == MSG_NO_BLOCK_RESPONSE:
+            (height,) = struct.unpack(">q", body)
+            cli = self.clients.get(peer.peer_id)
+            if cli:
+                cli.deliver(height, None)
+        else:
+            raise ValueError(f"unknown blocksync msg type {mtype}")

@@ -66,6 +66,9 @@ class BlockPool:
             )
         else:
             p.base, p.height = base, height
+        # a taller peer may unlock new heights (peers can appear/grow
+        # AFTER the pool started in the networked path)
+        self.start_requesters()
 
     def remove_peer(self, peer_id: str) -> None:
         self.peers.pop(peer_id, None)
@@ -178,8 +181,7 @@ class BlockPool:
         for h, (blk, pid) in list(self.blocks.items()):
             if pid == ban_peer and h >= self.height:
                 del self.blocks[h]
-        for h in range(self.height, self.height + MAX_PENDING):
-            self._maybe_spawn(h)
+        self.start_requesters()
 
     def is_caught_up(self) -> bool:
         mx = self.max_peer_height()

@@ -103,6 +103,18 @@ class BlockSyncReactor:
         verified on a later pass once the state has advanced. The hash
         is only used to LIMIT the batch — each block is still fully
         validated against the locally-derived valset when applied."""
+        if self.ingestor is not None:
+            # adaptive mode: consensus may ALSO be committing heights
+            # (its own rounds / commit_block catch-up). Track its state
+            # and drop heights it already owns, else the window would
+            # verify against a stale valset and ban honest peers.
+            self.state = self.ingestor.state
+            while window and window[0][0] < self.ingestor.rs.height:
+                self.pool.pop_request()
+                self.blocks_applied += 1
+                window = window[1:]
+            if len(window) < 2:
+                return 0
         # block at window[i] is verified by window[i+1].last_commit
         vals_hash = self.state.validators.hash()
         jobs = []
@@ -156,9 +168,21 @@ class BlockSyncReactor:
                 # straight into the consensus state machine. The
                 # ingestor applies the block and returns the post-apply
                 # state so subsequent window validation isn't stale.
-                self.state = self.ingestor.ingest_verified_block(
-                    blk, parts, nxt.last_commit
-                )
+                if blk.height < self.ingestor.rs.height:
+                    # consensus ingested it concurrently (catch-up)
+                    self.state = self.ingestor.state
+                    self.pool.pop_request()
+                    self.blocks_applied += 1
+                    applied += 1
+                    continue
+                try:
+                    self.state = self.ingestor.ingest_verified_block(
+                        blk, parts, nxt.last_commit
+                    )
+                except ValueError:
+                    # consensus is mid-commit at this height; let it
+                    # finish and resume on the next pass
+                    break
             else:
                 if self.block_store.height() < h:
                     self.block_store.save_block(

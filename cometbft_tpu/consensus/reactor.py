@@ -1,0 +1,387 @@
+"""Consensus reactor: gossips proposals, block parts and votes over
+p2p channels (reference consensus/reactor.go).
+
+Channel layout mirrors the reference (consensus/reactor.go:27-30):
+  0x20 state  — NewRoundStep, HasVote, HasPart announcements
+  0x21 data   — Proposal, BlockPart, CommitBlock (catch-up)
+  0x22 vote   — Vote
+
+Delivery model: fast path is flood-with-dedup (the state machine
+re-broadcasts every NEWLY-added artifact via its broadcast hooks;
+duplicates die at VoteSet/PartSet level). Reliability comes from the
+per-peer GOSSIP routine (reference gossipDataRoutine :611 /
+gossipVotesRoutine :657): using each peer's announced round state
+(NewRoundStep) and acknowledgements (HasVote/HasPart — sent for every
+vote/part received, duplicate or not), the routine retransmits
+whatever the peer still lacks until it advances. This heals both
+startup races (votes flooded before the peer connected) and any
+mid-round message loss. Lagging peers get whole committed blocks +
+commits instead (CommitBlock — the reactor-level analog of the
+reference's gossipDataForCatchup)."""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Dict, Set, Tuple
+
+from .. import types as T
+from ..p2p.node_info import ChannelDescriptor
+from ..p2p.reactor import Reactor
+from ..store.block_store import _decode_part, _encode_part
+from ..types import events as ev
+from ..utils import codec, proto
+from .state import BlockPartMessage, ProposalMessage, VoteMessage
+from .types import Step
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+
+MSG_NEW_ROUND_STEP = 0x01
+MSG_PROPOSAL = 0x02
+MSG_BLOCK_PART = 0x03
+MSG_VOTE = 0x04
+MSG_COMMIT_BLOCK = 0x05
+MSG_HAS_VOTE = 0x06
+MSG_HAS_PART = 0x07
+
+RETRANSMIT_AFTER_S = 0.25
+CATCHUP_RETRANSMIT_S = 1.0
+MAX_GOSSIP_VOTES_PER_TICK = 16
+MAX_GOSSIP_PARTS_PER_TICK = 8
+
+
+@dataclass
+class CommitBlockMessage:
+    block: T.Block
+    commit: T.Commit
+
+
+@dataclass
+class PeerRoundState:
+    height: int = 0
+    round: int = -1
+    step: int = 0
+    # (height, round, type, index) votes the peer is known to have
+    has_votes: Set[Tuple[int, int, int, int]] = field(default_factory=set)
+    # (height, round, part_index) parts the peer is known to have
+    has_parts: Set[Tuple[int, int, int]] = field(default_factory=set)
+    proposal_seen: bool = False
+
+
+# --- wire codecs --------------------------------------------------------
+
+
+def encode_new_round_step(height: int, round_: int, step: int) -> bytes:
+    return bytes([MSG_NEW_ROUND_STEP]) + struct.pack(
+        ">qiB", height, round_, step
+    )
+
+
+def encode_proposal_msg(p: T.Proposal) -> bytes:
+    return bytes([MSG_PROPOSAL]) + codec.encode_proposal(p)
+
+
+def encode_block_part_msg(height: int, round_: int, part: T.Part) -> bytes:
+    return (
+        bytes([MSG_BLOCK_PART])
+        + proto.field_varint(1, height)
+        + proto.field_varint(2, round_ + 1)  # +1: round 0 must be present
+        + proto.field_bytes(3, _encode_part(part))
+    )
+
+
+def encode_vote_msg(v: T.Vote) -> bytes:
+    return bytes([MSG_VOTE]) + codec.encode_vote(v)
+
+
+def encode_commit_block(block: T.Block, commit: T.Commit) -> bytes:
+    return (
+        bytes([MSG_COMMIT_BLOCK])
+        + proto.field_bytes(1, codec.encode_block(block))
+        + proto.field_bytes(2, codec.encode_commit(commit))
+    )
+
+
+def encode_has_vote(height: int, round_: int, type_: int, index: int) -> bytes:
+    return bytes([MSG_HAS_VOTE]) + struct.pack(">qiBi", height, round_, type_, index)
+
+
+def encode_has_part(height: int, round_: int, index: int) -> bytes:
+    return bytes([MSG_HAS_PART]) + struct.pack(">qii", height, round_, index)
+
+
+def _vote_key(v: T.Vote) -> Tuple[int, int, int, int]:
+    return (v.height, v.round, v.type_, v.validator_index)
+
+
+class ConsensusReactor(Reactor):
+    name = "consensus"
+
+    def __init__(self, cs, block_store, wait_sync: bool = False):
+        super().__init__()
+        self.cs = cs
+        self.block_store = block_store
+        # wait_sync: created during blocksync/statesync; gossip starts
+        # after switch_to_consensus (reference conR.WaitSync)
+        self.wait_sync = wait_sync
+        self._gossip_tasks: Dict[str, asyncio.Task] = {}
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(STATE_CHANNEL, priority=6, max_msg_size=1 << 20),
+            ChannelDescriptor(DATA_CHANNEL, priority=10),
+            ChannelDescriptor(VOTE_CHANNEL, priority=7, max_msg_size=1 << 20),
+        ]
+
+    # --- lifecycle ----------------------------------------------------
+
+    async def start(self) -> None:
+        self.cs.add_broadcast_hook(self._on_cs_broadcast)
+        self.cs.event_bus.add_sync_listener(self._on_event)
+
+    async def stop(self) -> None:
+        for t in self._gossip_tasks.values():
+            t.cancel()
+        self._gossip_tasks.clear()
+
+    def switch_to_consensus(self) -> None:
+        """Called when blocksync finishes (reference
+        consensus/reactor.go:121 SwitchToConsensus)."""
+        self.wait_sync = False
+        self._announce_step()
+
+    # --- outbound (flood fast path) -----------------------------------
+
+    def _on_cs_broadcast(self, kind: str, payload) -> None:
+        if self.switch is None or self.wait_sync:
+            return
+        if kind == "proposal":
+            self.switch.broadcast(
+                DATA_CHANNEL, encode_proposal_msg(payload.proposal)
+            )
+        elif kind == "block_part":
+            self.switch.broadcast(
+                DATA_CHANNEL,
+                encode_block_part_msg(
+                    payload.height, payload.round, payload.part
+                ),
+            )
+            # tell peers we have it so they stop retransmitting to us
+            self.switch.broadcast(
+                STATE_CHANNEL,
+                encode_has_part(
+                    payload.height, payload.round, payload.part.index
+                ),
+            )
+        elif kind == "vote":
+            self.switch.broadcast(
+                VOTE_CHANNEL, encode_vote_msg(payload.vote)
+            )
+            self.switch.broadcast(
+                STATE_CHANNEL, encode_has_vote(*_vote_key(payload.vote))
+            )
+
+    def _on_event(self, e) -> None:
+        if e.type_ == ev.EVENT_NEW_ROUND_STEP:
+            self._announce_step()
+
+    def _announce_step(self) -> None:
+        if self.switch is None or self.wait_sync:
+            return
+        rs = self.cs.rs
+        self.switch.broadcast(
+            STATE_CHANNEL,
+            encode_new_round_step(rs.height, rs.round, int(rs.step)),
+        )
+
+    # --- peers --------------------------------------------------------
+
+    def add_peer(self, peer) -> None:
+        peer.set("prs", PeerRoundState())
+        rs = self.cs.rs
+        if not self.wait_sync:
+            peer.try_send(
+                STATE_CHANNEL,
+                encode_new_round_step(rs.height, rs.round, int(rs.step)),
+            )
+        self._gossip_tasks[peer.peer_id] = asyncio.create_task(
+            self._gossip_routine(peer)
+        )
+
+    def remove_peer(self, peer, reason) -> None:
+        t = self._gossip_tasks.pop(peer.peer_id, None)
+        if t:
+            t.cancel()
+
+    # --- the per-peer gossip routine ----------------------------------
+
+    async def _gossip_routine(self, peer) -> None:
+        sent_at: Dict[tuple, float] = {}
+        sleep_s = getattr(self.cs.config, "peer_gossip_sleep_s", 0.1)
+        try:
+            while True:
+                await asyncio.sleep(sleep_s)
+                if self.wait_sync:
+                    continue
+                prs: PeerRoundState = peer.get("prs")
+                if prs is None or prs.height == 0:
+                    continue
+                rs = self.cs.rs
+                now = time.monotonic()
+
+                def due(key, after=RETRANSMIT_AFTER_S) -> bool:
+                    return now - sent_at.get(key, 0.0) > after
+
+                if prs.height < rs.height:
+                    # catch-up: ship whole committed blocks, repeating
+                    # (paced) until the peer's NewRoundStep advances
+                    ckey = ("cb", prs.height)
+                    if prs.height <= self.block_store.height() and due(
+                        ckey, CATCHUP_RETRANSMIT_S
+                    ):
+                        block = self.block_store.load_block(prs.height)
+                        commit = self.block_store.load_seen_commit(
+                            prs.height
+                        ) or self.block_store.load_block_commit(prs.height)
+                        if block is not None and commit is not None:
+                            sent_at[ckey] = now
+                            await peer.send(
+                                DATA_CHANNEL,
+                                encode_commit_block(block, commit),
+                            )
+                    continue
+                if prs.height > rs.height:
+                    continue  # we're behind; their catch-up feeds us
+
+                # data: proposal + parts for the current round
+                if rs.proposal is not None and not prs.proposal_seen:
+                    key = ("prop", rs.height, rs.round)
+                    if due(key):
+                        peer.try_send(
+                            DATA_CHANNEL, encode_proposal_msg(rs.proposal)
+                        )
+                        sent_at[key] = now
+                if rs.proposal_block_parts is not None:
+                    sent_parts = 0
+                    for part in rs.proposal_block_parts.parts:
+                        if part is None:
+                            continue
+                        pkey = (rs.height, rs.round, part.index)
+                        if pkey in prs.has_parts:
+                            continue
+                        if not due(("part",) + pkey):
+                            continue
+                        peer.try_send(
+                            DATA_CHANNEL,
+                            encode_block_part_msg(
+                                rs.height, rs.round, part
+                            ),
+                        )
+                        sent_at[("part",) + pkey] = now
+                        sent_parts += 1
+                        if sent_parts >= MAX_GOSSIP_PARTS_PER_TICK:
+                            break
+
+                # votes: everything we have for rounds the peer is in
+                sent_votes = 0
+                for vote in self._votes_for_peer(rs, prs):
+                    vkey = _vote_key(vote)
+                    if vkey in prs.has_votes:
+                        continue
+                    if not due(("vote",) + vkey):
+                        continue
+                    peer.try_send(VOTE_CHANNEL, encode_vote_msg(vote))
+                    sent_at[("vote",) + vkey] = now
+                    sent_votes += 1
+                    if sent_votes >= MAX_GOSSIP_VOTES_PER_TICK:
+                        break
+                if len(sent_at) > 50_000:
+                    sent_at.clear()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            traceback.print_exc()
+
+    def _votes_for_peer(self, rs, prs: PeerRoundState):
+        """All signed votes we hold that the peer's round state could
+        still need (reference PickSendVote's source sets)."""
+        if rs.votes is None:
+            return
+        rounds = {prs.round, rs.round, rs.round - 1}
+        for r in sorted(x for x in rounds if x >= 0):
+            for vs in (rs.votes.prevotes(r), rs.votes.precommits(r)):
+                if vs is not None:
+                    yield from (v for v in vs.votes if v is not None)
+        # last-height precommits help peers still committing
+        if rs.last_commit is not None:
+            yield from (v for v in rs.last_commit.votes if v is not None)
+
+    # --- inbound ------------------------------------------------------
+
+    def receive(self, chan_id: int, peer, msg: bytes) -> None:
+        if not msg:
+            return
+        mtype = msg[0]
+        body = msg[1:]
+        prs: PeerRoundState = peer.get("prs") or PeerRoundState()
+        if mtype == MSG_NEW_ROUND_STEP:
+            h, r, s = struct.unpack(">qiB", body)
+            if h != prs.height:
+                prs.has_votes.clear()
+                prs.has_parts.clear()
+                prs.proposal_seen = False
+            elif r != prs.round:
+                prs.proposal_seen = False
+            prs.height, prs.round, prs.step = h, r, s
+            peer.set("prs", prs)
+        elif mtype == MSG_HAS_VOTE:
+            h, r, t, i = struct.unpack(">qiBi", body)
+            prs.has_votes.add((h, r, t, i))
+        elif mtype == MSG_HAS_PART:
+            h, r, i = struct.unpack(">qii", body)
+            prs.has_parts.add((h, r, i))
+        elif self.wait_sync:
+            return  # ignore consensus traffic until synced
+        elif mtype == MSG_PROPOSAL:
+            prop = codec.decode_proposal(body)
+            if prop.height == prs.height:
+                prs.proposal_seen = True
+            self.cs.enqueue_nowait(
+                "proposal", ProposalMessage(prop), peer.peer_id
+            )
+        elif mtype == MSG_BLOCK_PART:
+            m = proto.parse(body)
+            height = proto.get1(m, 1, 0)
+            round_ = proto.get1(m, 2, 1) - 1
+            part = _decode_part(proto.get1(m, 3, b""))
+            # the sender obviously has it; ack so it stops resending
+            prs.has_parts.add((height, round_, part.index))
+            peer.try_send(
+                STATE_CHANNEL, encode_has_part(height, round_, part.index)
+            )
+            self.cs.enqueue_nowait(
+                "block_part",
+                BlockPartMessage(height, round_, part),
+                peer.peer_id,
+            )
+        elif mtype == MSG_VOTE:
+            vote = codec.decode_vote(body)
+            prs.has_votes.add(_vote_key(vote))
+            peer.try_send(STATE_CHANNEL, encode_has_vote(*_vote_key(vote)))
+            self.cs.enqueue_nowait("vote", VoteMessage(vote), peer.peer_id)
+        elif mtype == MSG_COMMIT_BLOCK:
+            m = proto.parse(body)
+            block = codec.decode_block(proto.get1(m, 1, b""))
+            commit = codec.decode_commit(proto.get1(m, 2, b""))
+            self.cs.enqueue_nowait(
+                "commit_block",
+                CommitBlockMessage(block, commit),
+                peer.peer_id,
+            )
+        else:
+            raise ValueError(f"unknown consensus msg type {mtype}")

@@ -199,6 +199,100 @@ class ConsensusState:
                 self._broadcast("block_part", payload)
         elif kind == "vote":
             self._try_add_vote(payload.vote, peer_id)
+        elif kind == "commit_block":
+            self._handle_commit_block(payload, peer_id)
+
+    def _handle_commit_block(self, payload, peer_id: str) -> None:
+        """Catch-up: a peer sent us a committed block + its commit
+        (the reactor-level analog of the reference's part-by-part
+        catch-up gossip, consensus/reactor.go gossipDataForCatchup).
+        Verify the commit against OUR validator set, then ingest."""
+        rs = self.rs
+        block, commit = payload.block, payload.commit
+        if block.height != rs.height:
+            return
+        parts = T.PartSet.from_data(codec.encode_block(block))
+        bid = T.BlockID(block.hash(), parts.header)
+        if commit.block_id.hash != bid.hash:
+            return
+        if rs.step >= Step.COMMIT:
+            # already committing from our own precommits, but we may be
+            # MISSING the block itself (enter_commit's "reset parts"
+            # branch). Adopt the received block if it matches the
+            # committed BlockID, then finalize.
+            maj = (
+                rs.votes.precommits(rs.commit_round).two_thirds_majority()
+                if rs.commit_round >= 0
+                else None
+            )
+            if (
+                rs.proposal_block is None
+                and maj is not None
+                and not maj.is_nil()
+                and maj.hash == bid.hash
+                and maj.part_set_header.hash == parts.header.hash
+            ):
+                rs.proposal_block = block
+                rs.proposal_block_parts = parts
+                self._try_finalize_commit(block.height)
+            return
+        try:
+            T.verify_commit(
+                self.state.chain_id, rs.validators, bid, block.height, commit
+            )
+        except Exception:
+            return
+        self.ingest_verified_block(block, parts, commit)
+
+    def ingest_verified_block(self, block, parts, commit):
+        """Adaptive-sync ingest (reference consensus/state_ingest.go:231
+        + reactor IngestVerifiedBlock): commit a block WITHOUT running
+        rounds. Caller must have verified `commit` against this
+        height's validator set. Returns the post-apply State."""
+        rs = self.rs
+        if block.height != rs.height:
+            raise ValueError(
+                f"ingest at height {block.height}, consensus at {rs.height}"
+            )
+        if rs.step >= Step.COMMIT:
+            raise ValueError("consensus already committing this height")
+        bid = T.BlockID(block.hash(), parts.header)
+        return self._apply_committed_block(
+            block, parts, commit, bid, immediate=True
+        )
+
+    def _apply_committed_block(
+        self, block, parts, commit, bid, immediate: bool
+    ):
+        """Shared tail of _finalize_commit and ingest_verified_block:
+        persist, WAL-barrier, apply, advance to the next height."""
+        height = block.height
+        if self.block_store.height() < height:
+            self.block_store.save_block(block, parts, commit)
+        else:
+            self.block_store.save_seen_commit(height, commit)
+        if self.wal:
+            self.wal.write_end_height(height)
+        new_state = self.block_exec.apply_verified_block(
+            self.state, bid, block
+        )
+        self.decided_heights += 1
+        if self.on_decided:
+            try:
+                self.on_decided(height, bid, block)
+            except Exception:
+                traceback.print_exc()
+        self.update_to_state(new_state)
+        if self.queue is not None:  # only once started
+            self._schedule_timeout(
+                0.0
+                if immediate or self.config.skip_timeout_commit
+                else self.config.timeout_commit_s,
+                self.rs.height,
+                0,
+                Step.NEW_HEIGHT,
+            )
+        return new_state
 
     def _handle_timeout(self, ti: TimeoutInfo) -> None:
         rs = self.rs
@@ -642,37 +736,11 @@ class ConsensusState:
         rs = self.rs
         block, parts = rs.proposal_block, rs.proposal_block_parts
         bid = T.BlockID(block.hash(), parts.header)
-        precommits = rs.votes.precommits(rs.commit_round)
-        seen_commit = precommits.make_commit()
-        # 1. save block
-        if self.block_store.height() < height:
-            self.block_store.save_block(block, parts, seen_commit)
-        else:
-            self.block_store.save_seen_commit(height, seen_commit)
-        # 2. WAL end-height barrier (reference :1801)
-        if self.wal:
-            self.wal.write_end_height(height)
-        # 3. apply (commit already verified by consensus itself)
-        try:
-            new_state = self.block_exec.apply_verified_block(
-                self.state, bid, block
-            )
-        except Exception:
-            traceback.print_exc()
-            raise
-        self.decided_heights += 1
-        if self.on_decided:
-            try:
-                self.on_decided(height, bid, block)
-            except Exception:
-                traceback.print_exc()
-        # 4. next height
-        self.update_to_state(new_state)
-        self._schedule_timeout(
-            0.0 if self.config.skip_timeout_commit else self.config.timeout_commit_s,
-            self.rs.height,
-            0,
-            Step.NEW_HEIGHT,
+        seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
+        # persist + WAL end-height barrier (reference :1775-1801) +
+        # apply + advance (commit already verified by consensus itself)
+        self._apply_committed_block(
+            block, parts, seen_commit, bid, immediate=False
         )
 
     # --- votes --------------------------------------------------------

@@ -1,0 +1,87 @@
+"""Evidence reactor: gossip evidence on channel 0x38 (reference
+evidence/reactor.go:17, broadcastEvidenceRoutine :107).
+
+Every pending piece of evidence is periodically offered to every peer
+(the pool dedups), and newly-added evidence is flooded immediately via
+the pool's broadcast hook."""
+
+from __future__ import annotations
+
+import asyncio
+import traceback
+from typing import Dict
+
+from ..p2p.node_info import ChannelDescriptor
+from ..p2p.reactor import Reactor
+from .types import decode_evidence
+
+EVIDENCE_CHANNEL = 0x38
+BROADCAST_INTERVAL_S = 0.5
+MAX_PENDING_BYTES = 1 << 20
+
+
+class EvidenceReactor(Reactor):
+    name = "evidence"
+
+    def __init__(self, evpool):
+        super().__init__()
+        self.evpool = evpool
+        self._tasks: Dict[str, asyncio.Task] = {}
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(EVIDENCE_CHANNEL, priority=6, max_msg_size=1 << 20)
+        ]
+
+    async def start(self) -> None:
+        self.evpool.add_broadcast_hook(self._on_new_evidence)
+
+    def _on_new_evidence(self, evd) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(EVIDENCE_CHANNEL, evd.encode())
+
+    def add_peer(self, peer) -> None:
+        self._tasks[peer.peer_id] = asyncio.create_task(
+            self._broadcast_routine(peer)
+        )
+
+    def remove_peer(self, peer, reason) -> None:
+        t = self._tasks.pop(peer.peer_id, None)
+        if t:
+            t.cancel()
+
+    async def stop(self) -> None:
+        for t in self._tasks.values():
+            t.cancel()
+        self._tasks.clear()
+
+    async def _broadcast_routine(self, peer) -> None:
+        sent = set()
+        try:
+            while True:
+                for evd in self.evpool.pending_evidence(MAX_PENDING_BYTES):
+                    k = evd.hash()
+                    if k in sent:
+                        continue
+                    await peer.send(EVIDENCE_CHANNEL, evd.encode())
+                    sent.add(k)
+                await asyncio.sleep(BROADCAST_INTERVAL_S)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            traceback.print_exc()
+
+    def receive(self, chan_id: int, peer, msg: bytes) -> None:
+        try:
+            evd = decode_evidence(msg)
+        except Exception:
+            self.switch.stop_peer_for_error(
+                peer, ValueError("undecodable evidence")
+            )
+            return
+        try:
+            self.evpool.add_evidence(evd)
+        except Exception:
+            # invalid evidence from a peer is a protocol violation in
+            # the reference (evidence/reactor.go Receive)
+            pass

@@ -106,6 +106,11 @@ class CListMempool(Mempool):
         self.height = height
         self.cache = TxCache(cache_size)
         self.pool: "OrderedDict[bytes, MempoolTx]" = OrderedDict()
+        # monotonic insertion log: gossip routines keep a per-peer seq
+        # cursor instead of rescanning the pool (the reference's clist
+        # waiter, mempool/reactor.go:217)
+        self._seq = 0
+        self._log: List[tuple] = []  # (seq, tx_key), insertion order
         self.max_tx_bytes = max_tx_bytes
         self.max_txs = max_txs
         self.recheck = recheck
@@ -134,6 +139,8 @@ class CListMempool(Mempool):
                 if sender:
                     mt.senders.add(sender)
                 self.pool[tx_key(tx)] = mt
+                self._seq += 1
+                self._log.append((self._seq, tx_key(tx)))
                 self._txs_available.set()
             if self._notify:
                 self._notify()
@@ -161,6 +168,27 @@ class CListMempool(Mempool):
         with self._lock:
             return [mt.tx for mt in self.pool.values()]
 
+    def tx_senders(self, key: bytes):
+        """Peers that gave us this tx (gossip echo suppression,
+        reference mempool/reactor.go broadcastTxRoutine)."""
+        with self._lock:
+            mt = self.pool.get(key)
+            return set(mt.senders) if mt else ()
+
+    def txs_after(self, seq: int) -> List[tuple]:
+        """(seq, tx, senders) for pooled txs inserted after `seq` —
+        the per-peer gossip cursor."""
+        import bisect
+
+        with self._lock:
+            i = bisect.bisect_right(self._log, seq, key=lambda e: e[0])
+            out = []
+            for s, k in self._log[i:]:
+                mt = self.pool.get(k)
+                if mt is not None:
+                    out.append((s, mt.tx, set(mt.senders)))
+            return out
+
     def size(self) -> int:
         with self._lock:
             return len(self.pool)
@@ -185,6 +213,8 @@ class CListMempool(Mempool):
             self.pool.pop(tx_key(tx), None)
         if self.recheck and self.pool:
             self._recheck_txs()
+        if len(self._log) > 4 * len(self.pool) + 1024:
+            self._log = [e for e in self._log if e[1] in self.pool]
         if self.pool:
             self._txs_available.set()
             if self._notify:

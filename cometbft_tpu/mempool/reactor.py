@@ -1,0 +1,85 @@
+"""Mempool reactor: tx gossip on channel 0x30 (reference
+mempool/reactor.go, channel id at mempool/mempool.go:13).
+
+Per-peer broadcast routine mirrors the reference's clist-waiter loop
+(mempool/reactor.go:217 broadcastTxRoutine): walk the mempool's tx
+list in insertion order, skip txs the peer itself sent us (sender
+tracking), and push everything else. Inbound txs go through the full
+CheckTx path, so invalid txs never propagate."""
+
+from __future__ import annotations
+
+import asyncio
+import traceback
+from typing import Dict
+
+from ..p2p.node_info import ChannelDescriptor
+from ..p2p.reactor import Reactor
+from .mempool import tx_key
+
+MEMPOOL_CHANNEL = 0x30
+GOSSIP_INTERVAL_S = 0.05
+
+
+class MempoolReactor(Reactor):
+    name = "mempool"
+
+    def __init__(self, mempool, broadcast: bool = True):
+        super().__init__()
+        self.mempool = mempool
+        self.broadcast = broadcast  # config.Mempool.Broadcast
+        self._tasks: Dict[str, asyncio.Task] = {}
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(MEMPOOL_CHANNEL, priority=5, max_msg_size=1 << 20)
+        ]
+
+    def add_peer(self, peer) -> None:
+        if self.broadcast:
+            self._tasks[peer.peer_id] = asyncio.create_task(
+                self._broadcast_tx_routine(peer)
+            )
+
+    def remove_peer(self, peer, reason) -> None:
+        t = self._tasks.pop(peer.peer_id, None)
+        if t:
+            t.cancel()
+
+    async def stop(self) -> None:
+        for t in self._tasks.values():
+            t.cancel()
+        self._tasks.clear()
+
+    async def _broadcast_tx_routine(self, peer) -> None:
+        cursor = 0
+        use_cursor = hasattr(self.mempool, "txs_after")
+        sent = set()  # fallback path only
+        try:
+            while True:
+                if use_cursor:
+                    # seq-cursor over the insertion log: O(new txs) per
+                    # tick, no rescans, no re-flood
+                    for seq, tx, senders in self.mempool.txs_after(cursor):
+                        cursor = max(cursor, seq)
+                        if peer.peer_id in senders:
+                            continue  # peer gave it to us; don't echo
+                        await peer.send(MEMPOOL_CHANNEL, tx)
+                else:
+                    for tx in self.mempool.iter_txs():
+                        k = tx_key(tx)
+                        if k in sent:
+                            continue
+                        sent.add(k)
+                        await peer.send(MEMPOOL_CHANNEL, tx)
+                await asyncio.sleep(GOSSIP_INTERVAL_S)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            traceback.print_exc()
+
+    def receive(self, chan_id: int, peer, msg: bytes) -> None:
+        try:
+            self.mempool.check_tx(msg, sender=peer.peer_id)
+        except Exception:
+            pass  # invalid txs are dropped, not fatal to the peer

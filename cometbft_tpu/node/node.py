@@ -1,0 +1,152 @@
+"""Full node assembly: transport + switch + reactors + consensus
+(reference node/node.go:285 NewNode, :616 OnStart, node/setup.go).
+
+Startup phases mirror the reference: (statesync ->) blocksync ->
+consensus. When blocksync is enabled the consensus state machine is
+built but NOT started; once the pool reports caught-up the node
+switches to consensus (reference consensus/reactor.go:121
+SwitchToConsensus). With the fork's AdaptiveSync, blocksync pipelines
+verified blocks straight into the RUNNING consensus state machine
+instead (reference blocksync/reactor_adaptive.go)."""
+
+from __future__ import annotations
+
+import asyncio
+import traceback
+from typing import Optional
+
+from ..blocksync.net_reactor import BlockSyncNetReactor
+from ..config import Config
+from ..consensus.reactor import ConsensusReactor
+from ..evidence.reactor import EvidenceReactor
+from ..mempool.reactor import MempoolReactor
+from ..p2p import MemoryTransport, NodeInfo, NodeKey, Switch, TCPTransport
+from ..types.genesis import GenesisDoc
+from .inprocess import NodeParts, build_node
+
+
+def _strip_proto(addr: str) -> str:
+    for p in ("tcp://", "unix://"):
+        if addr.startswith(p):
+            return addr[len(p):]
+    return addr
+
+
+class Node:
+    """A running full node / validator."""
+
+    def __init__(
+        self,
+        config: Config,
+        genesis: GenesisDoc,
+        privval=None,
+        app=None,
+        node_key: Optional[NodeKey] = None,
+        transport: Optional[object] = None,
+        home: Optional[str] = None,
+    ):
+        self.config = config
+        self.genesis = genesis
+        self.parts: NodeParts = build_node(
+            genesis, privval, app=app, config=config, home=home,
+            wal=bool(home),
+        )
+        self.node_key = node_key or NodeKey.generate()
+        self.node_info = NodeInfo(
+            node_id=self.node_key.node_id,
+            network=genesis.chain_id,
+            moniker=config.base.moniker,
+        )
+        if transport is None:
+            transport = TCPTransport(self.node_key, self.node_info)
+        self.transport = transport
+        self.switch = Switch(
+            self.transport,
+            self.node_info,
+            mconn_config={
+                "send_rate": config.p2p.send_rate,
+                "recv_rate": config.p2p.recv_rate,
+                "flush_throttle_s": config.p2p.flush_throttle_ms / 1000.0,
+            },
+        )
+
+        blocksync_active = config.blocksync.enable and not config.statesync.enable
+        adaptive = config.blocksync.adaptive_sync
+
+        self.consensus_reactor = ConsensusReactor(
+            self.parts.cs,
+            self.parts.block_store,
+            wait_sync=blocksync_active and not adaptive,
+        )
+        self.mempool_reactor = MempoolReactor(
+            self.parts.mempool, broadcast=config.mempool.broadcast
+        )
+        self.evidence_reactor = EvidenceReactor(self.parts.evpool)
+        self.blocksync_reactor = BlockSyncNetReactor(
+            self.parts.state,
+            self.parts.block_exec,
+            self.parts.block_store,
+            on_caught_up=self._on_caught_up,
+            block_ingestor=self.parts.cs if adaptive else None,
+            active=blocksync_active,
+        )
+        self.switch.add_reactor("consensus", self.consensus_reactor)
+        self.switch.add_reactor("mempool", self.mempool_reactor)
+        self.switch.add_reactor("evidence", self.evidence_reactor)
+        self.switch.add_reactor("blocksync", self.blocksync_reactor)
+        self._adaptive = adaptive
+        self._cs_started = False
+
+    # --- phase switching ----------------------------------------------
+
+    def _on_caught_up(self, state) -> None:
+        asyncio.ensure_future(self._switch_to_consensus(state))
+
+    async def _switch_to_consensus(self, state) -> None:
+        if self._cs_started:
+            self.consensus_reactor.switch_to_consensus()
+            return
+        try:
+            self.parts.cs.update_to_state(state)
+            await self.parts.cs.start()
+            self._cs_started = True
+            self.consensus_reactor.switch_to_consensus()
+        except Exception:
+            traceback.print_exc()
+
+    # --- lifecycle ----------------------------------------------------
+
+    @property
+    def listen_addr(self) -> str:
+        return self.transport.listen_addr
+
+    async def start(self) -> None:
+        await self.transport.listen(_strip_proto(self.config.p2p.laddr))
+        await self.switch.start()
+        # consensus starts now unless a sync phase must complete first
+        if not self.blocksync_reactor.active or self._adaptive:
+            await self.parts.cs.start()
+            self._cs_started = True
+        if self.config.p2p.persistent_peers:
+            self.switch.dial_peers_async(
+                [
+                    a.strip()
+                    for a in self.config.p2p.persistent_peers.split(",")
+                    if a.strip()
+                ],
+                persistent=True,
+            )
+
+    async def stop(self) -> None:
+        if self._cs_started:
+            await self.parts.cs.stop()
+        await self.switch.stop()
+
+    # --- convenience --------------------------------------------------
+
+    async def dial(self, addr: str, persistent: bool = False):
+        return await self.switch.dial_peer(addr, persistent=persistent)
+
+    @property
+    def height(self) -> int:
+        return self.parts.block_store.height()

@@ -1,0 +1,32 @@
+"""P2P layer: authenticated-encrypted TCP transport, multiplexed
+channels, peer lifecycle, switch + reactor plumbing.
+
+Parity map (reference -> here):
+- p2p/key.go              -> key.py (NodeKey, ID derivation)
+- p2p/conn/secret_connection.go -> conn/secret_connection.py
+- p2p/conn/connection.go  -> conn/connection.py (MConnection)
+- p2p/transport.go        -> transport.py (TCP + in-memory)
+- p2p/peer.go             -> peer.py
+- p2p/switch.go           -> switch.py
+- p2p/base_reactor.go     -> reactor.py
+- p2p/pex/                -> pex.py (addrbook + PEX reactor)
+"""
+
+from .key import NodeKey, node_id_from_pubkey
+from .node_info import ChannelDescriptor, NodeInfo
+from .peer import Peer
+from .reactor import Reactor
+from .switch import Switch
+from .transport import MemoryTransport, TCPTransport
+
+__all__ = [
+    "NodeKey",
+    "node_id_from_pubkey",
+    "NodeInfo",
+    "ChannelDescriptor",
+    "Peer",
+    "Reactor",
+    "Switch",
+    "TCPTransport",
+    "MemoryTransport",
+]

@@ -1,0 +1,4 @@
+from .connection import ChannelStatus, MConnection
+from .secret_connection import SecretConnection
+
+__all__ = ["SecretConnection", "MConnection", "ChannelStatus"]

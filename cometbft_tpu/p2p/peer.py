@@ -1,0 +1,74 @@
+"""Peer: a connected remote node (reference p2p/peer.go:23).
+
+Wraps the MConnection with identity/metadata and a small KV store that
+reactors use to stash per-peer state (reference peer.Set/Get).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable, Dict, List, Optional
+
+from .conn.connection import MConnection
+from .conn.secret_connection import SecretConnection
+from .node_info import NodeInfo
+
+
+class Peer:
+    def __init__(
+        self,
+        sconn: SecretConnection,
+        node_info: NodeInfo,
+        conn_str: str,
+        channels: List[tuple],
+        on_receive: Callable,  # (chan_id, msg_bytes, peer)
+        on_error: Optional[Callable] = None,  # (peer, exc)
+        outbound: bool = False,
+        persistent: bool = False,
+        mconn_config: Optional[dict] = None,
+    ):
+        self.node_info = node_info
+        self.conn_str = conn_str
+        self.outbound = outbound
+        self.persistent = persistent
+        self._data: Dict[str, Any] = {}
+        self.mconn = MConnection(
+            sconn,
+            channels,
+            on_receive=lambda cid, msg: on_receive(cid, msg, self),
+            on_error=(lambda e: on_error(self, e)) if on_error else None,
+            **(mconn_config or {}),
+        )
+
+    # --- identity -----------------------------------------------------
+
+    @property
+    def peer_id(self) -> str:
+        return self.node_info.node_id
+
+    def __repr__(self) -> str:
+        return f"Peer({self.peer_id[:10]}@{self.conn_str})"
+
+    # --- lifecycle ----------------------------------------------------
+
+    def start(self) -> None:
+        self.mconn.start()
+
+    async def stop(self) -> None:
+        await self.mconn.stop()
+
+    # --- messaging ----------------------------------------------------
+
+    async def send(self, chan_id: int, msg: bytes) -> bool:
+        return await self.mconn.send(chan_id, msg)
+
+    def try_send(self, chan_id: int, msg: bytes) -> bool:
+        return self.mconn.try_send(chan_id, msg)
+
+    # --- per-peer reactor state ---------------------------------------
+
+    def get(self, key: str, default=None):
+        return self._data.get(key, default)
+
+    def set(self, key: str, value) -> None:
+        self._data[key] = value

@@ -1,0 +1,259 @@
+"""Switch: owns transport, peers, and reactors (reference p2p/switch.go).
+
+Responsibilities (mirroring the reference):
+- accept loop: upgraded inbound conns -> add_peer
+- dial_peers_async with exponential-backoff reconnect for persistent
+  peers (reference switch.go reconnectToPeer)
+- channel routing: every complete MConnection message is dispatched to
+  the reactor that registered its channel
+- stop_peer_for_error: the single choke point reactors use to drop a
+  misbehaving peer (and everything re-routes through reconnect logic)
+- max peer caps + dedup by node ID.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import traceback
+from typing import Dict, List, Optional
+
+from .node_info import ChannelDescriptor, NodeInfo
+from .peer import Peer
+from .reactor import Reactor
+
+RECONNECT_BASE_S = 1.0
+RECONNECT_MAX_S = 30.0
+MAX_RECONNECT_ATTEMPTS = 20
+DEFAULT_MAX_PEERS = 50
+
+
+class Switch:
+    def __init__(
+        self,
+        transport,
+        node_info: NodeInfo,
+        max_peers: int = DEFAULT_MAX_PEERS,
+        mconn_config: Optional[dict] = None,
+    ):
+        self.transport = transport
+        self.node_info = node_info
+        self.reactors: Dict[str, Reactor] = {}
+        self.chan_to_reactor: Dict[int, Reactor] = {}
+        self.channel_descs: List[ChannelDescriptor] = []
+        self.peers: Dict[str, Peer] = {}
+        self.persistent_addrs: Dict[str, str] = {}  # id -> addr
+        self.banned: set = set()
+        self.max_peers = max_peers
+        self.mconn_config = mconn_config or {}
+        self._accept_task: Optional[asyncio.Task] = None
+        self._reconnect_tasks: Dict[str, asyncio.Task] = {}
+        self._stopped = False
+
+    # --- reactor registry ---------------------------------------------
+
+    def add_reactor(self, name: str, reactor: Reactor) -> Reactor:
+        self.reactors[name] = reactor
+        for desc in reactor.get_channels():
+            if desc.chan_id in self.chan_to_reactor:
+                raise ValueError(
+                    f"channel {desc.chan_id:#x} claimed twice"
+                )
+            self.chan_to_reactor[desc.chan_id] = reactor
+            self.channel_descs.append(desc)
+            self.node_info.channels.append(desc.chan_id)
+        reactor.set_switch(self)
+        return reactor
+
+    def reactor(self, name: str) -> Optional[Reactor]:
+        return self.reactors.get(name)
+
+    # --- lifecycle ----------------------------------------------------
+
+    async def start(self) -> None:
+        for r in self.reactors.values():
+            await r.start()
+        self._accept_task = asyncio.create_task(self._accept_routine())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._accept_task:
+            self._accept_task.cancel()
+        for t in self._reconnect_tasks.values():
+            t.cancel()
+        for r in self.reactors.values():
+            try:
+                await r.stop()
+            except Exception:
+                traceback.print_exc()
+        for p in list(self.peers.values()):
+            await self._remove_peer(p, None)
+        await self.transport.close()
+
+    # --- accept / dial ------------------------------------------------
+
+    async def _accept_routine(self) -> None:
+        while not self._stopped:
+            try:
+                sconn, their_info, conn_str = await self.transport.accept()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                traceback.print_exc()
+                await asyncio.sleep(0.1)
+                continue
+            if (
+                len(self.peers) >= self.max_peers
+                or their_info.node_id in self.peers
+                or their_info.node_id in self.banned
+                or their_info.node_id == self.node_info.node_id
+            ):
+                sconn.close()
+                continue
+            self._make_peer(sconn, their_info, conn_str, outbound=False)
+
+    async def dial_peer(
+        self, addr: str, peer_id: Optional[str] = None, persistent: bool = False
+    ) -> Optional[Peer]:
+        """addr forms: "id@host:port", "host:port", "mem://id"."""
+        if "@" in addr:
+            peer_id, _, addr = addr.partition("@")
+        if peer_id == self.node_info.node_id:
+            raise ValueError("cannot dial self")
+        if peer_id and (peer_id in self.peers or peer_id in self.banned):
+            return self.peers.get(peer_id)
+        if persistent and peer_id:
+            self.persistent_addrs[peer_id] = addr
+        try:
+            sconn, their_info, conn_str = await self.transport.dial(
+                addr, peer_id
+            )
+        except Exception as e:
+            if persistent and peer_id:
+                self._schedule_reconnect(peer_id)
+            raise e
+        if their_info.node_id == self.node_info.node_id:
+            sconn.close()
+            raise ValueError("dialed own address (self-connection)")
+        if their_info.node_id in self.peers:
+            sconn.close()
+            return self.peers[their_info.node_id]
+        return self._make_peer(
+            sconn, their_info, conn_str, outbound=True, persistent=persistent
+        )
+
+    def dial_peers_async(self, addrs: List[str], persistent: bool = False):
+        return [
+            asyncio.create_task(self._dial_ignore_err(a, persistent))
+            for a in addrs
+        ]
+
+    async def _dial_ignore_err(self, addr: str, persistent: bool):
+        try:
+            await self.dial_peer(addr, persistent=persistent)
+        except Exception:
+            pass
+
+    # --- peer management ----------------------------------------------
+
+    def _make_peer(
+        self, sconn, their_info, conn_str, outbound, persistent=False
+    ) -> Peer:
+        channels = [
+            (d.chan_id, d.priority, d.max_msg_size)
+            for d in self.channel_descs
+        ]
+        peer = Peer(
+            sconn,
+            their_info,
+            conn_str,
+            channels,
+            on_receive=self._on_peer_msg,
+            on_error=self._on_peer_error,
+            outbound=outbound,
+            persistent=persistent
+            or their_info.node_id in self.persistent_addrs,
+            mconn_config=self.mconn_config,
+        )
+        self.peers[peer.peer_id] = peer
+        peer.start()
+        for r in self.reactors.values():
+            try:
+                r.add_peer(peer)
+            except Exception:
+                traceback.print_exc()
+        return peer
+
+    def _on_peer_msg(self, chan_id: int, msg: bytes, peer: Peer) -> None:
+        reactor = self.chan_to_reactor.get(chan_id)
+        if reactor is None:
+            self.stop_peer_for_error(
+                peer, ValueError(f"msg on unclaimed channel {chan_id:#x}")
+            )
+            return
+        try:
+            reactor.receive(chan_id, peer, msg)
+        except Exception as e:
+            traceback.print_exc()
+            self.stop_peer_for_error(peer, e)
+
+    def _on_peer_error(self, peer: Peer, exc: Exception) -> None:
+        self.stop_peer_for_error(peer, exc)
+
+    def stop_peer_for_error(self, peer: Peer, exc: Optional[Exception]):
+        asyncio.ensure_future(self._remove_peer(peer, exc, reconnect=True))
+
+    async def stop_peer_gracefully(self, peer: Peer):
+        await self._remove_peer(peer, None, reconnect=False)
+
+    async def _remove_peer(self, peer, exc, reconnect=False) -> None:
+        if self.peers.get(peer.peer_id) is not peer:
+            return
+        del self.peers[peer.peer_id]
+        for r in self.reactors.values():
+            try:
+                r.remove_peer(peer, exc)
+            except Exception:
+                traceback.print_exc()
+        await peer.stop()
+        if reconnect and peer.persistent and not self._stopped:
+            self._schedule_reconnect(peer.peer_id)
+
+    def ban_peer(self, peer_id: str) -> None:
+        self.banned.add(peer_id)
+        p = self.peers.get(peer_id)
+        if p:
+            asyncio.ensure_future(self._remove_peer(p, None))
+
+    def _schedule_reconnect(self, peer_id: str) -> None:
+        if peer_id in self._reconnect_tasks or self._stopped:
+            return
+        addr = self.persistent_addrs.get(peer_id)
+        if not addr:
+            return
+
+        async def routine():
+            try:
+                delay = RECONNECT_BASE_S
+                for _ in range(MAX_RECONNECT_ATTEMPTS):
+                    await asyncio.sleep(delay * (0.8 + 0.4 * random.random()))
+                    if self._stopped or peer_id in self.peers:
+                        return
+                    try:
+                        await self.dial_peer(addr, peer_id)
+                        return
+                    except Exception:
+                        delay = min(delay * 2, RECONNECT_MAX_S)
+            finally:
+                self._reconnect_tasks.pop(peer_id, None)
+
+        self._reconnect_tasks[peer_id] = asyncio.create_task(routine())
+
+    # --- broadcast ----------------------------------------------------
+
+    def broadcast(self, chan_id: int, msg: bytes) -> None:
+        for p in list(self.peers.values()):
+            p.try_send(chan_id, msg)
+
+    def num_peers(self) -> int:
+        return len(self.peers)
