@@ -23,9 +23,30 @@ class ValidatorUpdate:
 
 
 @dataclass
+class EventAttribute:
+    key: str
+    value: str
+    index: bool = True
+
+
+@dataclass
 class Event:
     type_: str
-    attributes: List[tuple] = field(default_factory=list)  # (key, value, index)
+    # EventAttribute or bare (key, value, index) tuples — use attr_kvi
+    attributes: List = field(default_factory=list)
+
+
+def attr_kvi(a) -> tuple:
+    """(key, value, index) from an EventAttribute or tuple."""
+    if isinstance(a, EventAttribute):
+        return a.key, a.value, a.index
+    k, v = a[0], a[1]
+    idx = a[2] if len(a) > 2 else True
+    if isinstance(k, bytes):
+        k = k.decode()
+    if isinstance(v, bytes):
+        v = v.decode()
+    return k, v, bool(idx)
 
 
 @dataclass

@@ -68,6 +68,7 @@ class BlockSyncNetReactor(Reactor):
         on_caught_up: Optional[Callable] = None,
         block_ingestor=None,  # fork: adaptive sync
         active: bool = True,
+        local_blocks_chain=None,
     ):
         super().__init__()
         self.block_store = block_store
@@ -77,6 +78,7 @@ class BlockSyncNetReactor(Reactor):
             block_store,
             on_caught_up=self._caught_up,
             block_ingestor=block_ingestor,
+            local_blocks_chain=local_blocks_chain,
         )
         self.on_caught_up = on_caught_up
         # active=False: full node already caught up, only SERVES blocks

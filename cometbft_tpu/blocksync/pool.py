@@ -55,6 +55,7 @@ class BlockPool:
         self._tasks: Dict[int, asyncio.Task] = {}
         self._new_block = asyncio.Event()
         self._stopped = False
+        self.start_time = time.monotonic()
 
     # --- peers --------------------------------------------------------
 
@@ -184,8 +185,18 @@ class BlockPool:
         self.start_requesters()
 
     def is_caught_up(self) -> bool:
+        """Reference blocksync/pool.go:227 IsCaughtUp: at least one
+        peer, either progress was made or we waited 5s, and our chain
+        reaches maxPeerHeight-1 (block H needs H+1's commit)."""
+        if not self.peers:
+            return False
+        received_or_timed_out = (
+            self.height > self.start_height
+            or time.monotonic() - self.start_time > 5.0
+        )
         mx = self.max_peer_height()
-        return bool(self.peers) and (mx == 0 or self.height >= mx)
+        longest = mx == 0 or self.height >= mx - 1
+        return received_or_timed_out and longest
 
     async def wait_for_block(self, timeout: float = 0.2) -> None:
         try:

@@ -40,6 +40,7 @@ class BlockSyncReactor:
         on_caught_up: Optional[Callable] = None,
         block_ingestor=None,  # fork: adaptive sync ingest hook
         verify_window: int = VERIFY_WINDOW,
+        local_blocks_chain=None,  # fn(state)->bool, reactor.go:448
     ):
         self.state = state
         self.block_exec = block_exec
@@ -49,6 +50,7 @@ class BlockSyncReactor:
         self.on_caught_up = on_caught_up
         self.ingestor = block_ingestor
         self.window = verify_window
+        self.local_blocks_chain = local_blocks_chain
         self.blocks_applied = 0
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
@@ -76,7 +78,13 @@ class BlockSyncReactor:
         while not self._stopped:
             if time.monotonic() - last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL_S:
                 last_switch_check = time.monotonic()
-                if self.pool.is_caught_up():
+                # switch when caught up, OR when blocksync cannot
+                # proceed without our own votes (we hold >=1/3 power,
+                # reference reactor.go:543 + localNodeBlocksTheChain)
+                if self.pool.is_caught_up() or (
+                    self.local_blocks_chain is not None
+                    and self.local_blocks_chain(self.state)
+                ):
                     if self.on_caught_up:
                         self.on_caught_up(self.state)
                     return

@@ -183,6 +183,8 @@ def test_config(root_dir: str = ".") -> Config:
     c.consensus.timeout_commit_s = 0.1
     c.consensus.peer_gossip_sleep_s = 0.01
     c.base.db_backend = "memdb"
+    c.rpc.laddr = "tcp://127.0.0.1:0"  # ephemeral port per test node
+    c.p2p.laddr = "tcp://127.0.0.1:0"
     return c
 
 

@@ -1,0 +1,70 @@
+"""HTTP light-block provider (reference light/provider/http).
+
+Fetches (header, commit, valset) triples from a full node's RPC using
+the lossless `*_b64` payloads, so every hash recomputes exactly.
+
+The light.Client Provider interface is synchronous; HTTP is async. The
+provider owns a dedicated background event loop thread and blocks the
+calling thread per request — safe from sync code and from OTHER event
+loops (never call it from the provider's own loop)."""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import threading
+from typing import Optional
+
+from ..rpc.client import HTTPClient, RPCClientError
+from .provider import LightBlockNotFound, Provider, ProviderError
+from .types import LightBlock
+
+
+class HTTPProvider(Provider):
+    def __init__(self, chain_id: str, base_url: str, timeout_s: float = 10.0):
+        self.chain_id = chain_id
+        self.base_url = base_url
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, daemon=True
+        )
+        self._thread.start()
+        self._client = HTTPClient(base_url, timeout_s=timeout_s)
+        self._timeout_s = timeout_s + 5.0
+
+    def _run(self, coro):
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return fut.result(self._timeout_s)
+
+    def light_block(self, height: int) -> LightBlock:
+        try:
+            return self._run(self._light_block(height or None))
+        except RPCClientError as e:
+            raise LightBlockNotFound(str(e))
+        except ProviderError:
+            raise
+        except Exception as e:
+            raise ProviderError(f"rpc failure: {e}")
+
+    async def _light_block(self, height: Optional[int]) -> LightBlock:
+        hdr, commit = await self._client.commit_decoded(height)
+        vals = await self._client.validators_decoded(hdr.height)
+        return LightBlock(header=hdr, commit=commit, validator_set=vals)
+
+    def report_evidence(self, ev) -> None:
+        try:
+            self._run(
+                self._client.call(
+                    "broadcast_evidence",
+                    evidence=base64.b64encode(ev.encode()).decode(),
+                )
+            )
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        try:
+            self._run(self._client.close())
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)

@@ -53,6 +53,8 @@ class NodeParts:
     block_exec: BlockExecutor
     cs: ConsensusState
     evpool: object = None
+    tx_indexer: object = None
+    block_indexer: object = None
 
 
 def build_node(
@@ -92,8 +94,18 @@ def build_node(
 
     event_bus = ev.EventBus()
     from ..evidence.pool import EvidencePool
+    from ..state.indexer import BlockIndexer, IndexerService, TxIndexer
 
     evpool = EvidencePool(kv.MemKV(), state_store, block_store)
+    # indexing is config-gated (reference [tx_index] indexer = "kv" |
+    # "null"); the kv indexer runs as a sync event listener — nodes
+    # that never serve tx_search should set "null" to keep the commit
+    # path free of indexing work
+    tx_indexer = block_indexer = None
+    if config.tx_index.indexer == "kv":
+        tx_indexer = TxIndexer(kv.MemKV())
+        block_indexer = BlockIndexer(kv.MemKV())
+        IndexerService(tx_indexer, block_indexer, event_bus).start()
     mempool = CListMempool(proxy.mempool)
     block_exec = BlockExecutor(
         state_store,
@@ -136,6 +148,8 @@ def build_node(
         block_exec=block_exec,
         cs=cs,
         evpool=evpool,
+        tx_indexer=tx_indexer,
+        block_indexer=block_indexer,
     )
 
 

@@ -89,6 +89,7 @@ class Node:
             on_caught_up=self._on_caught_up,
             block_ingestor=self.parts.cs if adaptive else None,
             active=blocksync_active,
+            local_blocks_chain=self._local_blocks_chain,
         )
         self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("mempool", self.mempool_reactor)
@@ -96,8 +97,24 @@ class Node:
         self.switch.add_reactor("blocksync", self.blocksync_reactor)
         self._adaptive = adaptive
         self._cs_started = False
+        self.rpc_server = None
 
     # --- phase switching ----------------------------------------------
+
+    def _local_blocks_chain(self, state) -> bool:
+        """True when our own validator holds >=1/3 voting power, so
+        blocksync cannot progress without our votes (reference
+        blocksync/reactor.go:448 localNodeBlocksTheChain)."""
+        pv = self.parts.privval
+        if pv is None:
+            return False
+        try:
+            _, val = state.validators.get_by_address(pv.pub_key().address())
+        except Exception:
+            return False
+        if val is None:
+            return False
+        return val.voting_power >= state.validators.total_voting_power() / 3
 
     def _on_caught_up(self, state) -> None:
         asyncio.ensure_future(self._switch_to_consensus(state))
@@ -123,6 +140,11 @@ class Node:
     async def start(self) -> None:
         await self.transport.listen(_strip_proto(self.config.p2p.laddr))
         await self.switch.start()
+        if self.config.rpc.laddr:
+            from ..rpc import Environment, RPCServer
+
+            self.rpc_server = RPCServer(Environment.from_node(self))
+            await self.rpc_server.start(_strip_proto(self.config.rpc.laddr))
         # consensus starts now unless a sync phase must complete first
         if not self.blocksync_reactor.active or self._adaptive:
             await self.parts.cs.start()
@@ -138,6 +160,8 @@ class Node:
             )
 
     async def stop(self) -> None:
+        if self.rpc_server is not None:
+            await self.rpc_server.stop()
         if self._cs_started:
             await self.parts.cs.stop()
         await self.switch.stop()

@@ -1,0 +1,8 @@
+"""JSON-RPC API layer (reference rpc/): HTTP + WebSocket server over
+the node's internals, and the matching client library."""
+
+from .client import HTTPClient
+from .env import Environment
+from .server import RPCServer
+
+__all__ = ["RPCServer", "Environment", "HTTPClient"]

@@ -1,0 +1,612 @@
+"""RPC route implementations (reference rpc/core/*.go, routes table at
+rpc/core/routes.go:15-62).
+
+Every handler takes (env, **params) and returns a JSON-able dict.
+Heights arrive as strings or ints (JSON-RPC clients send both)."""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import types as T
+from ..abci import types as abci
+from ..utils import codec
+from ..utils.pubsub_query import parse as parse_query
+from . import encoding as enc
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.data = data
+
+
+def _h(v, default=None) -> Optional[int]:
+    if v is None or v == "":
+        return default
+    return int(v)
+
+
+def _bool(v) -> bool:
+    """GET params arrive as strings; 'false'/'0'/'' are False."""
+    if isinstance(v, str):
+        return v.strip().lower() not in ("", "0", "false", "no")
+    return bool(v)
+
+
+def _page(v) -> int:
+    p = _h(v, 1) or 1
+    if p < 1:
+        raise RPCError(-32602, f"page must be >= 1, got {p}")
+    return p
+
+
+def _bytes_param(v) -> bytes:
+    """Accept hex (0x... or bare) or base64."""
+    if v is None:
+        return b""
+    if isinstance(v, bytes):
+        return v
+    s = str(v)
+    if s.startswith("0x"):
+        return bytes.fromhex(s[2:])
+    try:
+        return bytes.fromhex(s)
+    except ValueError:
+        return base64.b64decode(s)
+
+
+def _latest_height(env) -> int:
+    return env.block_store.height()
+
+
+def _norm_height(env, height) -> int:
+    h = _h(height)
+    if h is None:
+        return _latest_height(env)
+    if h <= 0:
+        raise RPCError(-32603, f"height must be positive, got {h}")
+    if h > _latest_height(env):
+        raise RPCError(
+            -32603,
+            f"height {h} is ahead of the latest height {_latest_height(env)}",
+        )
+    return h
+
+
+# --- info routes --------------------------------------------------------
+
+
+def health(env) -> Dict[str, Any]:
+    return {}
+
+
+def status(env) -> Dict[str, Any]:
+    bs = env.block_store
+    latest = bs.height()
+    meta = bs.load_block_meta(latest) if latest else None
+    state = env.state_store.load()
+    pub = env.privval_pubkey
+    return {
+        "node_info": {
+            "id": env.node_info.node_id if env.node_info else "",
+            "network": env.chain_id,
+            "moniker": env.node_info.moniker if env.node_info else "",
+            "version": env.node_info.version if env.node_info else "",
+            "listen_addr": env.node_info.listen_addr if env.node_info else "",
+        },
+        "sync_info": {
+            "latest_block_height": str(latest),
+            "latest_block_hash": enc.hexb(meta.block_id.hash) if meta else "",
+            "latest_app_hash": enc.hexb(state.app_hash) if state else "",
+            "latest_block_time_ns": str(meta.header.time_ns) if meta else "0",
+            "earliest_block_height": str(bs.base()),
+            "catching_up": bool(
+                env.consensus_state is None
+                or getattr(env.consensus_state, "queue", None) is None
+            ),
+        },
+        "validator_info": {
+            "address": enc.hexb(pub.address()) if pub else "",
+            "pub_key": {
+                "type": pub.type_,
+                "value": enc.b64(bytes(pub)),
+            }
+            if pub
+            else None,
+            "voting_power": str(
+                _own_power(state, pub) if state and pub else 0
+            ),
+        },
+    }
+
+
+def _own_power(state, pub) -> int:
+    try:
+        _, val = state.validators.get_by_address(pub.address())
+        return val.voting_power if val else 0
+    except Exception:
+        return 0
+
+
+def net_info(env) -> Dict[str, Any]:
+    sw = env.switch
+    peers = list(sw.peers.values()) if sw else []
+    return {
+        "listening": bool(sw),
+        "listeners": [sw.transport.listen_addr] if sw else [],
+        "n_peers": str(len(peers)),
+        "peers": [
+            {
+                "node_info": {
+                    "id": p.peer_id,
+                    "moniker": p.node_info.moniker,
+                    "network": p.node_info.network,
+                    "listen_addr": p.node_info.listen_addr,
+                },
+                "is_outbound": p.outbound,
+                "remote_ip": p.conn_str,
+            }
+            for p in peers
+        ],
+    }
+
+
+def genesis(env) -> Dict[str, Any]:
+    import json
+
+    return {"genesis": json.loads(env.genesis.to_json())}
+
+
+def genesis_chunked(env, chunk=0) -> Dict[str, Any]:
+    data = env.genesis.to_json().encode()
+    size = 16 * 1024
+    chunks = [data[i : i + size] for i in range(0, len(data), size)] or [b""]
+    c = _h(chunk, 0)
+    if not 0 <= c < len(chunks):
+        raise RPCError(-32603, f"chunk {c} out of range [0,{len(chunks)})")
+    return {
+        "chunk": str(c),
+        "total": str(len(chunks)),
+        "data": enc.b64(chunks[c]),
+    }
+
+
+# --- block routes -------------------------------------------------------
+
+
+def blockchain(env, minHeight=None, maxHeight=None) -> Dict[str, Any]:
+    latest = _latest_height(env)
+    max_h = min(_h(maxHeight, latest) or latest, latest)
+    min_h = max(_h(minHeight, 1) or 1, env.block_store.base())
+    max_h = max(min_h, max_h)
+    metas = []
+    for h in range(max_h, min_h - 1, -1):
+        if len(metas) >= 20:
+            break
+        meta = env.block_store.load_block_meta(h)
+        if meta is None:
+            continue
+        metas.append(
+            {
+                "block_id": enc.block_id_json(meta.block_id),
+                "block_size": str(meta.block_size),
+                "header": enc.header_json(meta.header),
+                "num_txs": str(meta.num_txs),
+            }
+        )
+    return {"last_height": str(latest), "block_metas": metas}
+
+
+def block(env, height=None) -> Dict[str, Any]:
+    h = _norm_height(env, height)
+    blk = env.block_store.load_block(h)
+    if blk is None:
+        raise RPCError(-32603, f"block at height {h} not found")
+    commit = env.block_store.load_seen_commit(
+        h
+    ) or env.block_store.load_block_commit(h)
+    return {
+        "block_id": enc.block_id_json(
+            T.BlockID(blk.hash(), T.PartSet.from_data(
+                codec.encode_block(blk)).header)
+        ),
+        "block": enc.block_json(blk),
+        "block_b64": enc.b64(codec.encode_block(blk)),
+        "commit_b64": enc.b64(codec.encode_commit(commit)) if commit else "",
+    }
+
+
+def block_by_hash(env, hash=None) -> Dict[str, Any]:
+    blk = env.block_store.load_block_by_hash(_bytes_param(hash))
+    if blk is None:
+        raise RPCError(-32603, "block not found")
+    return block(env, blk.height)
+
+
+def header(env, height=None) -> Dict[str, Any]:
+    h = _norm_height(env, height)
+    blk = env.block_store.load_block(h)
+    if blk is None:
+        raise RPCError(-32603, f"header at height {h} not found")
+    return {
+        "header": enc.header_json(blk.header),
+        "header_b64": enc.b64(codec.encode_header(blk.header)),
+    }
+
+
+def header_by_hash(env, hash=None) -> Dict[str, Any]:
+    blk = env.block_store.load_block_by_hash(_bytes_param(hash))
+    if blk is None:
+        raise RPCError(-32603, "header not found")
+    return header(env, blk.height)
+
+
+def commit(env, height=None) -> Dict[str, Any]:
+    h = _norm_height(env, height)
+    blk = env.block_store.load_block(h)
+    # canonical = the immutable commit from block h+1's LastCommit;
+    # at the store tip only the mutable seen commit exists
+    # (reference rpc/core/blocks.go Commit)
+    cm = env.block_store.load_block_commit(h)
+    canonical = cm is not None
+    if cm is None:
+        cm = env.block_store.load_seen_commit(h)
+    if blk is None or cm is None:
+        raise RPCError(-32603, f"commit for height {h} not found")
+    return {
+        "signed_header": {
+            "header": enc.header_json(blk.header),
+            "commit": enc.commit_json(cm),
+        },
+        "header_b64": enc.b64(codec.encode_header(blk.header)),
+        "commit_b64": enc.b64(codec.encode_commit(cm)),
+        "canonical": canonical,
+    }
+
+
+def block_results(env, height=None) -> Dict[str, Any]:
+    h = _norm_height(env, height)
+    raw = env.state_store.load_finalize_block_response(h)
+    if raw is None:
+        raise RPCError(-32603, f"no results for height {h}")
+    from ..state.execution import decode_finalize_response
+
+    resp = decode_finalize_response(raw)
+    return {
+        "height": str(h),
+        "txs_results": [enc.tx_result_json(r) for r in resp.tx_results],
+        "finalize_block_events": [],
+        "app_hash": enc.hexb(resp.app_hash),
+        "validator_updates": [
+            {"power": str(u.power), "pub_key_type": u.pub_key_type,
+             "pub_key": enc.b64(u.pub_key_bytes)}
+            for u in resp.validator_updates
+        ],
+    }
+
+
+def validators(env, height=None, page=1, per_page=30) -> Dict[str, Any]:
+    h = _norm_height(env, height)
+    vs = env.state_store.load_validators(h)
+    if vs is None:
+        raise RPCError(-32603, f"no validator set at height {h}")
+    page, per_page = _page(page), min(_h(per_page, 30) or 30, 100)
+    vals = vs.validators
+    start = (page - 1) * per_page
+    return {
+        "block_height": str(h),
+        "validators": [
+            enc.validator_json(v) for v in vals[start : start + per_page]
+        ],
+        "count": str(min(per_page, max(0, len(vals) - start))),
+        "total": str(len(vals)),
+        "validator_set_b64": enc.b64(codec.encode_validator_set(vs)),
+    }
+
+
+# --- consensus routes ---------------------------------------------------
+
+
+def consensus_state(env) -> Dict[str, Any]:
+    cs = env.consensus_state
+    if cs is None:
+        raise RPCError(-32603, "consensus state not available")
+    rs = cs.rs
+    return {
+        "round_state": {
+            "height": str(rs.height),
+            "round": rs.round,
+            "step": int(rs.step),
+            "proposal": rs.proposal is not None,
+            "proposal_block": rs.proposal_block is not None,
+            "locked_round": rs.locked_round,
+            "valid_round": rs.valid_round,
+        }
+    }
+
+
+def dump_consensus_state(env) -> Dict[str, Any]:
+    out = consensus_state(env)
+    sw = env.switch
+    out["peers"] = [
+        {
+            "node_address": p.conn_str,
+            "peer_state": {
+                "round_state": vars(p.get("prs"))
+                if p.get("prs") is not None and hasattr(p.get("prs"), "height")
+                else {},
+            },
+        }
+        for p in (sw.peers.values() if sw else [])
+    ]
+    # sets are not JSON-able; flatten
+    for p in out["peers"]:
+        prs = p["peer_state"]["round_state"]
+        if prs:
+            p["peer_state"]["round_state"] = {
+                "height": prs.get("height"),
+                "round": prs.get("round"),
+                "step": prs.get("step"),
+            }
+    return out
+
+
+def consensus_params(env, height=None) -> Dict[str, Any]:
+    h = _norm_height(env, height)
+    state = env.state_store.load()
+    cp = state.consensus_params
+    return {
+        "block_height": str(h),
+        "consensus_params": {
+            "block": {
+                "max_bytes": str(cp.block.max_bytes),
+                "max_gas": str(cp.block.max_gas),
+            },
+            "validator": {
+                "pub_key_types": list(cp.validator.pub_key_types)
+            },
+            "evidence": {
+                "max_age_num_blocks": str(cp.evidence.max_age_num_blocks),
+                "max_age_duration_ns": str(cp.evidence.max_age_duration_ns),
+                "max_bytes": str(cp.evidence.max_bytes),
+            },
+            "abci": {
+                "vote_extensions_enable_height": str(
+                    cp.abci.vote_extensions_enable_height
+                ),
+            },
+        },
+    }
+
+
+# --- mempool routes -----------------------------------------------------
+
+
+def unconfirmed_txs(env, limit=30) -> Dict[str, Any]:
+    lim = min(_h(limit, 30) or 30, 100)
+    txs = env.mempool.iter_txs()[:lim]
+    return {
+        "n_txs": str(len(txs)),
+        "total": str(env.mempool.size()),
+        "total_bytes": str(sum(len(t) for t in txs)),
+        "txs": [enc.b64(t) for t in txs],
+    }
+
+
+def num_unconfirmed_txs(env) -> Dict[str, Any]:
+    return {
+        "n_txs": str(env.mempool.size()),
+        "total": str(env.mempool.size()),
+        "total_bytes": "0",
+    }
+
+
+def check_tx(env, tx=None) -> Dict[str, Any]:
+    res = env.proxy.mempool.check_tx(
+        abci.RequestCheckTx(tx=_bytes_param(tx))
+    )
+    return {"code": res.code, "log": res.log, "gas_wanted": str(res.gas_wanted)}
+
+
+def broadcast_tx_async(env, tx=None) -> Dict[str, Any]:
+    raw = _bytes_param(tx)
+    env.mempool.check_tx(raw)
+    return {"code": 0, "data": "", "log": "", "hash": enc.hexb(_tx_hash(raw))}
+
+
+def broadcast_tx_sync(env, tx=None) -> Dict[str, Any]:
+    raw = _bytes_param(tx)
+    res = env.mempool.check_tx(raw)
+    return {
+        "code": res.code,
+        "data": "",
+        "log": res.log,
+        "hash": enc.hexb(_tx_hash(raw)),
+    }
+
+
+async def broadcast_tx_commit(env, tx=None, timeout_s: float = 10.0):
+    """Subscribe to the tx event, CheckTx, await inclusion (reference
+    rpc/core/mempool.go:70)."""
+    raw = _bytes_param(tx)
+    key = _tx_hash(raw)
+    bus = env.event_bus
+    sub = bus.subscribe(
+        lambda e: e.type_ == "Tx" and e.attrs.get("hash") == key.hex()
+    )
+    try:
+        res = env.mempool.check_tx(raw)
+        if res.code != 0:
+            return {
+                "check_tx": {"code": res.code, "log": res.log},
+                "tx_result": {},
+                "hash": enc.hexb(key),
+                "height": "0",
+            }
+        event = await asyncio.wait_for(sub.queue.get(), timeout_s)
+        return {
+            "check_tx": {"code": 0, "log": ""},
+            "tx_result": enc.tx_result_json(event.data["result"]),
+            "hash": enc.hexb(key),
+            "height": str(event.data["height"]),
+        }
+    except asyncio.TimeoutError:
+        raise RPCError(-32603, "timed out waiting for tx to be included")
+    finally:
+        sub.unsubscribe()
+
+
+def _tx_hash(tx: bytes) -> bytes:
+    import hashlib
+
+    return hashlib.sha256(tx).digest()
+
+
+def broadcast_evidence(env, evidence=None) -> Dict[str, Any]:
+    from ..evidence.types import decode_evidence
+
+    ev = decode_evidence(_bytes_param(evidence))
+    env.evidence_pool.add_evidence(ev)
+    return {"hash": enc.hexb(ev.hash())}
+
+
+# --- abci passthrough ---------------------------------------------------
+
+
+def abci_info(env) -> Dict[str, Any]:
+    res = env.proxy.query.info(abci.RequestInfo())
+    return {
+        "response": {
+            "data": res.data,
+            "version": res.version,
+            "app_version": str(res.app_version),
+            "last_block_height": str(res.last_block_height),
+            "last_block_app_hash": enc.b64(res.last_block_app_hash),
+        }
+    }
+
+
+def abci_query(env, path="", data=None, height=0, prove=False) -> Dict[str, Any]:
+    res = env.proxy.query.query(
+        abci.RequestQuery(
+            data=_bytes_param(data),
+            path=str(path or ""),
+            height=_h(height, 0) or 0,
+            prove=_bool(prove),
+        )
+    )
+    return {
+        "response": {
+            "code": res.code,
+            "log": res.log,
+            "key": enc.b64(res.key) if res.key else "",
+            "value": enc.b64(res.value) if res.value else "",
+            "height": str(res.height),
+        }
+    }
+
+
+# --- tx / block search (indexer-backed) ---------------------------------
+
+
+def tx(env, hash=None, prove=False) -> Dict[str, Any]:
+    if env.tx_indexer is None:
+        raise RPCError(-32603, "tx indexing is disabled")
+    key = _bytes_param(hash)
+    res = env.tx_indexer.get(key)
+    if res is None:
+        raise RPCError(-32603, f"tx {key.hex()} not found")
+    height, index, tx_bytes, tx_result = res
+    return {
+        "hash": enc.hexb(key),
+        "height": str(height),
+        "index": index,
+        "tx_result": enc.tx_result_json(tx_result),
+        "tx": enc.b64(tx_bytes),
+    }
+
+
+def tx_search(
+    env, query="", prove=False, page=1, per_page=30, order_by="asc"
+) -> Dict[str, Any]:
+    if env.tx_indexer is None:
+        raise RPCError(-32603, "tx indexing is disabled")
+    q = parse_query(str(query))
+    hits = env.tx_indexer.search(q)
+    if str(order_by) == "desc":
+        hits = list(reversed(hits))
+    page, per_page = _page(page), min(_h(per_page, 30) or 30, 100)
+    start = (page - 1) * per_page
+    out = []
+    for height, index, tx_bytes, tx_result, key in hits[start : start + per_page]:
+        out.append(
+            {
+                "hash": enc.hexb(key),
+                "height": str(height),
+                "index": index,
+                "tx_result": enc.tx_result_json(tx_result),
+                "tx": enc.b64(tx_bytes),
+            }
+        )
+    return {"txs": out, "total_count": str(len(hits))}
+
+
+def block_search(env, query="", page=1, per_page=30, order_by="asc"):
+    if env.block_indexer is None:
+        raise RPCError(-32603, "block indexing is disabled")
+    q = parse_query(str(query))
+    heights = env.block_indexer.search(q)
+    if str(order_by) == "desc":
+        heights = list(reversed(heights))
+    page, per_page = _page(page), min(_h(per_page, 30) or 30, 100)
+    start = (page - 1) * per_page
+    blocks = []
+    for h in heights[start : start + per_page]:
+        blk = env.block_store.load_block(h)
+        if blk:
+            blocks.append(
+                {
+                    "block_id": enc.block_id_json(T.BlockID(blk.hash(), None)),
+                    "block": enc.block_json(blk),
+                }
+            )
+    return {"blocks": blocks, "total_count": str(len(heights))}
+
+
+# --- route table --------------------------------------------------------
+
+ROUTES = {
+    "health": health,
+    "status": status,
+    "net_info": net_info,
+    "genesis": genesis,
+    "genesis_chunked": genesis_chunked,
+    "blockchain": blockchain,
+    "block": block,
+    "block_by_hash": block_by_hash,
+    "header": header,
+    "header_by_hash": header_by_hash,
+    "commit": commit,
+    "block_results": block_results,
+    "validators": validators,
+    "consensus_state": consensus_state,
+    "dump_consensus_state": dump_consensus_state,
+    "consensus_params": consensus_params,
+    "unconfirmed_txs": unconfirmed_txs,
+    "num_unconfirmed_txs": num_unconfirmed_txs,
+    "check_tx": check_tx,
+    "broadcast_tx_async": broadcast_tx_async,
+    "broadcast_tx_sync": broadcast_tx_sync,
+    "broadcast_tx_commit": broadcast_tx_commit,
+    "broadcast_evidence": broadcast_evidence,
+    "abci_info": abci_info,
+    "abci_query": abci_query,
+    "tx": tx,
+    "tx_search": tx_search,
+    "block_search": block_search,
+}

@@ -1,0 +1,49 @@
+"""RPC Environment: the node internals the route handlers read
+(reference rpc/core/env.go + node/node.go:754-788 ConfigureRPC)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class Environment:
+    chain_id: str = ""
+    block_store: object = None
+    state_store: object = None
+    mempool: object = None
+    evidence_pool: object = None
+    consensus_state: object = None  # may be None (inspect mode)
+    event_bus: object = None
+    proxy: object = None  # AppConns
+    genesis: object = None
+    tx_indexer: object = None
+    block_indexer: object = None
+    switch: object = None  # p2p switch, may be None
+    node_info: object = None
+    privval_pubkey: object = None
+    config: object = None
+
+    @classmethod
+    def from_node(cls, node) -> "Environment":
+        p = node.parts
+        return cls(
+            chain_id=node.genesis.chain_id,
+            block_store=p.block_store,
+            state_store=p.state_store,
+            mempool=p.mempool,
+            evidence_pool=p.evpool,
+            consensus_state=p.cs,
+            event_bus=p.event_bus,
+            proxy=p.proxy,
+            genesis=node.genesis,
+            tx_indexer=getattr(p, "tx_indexer", None),
+            block_indexer=getattr(p, "block_indexer", None),
+            switch=node.switch,
+            node_info=node.node_info,
+            privval_pubkey=(
+                p.privval.pub_key() if p.privval is not None else None
+            ),
+            config=node.config,
+        )

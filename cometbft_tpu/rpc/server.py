@@ -1,0 +1,285 @@
+"""JSON-RPC 2.0 server over HTTP + WebSocket (reference
+rpc/jsonrpc/server/): POST bodies, GET URI params, and a `/websocket`
+endpoint with subscribe/unsubscribe event streaming backed by the
+node's EventBus and the pubsub query language."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import traceback
+from typing import Any, Dict, Optional
+
+from aiohttp import WSMsgType, web
+
+from ..types import events as ev
+from ..utils.pubsub_query import parse as parse_query
+from . import core
+from . import encoding as enc
+from .env import Environment
+
+
+def _rpc_response(id_, result=None, error=None) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"jsonrpc": "2.0", "id": id_}
+    if error is not None:
+        out["error"] = error
+    else:
+        out["result"] = result
+    return out
+
+
+def _rpc_error(code: int, message: str, data: str = "") -> Dict[str, Any]:
+    e: Dict[str, Any] = {"code": code, "message": message}
+    if data:
+        e["data"] = data
+    return e
+
+
+def _event_attrs(e: ev.Event) -> Dict[str, list]:
+    """Flatten an Event into query-matchable attributes, mirroring the
+    reference's composite keys (tm.event + abci event attributes)."""
+    attrs: Dict[str, list] = {"tm.event": [e.type_]}
+    for k, v in e.attrs.items():
+        attrs.setdefault(f"tm.{k}", []).append(str(v))
+    if e.type_ == ev.EVENT_TX and isinstance(e.data, dict):
+        attrs["tx.height"] = [str(e.data.get("height", ""))]
+        if "hash" in e.attrs:
+            attrs["tx.hash"] = [e.attrs["hash"].upper()]
+        result = e.data.get("result")
+        from ..abci.types import attr_kvi
+
+        for evt in getattr(result, "events", []) or []:
+            for a in evt.attributes:
+                k, v, _ = attr_kvi(a)
+                attrs.setdefault(f"{evt.type_}.{k}", []).append(v)
+    return attrs
+
+
+def _event_json(e: ev.Event) -> Dict[str, Any]:
+    if e.type_ == ev.EVENT_NEW_BLOCK and isinstance(e.data, dict):
+        return {
+            "type": "tendermint/event/NewBlock",
+            "value": {"block": enc.block_json(e.data["block"])},
+        }
+    if e.type_ == ev.EVENT_TX and isinstance(e.data, dict):
+        return {
+            "type": "tendermint/event/Tx",
+            "value": {
+                "TxResult": {
+                    "height": str(e.data["height"]),
+                    "index": e.data["index"],
+                    "tx": enc.b64(e.data["tx"]),
+                    "result": enc.tx_result_json(e.data["result"]),
+                }
+            },
+        }
+    return {"type": f"tendermint/event/{e.type_}", "value": {}}
+
+
+class RPCServer:
+    def __init__(self, env: Environment):
+        self.env = env
+        self.app = web.Application()
+        self.app.router.add_post("/", self._handle_post)
+        self.app.router.add_get("/websocket", self._handle_ws)
+        self.app.router.add_get("/{method}", self._handle_get)
+        self._runner: Optional[web.AppRunner] = None
+        self._site = None
+        self.listen_addr = ""
+
+    # --- lifecycle ----------------------------------------------------
+
+    async def start(self, addr: str) -> None:
+        host, _, port = addr.rpartition(":")
+        for p in ("tcp://", "http://"):
+            if host.startswith(p):
+                host = host[len(p):]
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, host or "127.0.0.1", int(port))
+        await self._site.start()
+        srv_sockets = self._site._server.sockets  # noqa: SLF001
+        h, p = srv_sockets[0].getsockname()[:2]
+        self.listen_addr = f"{h}:{p}"
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # --- dispatch -----------------------------------------------------
+
+    async def _call(self, method: str, params: Dict[str, Any]):
+        fn = core.ROUTES.get(method)
+        if fn is None:
+            raise core.RPCError(-32601, f"method {method!r} not found")
+        res = fn(self.env, **params)
+        if asyncio.iscoroutine(res):
+            res = await res
+        return res
+
+    async def _handle_post(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response(
+                _rpc_response(None, error=_rpc_error(-32700, "parse error"))
+            )
+        batch = body if isinstance(body, list) else [body]
+        out = []
+        for req in batch:
+            id_ = req.get("id")
+            try:
+                result = await self._call(
+                    req.get("method", ""), req.get("params") or {}
+                )
+                out.append(_rpc_response(id_, result))
+            except core.RPCError as e:
+                out.append(
+                    _rpc_response(id_, error=_rpc_error(e.code, str(e), e.data))
+                )
+            except TypeError as e:
+                out.append(
+                    _rpc_response(id_, error=_rpc_error(-32602, str(e)))
+                )
+            except Exception as e:
+                traceback.print_exc()
+                out.append(
+                    _rpc_response(
+                        id_, error=_rpc_error(-32603, f"internal: {e}")
+                    )
+                )
+        payload = out if isinstance(body, list) else out[0]
+        return web.json_response(payload)
+
+    async def _handle_get(self, request: web.Request) -> web.Response:
+        method = request.match_info["method"]
+        params = {k: v for k, v in request.query.items()}
+        # strip the reference's quoted-string URI convention
+        for k, v in params.items():
+            if len(v) >= 2 and v[0] == '"' and v[-1] == '"':
+                params[k] = v[1:-1]
+        try:
+            result = await self._call(method, params)
+            return web.json_response(_rpc_response(-1, result))
+        except core.RPCError as e:
+            return web.json_response(
+                _rpc_response(-1, error=_rpc_error(e.code, str(e), e.data))
+            )
+        except TypeError as e:
+            return web.json_response(
+                _rpc_response(-1, error=_rpc_error(-32602, str(e)))
+            )
+        except Exception as e:
+            traceback.print_exc()
+            return web.json_response(
+                _rpc_response(-1, error=_rpc_error(-32603, f"internal: {e}"))
+            )
+
+    # --- websocket subscriptions ---------------------------------------
+
+    async def _handle_ws(self, request: web.Request):
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        subs: Dict[str, tuple] = {}  # query string -> (Subscription, task)
+
+        async def pump(query_str: str, sub, sub_id):
+            try:
+                while True:
+                    event = await sub.queue.get()
+                    attrs = _event_attrs(event)
+                    if not sub.query_obj.matches(attrs):
+                        continue
+                    await ws.send_json(
+                        _rpc_response(
+                            sub_id,
+                            {
+                                "query": query_str,
+                                "data": _event_json(event),
+                                "events": attrs,
+                            },
+                        )
+                    )
+            except (asyncio.CancelledError, ConnectionError):
+                pass
+            except Exception:
+                traceback.print_exc()
+
+        try:
+            async for msg in ws:
+                if msg.type != WSMsgType.TEXT:
+                    continue
+                try:
+                    req = json.loads(msg.data)
+                except Exception:
+                    await ws.send_json(
+                        _rpc_response(
+                            None, error=_rpc_error(-32700, "parse error")
+                        )
+                    )
+                    continue
+                id_ = req.get("id")
+                method = req.get("method", "")
+                params = req.get("params") or {}
+                if method == "subscribe":
+                    qs = str(params.get("query", ""))
+                    if qs in subs:
+                        # reference errors on duplicate subscriptions;
+                        # silently replacing would leak the old one
+                        await ws.send_json(
+                            _rpc_response(
+                                id_,
+                                error=_rpc_error(
+                                    -32603, "already subscribed"
+                                ),
+                            )
+                        )
+                        continue
+                    try:
+                        q = parse_query(qs)
+                    except ValueError as e:
+                        await ws.send_json(
+                            _rpc_response(
+                                id_, error=_rpc_error(-32602, str(e))
+                            )
+                        )
+                        continue
+                    sub = self.env.event_bus.subscribe()
+                    sub.query_obj = q
+                    task = asyncio.create_task(pump(qs, sub, id_))
+                    subs[qs] = (sub, task)
+                    await ws.send_json(_rpc_response(id_, {}))
+                elif method == "unsubscribe":
+                    qs = str(params.get("query", ""))
+                    pair = subs.pop(qs, None)
+                    if pair:
+                        pair[0].unsubscribe()
+                        pair[1].cancel()
+                    await ws.send_json(_rpc_response(id_, {}))
+                elif method == "unsubscribe_all":
+                    for sub, task in subs.values():
+                        sub.unsubscribe()
+                        task.cancel()
+                    subs.clear()
+                    await ws.send_json(_rpc_response(id_, {}))
+                else:
+                    try:
+                        result = await self._call(method, params)
+                        await ws.send_json(_rpc_response(id_, result))
+                    except core.RPCError as e:
+                        await ws.send_json(
+                            _rpc_response(
+                                id_, error=_rpc_error(e.code, str(e))
+                            )
+                        )
+                    except Exception as e:
+                        traceback.print_exc()
+                        await ws.send_json(
+                            _rpc_response(
+                                id_, error=_rpc_error(-32603, str(e))
+                            )
+                        )
+        finally:
+            for sub, task in subs.values():
+                sub.unsubscribe()
+                task.cancel()
+        return ws

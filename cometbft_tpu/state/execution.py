@@ -298,7 +298,11 @@ class BlockExecutor:
             return
         self.event_bus.publish_type(
             ev.EVENT_NEW_BLOCK,
-            {"block": block, "block_id": block_id},
+            {
+                "block": block,
+                "block_id": block_id,
+                "result_events": resp.events,
+            },
             height=block.height,
         )
         self.event_bus.publish_type(

@@ -1,0 +1,301 @@
+"""Tx + block event indexing (reference state/txindex/kv/kv.go,
+state/indexer/block/kv, and the event-driven IndexerService at
+state/txindex/indexer_service.go:29).
+
+KV layout (order-preserving big-endian heights for prefix scans):
+  tx:h:<hash>                  -> record(height, index, tx, result)
+  tx:a:<key>=<value>:<height8>:<index4> -> tx hash   (attribute index)
+  blk:e:<key>=<value>:<height8>         -> b""       (block events)
+Search evaluates the pubsub query against the attribute index;
+height conditions constrain the scan range."""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+import threading
+from typing import List, Optional, Tuple
+
+from ..abci import types as abci
+from ..types import events as ev
+from ..utils import kv, proto
+from ..utils.pubsub_query import Query
+
+
+def _enc_record(height: int, index: int, tx: bytes, result) -> bytes:
+    from .execution import encode_finalize_response  # noqa: F401
+
+    res_b = _enc_tx_result(result)
+    return (
+        proto.field_varint(1, height)
+        + proto.field_varint(2, index + 1)
+        + proto.field_bytes(3, tx)
+        + proto.field_bytes(4, res_b)
+    )
+
+
+def _enc_tx_result(r) -> bytes:
+    out = (
+        proto.field_varint(1, r.code)
+        + proto.field_bytes(2, r.data)
+        + proto.field_string(3, r.log)
+        + proto.field_varint(4, r.gas_wanted)
+        + proto.field_varint(5, r.gas_used)
+    )
+    for e in r.events:
+        attrs = b""
+        for a in e.attributes:
+            k, v, idx = abci.attr_kvi(a)
+            attrs += proto.field_bytes(
+                2,
+                proto.field_string(1, k)
+                + proto.field_string(2, v)
+                + proto.field_varint(3, 1 if idx else 0),
+            )
+        out += proto.field_bytes(6, proto.field_string(1, e.type_) + attrs)
+    return out
+
+
+def _dec_tx_result(b: bytes) -> abci.ExecTxResult:
+    m = proto.parse(b)
+    events = []
+    for eb in m.get(6, []):
+        em = proto.parse(eb)
+        attrs = []
+        for ab in em.get(2, []):
+            am = proto.parse(ab)
+            attrs.append(
+                abci.EventAttribute(
+                    key=proto.get1(am, 1, b"").decode(),
+                    value=proto.get1(am, 2, b"").decode(),
+                    index=bool(proto.get1(am, 3, 0)),
+                )
+            )
+        events.append(
+            abci.Event(
+                type_=proto.get1(em, 1, b"").decode(), attributes=attrs
+            )
+        )
+    return abci.ExecTxResult(
+        code=proto.get1(m, 1, 0),
+        data=proto.get1(m, 2, b""),
+        log=proto.get1(m, 3, b"").decode(),
+        gas_wanted=proto.get1(m, 4, 0),
+        gas_used=proto.get1(m, 5, 0),
+        events=events,
+    )
+
+
+def _attr_key(key: str, value: str, height: int, index: int) -> bytes:
+    return (
+        b"tx:a:"
+        + key.encode()
+        + b"="
+        + value.encode()
+        + b":"
+        + struct.pack(">Q", height)
+        + struct.pack(">I", index)
+    )
+
+
+class TxIndexer:
+    """Indexes txs by hash + event attributes."""
+
+    def __init__(self, db: kv.KV):
+        self.db = db
+        self._lock = threading.Lock()
+
+    def index_tx(
+        self, height: int, index: int, tx: bytes, result: abci.ExecTxResult
+    ) -> None:
+        h = hashlib.sha256(tx).digest()
+        sets = [(b"tx:h:" + h, _enc_record(height, index, tx, result))]
+        # implicit attributes (reference: tx.height is always indexed)
+        sets.append((_attr_key("tx.height", str(height), height, index), h))
+        for e in result.events:
+            for a in e.attributes:
+                k, v, idx = abci.attr_kvi(a)
+                if not idx:
+                    continue
+                sets.append(
+                    (_attr_key(f"{e.type_}.{k}", v, height, index), h)
+                )
+        with self._lock:
+            self.db.write_batch(sets)
+
+    def get(self, tx_hash: bytes):
+        raw = self.db.get(b"tx:h:" + tx_hash)
+        if raw is None:
+            return None
+        m = proto.parse(raw)
+        return (
+            proto.get1(m, 1, 0),
+            proto.get1(m, 2, 1) - 1,
+            proto.get1(m, 3, b""),
+            _dec_tx_result(proto.get1(m, 4, b"")),
+        )
+
+    def search(self, q: Query) -> List[Tuple]:
+        """Returns [(height, index, tx, result, hash)] matching ALL
+        conditions, height/index ordered."""
+        # special case: tx.hash = '...' is a point lookup
+        for c in q.conditions:
+            if c.key == "tx.hash" and c.op == "=":
+                h = bytes.fromhex(str(c.value))
+                rec = self.get(h)
+                return [rec + (h,)] if rec else []
+        candidate_hashes: Optional[set] = None
+        scans = 0
+        for c in q.conditions:
+            matches = set()
+            if c.op == "=":
+                prefix = (
+                    b"tx:a:"
+                    + c.key.encode()
+                    + b"="
+                    + self._valstr(c.value).encode()
+                    + b":"
+                )
+                for k, v in self.db.iter_prefix(prefix):
+                    matches.add(bytes(v))
+            elif c.op == "CONTAINS":
+                prefix = b"tx:a:" + c.key.encode() + b"="
+                for k, v in self.db.iter_prefix(prefix):
+                    # substring-match only the VALUE portion of the
+                    # key (tail = value ':' height(8) index(4))
+                    if str(c.value).encode() in k[len(prefix):-13]:
+                        matches.add(bytes(v))
+            else:  # range ops incl. EXISTS: scan the key's entries
+                prefix = b"tx:a:" + c.key.encode() + b"="
+                for k, v in self.db.iter_prefix(prefix):
+                    if c.op == "EXISTS":
+                        matches.add(bytes(v))
+                        continue
+                    try:
+                        # key tail = <value> ':' height(8) index(4)
+                        val = float(k[len(prefix):-13])
+                    except ValueError:
+                        continue
+                    if (
+                        (c.op == "<" and val < c.value)
+                        or (c.op == ">" and val > c.value)
+                        or (c.op == "<=" and val <= c.value)
+                        or (c.op == ">=" and val >= c.value)
+                    ):
+                        matches.add(bytes(v))
+            scans += 1
+            candidate_hashes = (
+                matches
+                if candidate_hashes is None
+                else candidate_hashes & matches
+            )
+            if not candidate_hashes:
+                return []
+        out = []
+        for h in candidate_hashes or ():
+            rec = self.get(h)
+            if rec:
+                out.append(rec + (h,))
+        out.sort(key=lambda r: (r[0], r[1]))
+        return out
+
+    @staticmethod
+    def _valstr(v) -> str:
+        if isinstance(v, float) and v == int(v):
+            return str(int(v))
+        return str(v)
+
+
+class BlockIndexer:
+    """Indexes block-level events by height (reference
+    state/indexer/block/kv)."""
+
+    def __init__(self, db: kv.KV):
+        self.db = db
+
+    def index_block(self, height: int, events: List[abci.Event]) -> None:
+        sets = [
+            (
+                b"blk:e:block.height="
+                + str(height).encode()
+                + b":"
+                + struct.pack(">Q", height),
+                b"",
+            )
+        ]
+        for e in events:
+            for a in e.attributes:
+                k, v, idx = abci.attr_kvi(a)
+                if not idx:
+                    continue
+                sets.append(
+                    (
+                        b"blk:e:"
+                        + f"{e.type_}.{k}={v}".encode()
+                        + b":"
+                        + struct.pack(">Q", height),
+                        b"",
+                    )
+                )
+        self.db.write_batch(sets)
+
+    def search(self, q: Query) -> List[int]:
+        heights: Optional[set] = None
+        for c in q.conditions:
+            matches = set()
+            if c.op == "=":
+                prefix = (
+                    b"blk:e:"
+                    + c.key.encode()
+                    + b"="
+                    + TxIndexer._valstr(c.value).encode()
+                    + b":"
+                )
+                for k, _ in self.db.iter_prefix(prefix):
+                    matches.add(struct.unpack(">Q", k[-8:])[0])
+            else:
+                prefix = b"blk:e:" + c.key.encode() + b"="
+                for k, _ in self.db.iter_prefix(prefix):
+                    h = struct.unpack(">Q", k[-8:])[0]
+                    if c.op == "EXISTS":
+                        matches.add(h)
+                        continue
+                    try:
+                        val = float(k[len(prefix):-9])
+                    except ValueError:
+                        continue
+                    if (
+                        (c.op == "<" and val < c.value)
+                        or (c.op == ">" and val > c.value)
+                        or (c.op == "<=" and val <= c.value)
+                        or (c.op == ">=" and val >= c.value)
+                    ):
+                        matches.add(h)
+            heights = matches if heights is None else heights & matches
+            if not heights:
+                return []
+        return sorted(heights or ())
+
+
+class IndexerService:
+    """Event-bus-driven indexing (reference
+    state/txindex/indexer_service.go:29,43)."""
+
+    def __init__(self, tx_indexer: TxIndexer, block_indexer: BlockIndexer, event_bus):
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
+        self.bus = event_bus
+
+    def start(self) -> None:
+        self.bus.add_sync_listener(self._on_event)
+
+    def _on_event(self, e: ev.Event) -> None:
+        if e.type_ == ev.EVENT_TX and isinstance(e.data, dict):
+            self.tx_indexer.index_tx(
+                e.data["height"], e.data["index"], e.data["tx"], e.data["result"]
+            )
+        elif e.type_ == ev.EVENT_NEW_BLOCK and isinstance(e.data, dict):
+            blk = e.data["block"]
+            self.block_indexer.index_block(
+                blk.height, e.data.get("result_events") or []
+            )

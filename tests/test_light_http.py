@@ -1,0 +1,61 @@
+"""Light client bisection over the real RPC HTTP provider against a
+live node (reference analog: light/client_test.go + provider/http)."""
+
+import asyncio
+
+from cometbft_tpu.config.config import test_config as make_test_cfg
+from cometbft_tpu.light import Client, TrustOptions
+from cometbft_tpu.light.http_provider import HTTPProvider
+from cometbft_tpu.node.inprocess import make_genesis
+from cometbft_tpu.node.node import Node
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def test_light_bisection_over_http():
+    async def main():
+        gen, pvs = make_genesis(2, chain_id="light-http")
+        cfg = make_test_cfg(".")
+        n0 = Node(cfg, gen, privval=pvs[0])
+        n1 = Node(make_test_cfg("."), gen, privval=pvs[1])
+        await n0.start()
+        await n1.start()
+        await n0.dial(n1.listen_addr)
+        while n0.height < 6:
+            await asyncio.sleep(0.05)
+        trusted = n0.parts.block_store.load_block(1)
+        target_height = n0.height
+
+        provider = HTTPProvider("light-http", n0.rpc_server.listen_addr)
+        witness = HTTPProvider("light-http", n1.rpc_server.listen_addr)
+
+        def verify():
+            cli = Client(
+                "light-http",
+                TrustOptions(
+                    period_ns=3600 * 10**9,
+                    height=1,
+                    hash=trusted.hash(),
+                ),
+                primary=provider,
+                witnesses=[witness],
+            )
+            lb = cli.verify_light_block_at_height(
+                target_height, now_ns=None
+            )
+            return lb
+
+        # provider blocks its calling thread; run off the event loop
+        lb = await asyncio.to_thread(verify)
+        assert lb.height == target_height
+        assert bytes(lb.hash()) == bytes(
+            n0.parts.block_store.load_block(target_height).hash()
+        )
+        provider.close()
+        witness.close()
+        await n0.stop()
+        await n1.stop()
+
+    run(main())

@@ -8,7 +8,7 @@ import asyncio
 
 import pytest
 
-from cometbft_tpu.config.config import test_config
+from cometbft_tpu.config.config import test_config as make_test_cfg
 from cometbft_tpu.node.inprocess import make_genesis
 from cometbft_tpu.node.node import Node
 
@@ -20,7 +20,7 @@ def run(coro, timeout=120):
 
 
 def _mk_node(gen, pv, i, blocksync=False, adaptive=False):
-    cfg = test_config(".")
+    cfg = make_test_cfg(".")
     cfg.p2p.laddr = "tcp://127.0.0.1:0"
     cfg.base.moniker = f"node{i}"
     cfg.blocksync.enable = blocksync

@@ -1,0 +1,130 @@
+"""RPC server + client tests over a live single-node chain
+(reference analog: rpc/client/rpc_test.go)."""
+
+import asyncio
+import base64
+import hashlib
+
+import pytest
+
+from cometbft_tpu.config.config import test_config as make_test_cfg
+from cometbft_tpu.node.inprocess import make_genesis
+from cometbft_tpu.node.node import Node
+from cometbft_tpu.rpc.client import HTTPClient, RPCClientError
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+async def _single_node():
+    gen, pvs = make_genesis(1, chain_id="rpc-chain")
+    cfg = make_test_cfg(".")
+    node = Node(cfg, gen, privval=pvs[0])
+    await node.start()
+    while node.height < 2:
+        await asyncio.sleep(0.05)
+    return node, HTTPClient(node.rpc_server.listen_addr)
+
+
+def test_status_block_commit_validators():
+    async def main():
+        node, cli = await _single_node()
+        st = await cli.status()
+        assert st["node_info"]["network"] == "rpc-chain"
+        assert int(st["sync_info"]["latest_block_height"]) >= 2
+
+        blk = await cli.block_decoded(1)
+        assert blk.height == 1
+        assert bytes(blk.hash()) == bytes(
+            node.parts.block_store.load_block(1).hash()
+        )
+        hdr, cm = await cli.commit_decoded(1)
+        assert cm.height == 1 and hdr.height == 1
+        vs = await cli.validators_decoded(1)
+        assert vs.size() == 1
+        assert (
+            bytes(vs.hash())
+            == bytes(node.parts.state_store.load_validators(1).hash())
+        )
+        # error path: future height
+        with pytest.raises(RPCClientError):
+            await cli.block(10_000)
+        await cli.close()
+        await node.stop()
+
+    run(main())
+
+
+def test_broadcast_tx_commit_and_tx_query():
+    async def main():
+        node, cli = await _single_node()
+        tx = b"rpckey=rpcval"
+        res = await cli.broadcast_tx_commit(tx)
+        assert res["check_tx"]["code"] == 0
+        assert res["tx_result"]["code"] == 0
+        height = int(res["height"])
+        assert height >= 1
+        # tx route finds it by hash
+        txr = await cli.call("tx", hash=hashlib.sha256(tx).hexdigest())
+        assert int(txr["height"]) == height
+        assert base64.b64decode(txr["tx"]) == tx
+        # tx_search by height
+        sr = await cli.call("tx_search", query=f"tx.height={height}")
+        assert int(sr["total_count"]) >= 1
+        # abci_query sees the committed kv pair
+        q = await cli.abci_query("/store", b"rpckey")
+        assert base64.b64decode(q["response"]["value"] or "") == b"rpcval"
+        await cli.close()
+        await node.stop()
+
+    run(main())
+
+
+def test_ws_subscription_new_block():
+    async def main():
+        node, cli = await _single_node()
+        events = await cli.subscribe("tm.event='NewBlock'")
+        got = []
+        async for e in events:
+            got.append(e)
+            if len(got) >= 2:
+                break
+        assert all(
+            e["data"]["type"] == "tendermint/event/NewBlock" for e in got
+        )
+        heights = [
+            int(e["data"]["value"]["block"]["header"]["height"])
+            for e in got
+        ]
+        assert heights[1] == heights[0] + 1
+        await cli.close()
+        await node.stop()
+
+    run(main())
+
+
+def test_misc_routes():
+    async def main():
+        node, cli = await _single_node()
+        assert await cli.call("health") == {}
+        gen = await cli.call("genesis")
+        assert gen["genesis"]["chain_id"] == "rpc-chain"
+        ni = await cli.call("net_info")
+        assert ni["n_peers"] == "0"
+        bc = await cli.call("blockchain", minHeight="1", maxHeight="2")
+        assert len(bc["block_metas"]) == 2
+        cp = await cli.call("consensus_params")
+        assert int(cp["consensus_params"]["block"]["max_bytes"]) > 0
+        cs = await cli.call("consensus_state")
+        assert int(cs["round_state"]["height"]) >= 1
+        ab = await cli.call("abci_info")
+        assert int(ab["response"]["last_block_height"]) >= 1
+        ut = await cli.call("num_unconfirmed_txs")
+        assert "n_txs" in ut
+        with pytest.raises(RPCClientError):
+            await cli.call("nonexistent_route")
+        await cli.close()
+        await node.stop()
+
+    run(main())
