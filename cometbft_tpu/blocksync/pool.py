@@ -186,17 +186,13 @@ class BlockPool:
 
     def is_caught_up(self) -> bool:
         """Reference blocksync/pool.go:227 IsCaughtUp: at least one
-        peer, either progress was made or we waited 5s, and our chain
-        reaches maxPeerHeight-1 (block H needs H+1's commit)."""
+        peer (peers only exist once their status arrived, so heights
+        are known), and our chain reaches maxPeerHeight-1 (block H
+        needs H+1's commit to verify)."""
         if not self.peers:
             return False
-        received_or_timed_out = (
-            self.height > self.start_height
-            or time.monotonic() - self.start_time > 5.0
-        )
         mx = self.max_peer_height()
-        longest = mx == 0 or self.height >= mx - 1
-        return received_or_timed_out and longest
+        return mx == 0 or self.height >= mx - 1
 
     async def wait_for_block(self, timeout: float = 0.2) -> None:
         try:

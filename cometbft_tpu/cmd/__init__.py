@@ -1,0 +1,1 @@
+"""CLI command tree (reference cmd/cometbft/)."""

@@ -1,0 +1,549 @@
+"""`python -m cometbft_tpu` — the node CLI (reference
+cmd/cometbft/main.go:14-49 command registry).
+
+Commands: init, start, testnet, light, replay, rollback,
+reindex-event, reset / unsafe-reset-all, inspect, compact,
+gen-node-key, gen-validator, show-node-id, show-validator, version.
+
+Home layout (reference config directory conventions):
+  <home>/config/config.toml, genesis.json, node_key.json,
+               priv_validator_key.json
+  <home>/data/priv_validator_state.json, *.db, cs.wal/
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import signal
+import sys
+
+VERSION = "0.1.0"
+
+
+def _home(args) -> str:
+    return os.path.expanduser(args.home)
+
+
+def _paths(home: str) -> dict:
+    return {
+        "config": os.path.join(home, "config"),
+        "data": os.path.join(home, "data"),
+        "config_toml": os.path.join(home, "config", "config.toml"),
+        "genesis": os.path.join(home, "config", "genesis.json"),
+        "node_key": os.path.join(home, "config", "node_key.json"),
+        "pv_key": os.path.join(home, "config", "priv_validator_key.json"),
+        "pv_state": os.path.join(home, "data", "priv_validator_state.json"),
+    }
+
+
+def _load_config(home: str):
+    from ..config.config import default_config, load_toml
+
+    p = _paths(home)
+    if os.path.exists(p["config_toml"]):
+        cfg = load_toml(p["config_toml"])
+    else:
+        cfg = default_config(home)
+    cfg.root_dir = home
+    return cfg
+
+
+# --- init ----------------------------------------------------------------
+
+
+def cmd_init(args) -> int:
+    """Initialise a home dir: config, genesis (this node as sole
+    validator), node key, privval key (reference commands/init.go)."""
+    from .. import types as T
+    from ..config.config import default_config, write_toml
+    from ..p2p.key import NodeKey
+    from ..privval.file_pv import FilePV
+    from ..types.genesis import GenesisDoc
+
+    home = _home(args)
+    p = _paths(home)
+    os.makedirs(p["config"], exist_ok=True)
+    os.makedirs(p["data"], exist_ok=True)
+
+    cfg = default_config(home)
+    if not os.path.exists(p["config_toml"]):
+        write_toml(cfg, p["config_toml"])
+    pv = FilePV.load_or_generate(p["pv_key"], p["pv_state"])
+    nk = NodeKey.load_or_gen(p["node_key"])
+    if not os.path.exists(p["genesis"]):
+        gen = GenesisDoc(
+            chain_id=args.chain_id
+            or "test-chain-%s" % os.urandom(3).hex(),
+            validators=[T.Validator(pv.pub_key(), 10)],
+        )
+        with open(p["genesis"], "w") as f:
+            f.write(gen.to_json())
+        print(f"Generated genesis file {p['genesis']}")
+    print(f"Initialised node in {home} (node id {nk.node_id})")
+    return 0
+
+
+# --- start ---------------------------------------------------------------
+
+
+def cmd_start(args) -> int:
+    from ..node.node import Node
+    from ..p2p.key import NodeKey
+    from ..privval.file_pv import FilePV
+    from ..types.genesis import GenesisDoc
+
+    home = _home(args)
+    p = _paths(home)
+    cfg = _load_config(home)
+    with open(p["genesis"]) as f:
+        gen = GenesisDoc.from_json(f.read())
+    pv = (
+        FilePV.load(p["pv_key"], p["pv_state"])
+        if os.path.exists(p["pv_key"])
+        else None
+    )
+    nk = NodeKey.load_or_gen(p["node_key"])
+
+    async def main():
+        node = Node(
+            cfg, gen, privval=pv, node_key=nk, home=os.path.join(home, "data")
+        )
+        await node.start()
+        print(
+            f"Node {nk.node_id} started: p2p {node.listen_addr}, "
+            f"rpc {node.rpc_server.listen_addr if node.rpc_server else '-'}"
+        )
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        print("shutting down...")
+        await node.stop()
+
+    asyncio.run(main())
+    return 0
+
+
+# --- key/identity helpers ------------------------------------------------
+
+
+def cmd_gen_node_key(args) -> int:
+    from ..p2p.key import NodeKey
+
+    home = _home(args)
+    nk = NodeKey.load_or_gen(_paths(home)["node_key"])
+    print(nk.node_id)
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from ..p2p.key import NodeKey
+
+    nk = NodeKey.load(_paths(_home(args))["node_key"])
+    print(nk.node_id)
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    from ..privval.file_pv import FilePV
+
+    p = _paths(_home(args))
+    os.makedirs(p["config"], exist_ok=True)
+    os.makedirs(p["data"], exist_ok=True)
+    pv = FilePV.load_or_generate(p["pv_key"], p["pv_state"])
+    print(
+        json.dumps(
+            {
+                "address": pv.pub_key().address().hex().upper(),
+                "pub_key": {
+                    "type": pv.pub_key().type_,
+                    "value": bytes(pv.pub_key()).hex(),
+                },
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from ..privval.file_pv import FilePV
+
+    p = _paths(_home(args))
+    pv = FilePV.load(p["pv_key"], p["pv_state"])
+    print(
+        json.dumps(
+            {
+                "type": pv.pub_key().type_,
+                "value": bytes(pv.pub_key()).hex(),
+            }
+        )
+    )
+    return 0
+
+
+# --- testnet -------------------------------------------------------------
+
+
+def cmd_testnet(args) -> int:
+    """Generate a multi-node testnet directory tree (reference
+    commands/testnet.go)."""
+    from .. import types as T
+    from ..config.config import default_config, write_toml
+    from ..p2p.key import NodeKey
+    from ..privval.file_pv import FilePV
+    from ..types.genesis import GenesisDoc
+
+    out = os.path.expanduser(args.o)
+    n = args.v
+    pvs, nks = [], []
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        p = _paths(home)
+        os.makedirs(p["config"], exist_ok=True)
+        os.makedirs(p["data"], exist_ok=True)
+        pvs.append(FilePV.load_or_generate(p["pv_key"], p["pv_state"]))
+        nks.append(NodeKey.load_or_gen(p["node_key"]))
+    gen = GenesisDoc(
+        chain_id=args.chain_id or "testnet-%s" % os.urandom(3).hex(),
+        validators=[T.Validator(pv.pub_key(), 10) for pv in pvs],
+    )
+    base_p2p = args.starting_port
+    peers = ",".join(
+        f"{nks[i].node_id}@127.0.0.1:{base_p2p + 2 * i}" for i in range(n)
+    )
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        p = _paths(home)
+        cfg = default_config(home)
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{base_p2p + 2 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{base_p2p + 2 * i + 1}"
+        cfg.p2p.persistent_peers = ",".join(
+            pr
+            for j, pr in enumerate(peers.split(","))
+            if j != i
+        )
+        cfg.base.moniker = f"node{i}"
+        write_toml(cfg, p["config_toml"])
+        with open(p["genesis"], "w") as f:
+            f.write(gen.to_json())
+    print(f"Wrote {n}-node testnet to {out} (chain {gen.chain_id})")
+    return 0
+
+
+# --- maintenance ---------------------------------------------------------
+
+
+def cmd_reset(args, all_: bool = False) -> int:
+    """Delete data (blocks/state/WAL) and reset privval height state
+    (reference commands/reset.go). unsafe-reset-all also removes the
+    address book."""
+    from ..privval.file_pv import FilePV
+
+    home = _home(args)
+    p = _paths(home)
+    data = p["data"]
+    if os.path.isdir(data):
+        for name in os.listdir(data):
+            if name == "priv_validator_state.json":
+                continue
+            full = os.path.join(data, name)
+            shutil.rmtree(full, ignore_errors=True) if os.path.isdir(
+                full
+            ) else os.remove(full)
+    if os.path.exists(p["pv_key"]):
+        pv = FilePV.load(p["pv_key"], p["pv_state"])
+        pv.last = type(pv.last)()  # zero sign-state
+        pv.save_state()
+    print(f"Reset data in {data}")
+    return 0
+
+
+def cmd_rollback(args) -> int:
+    from ..state.rollback import rollback_state
+    from ..state.store import Store as StateStore
+    from ..store.block_store import BlockStore
+    from ..utils import kv
+
+    home = _home(args)
+    cfg = _load_config(home)
+    data = os.path.join(home, "data")
+    block_db = kv.open_kv("sqlite", os.path.join(data, "blockstore.db"))
+    state_db = kv.open_kv("sqlite", os.path.join(data, "state.db"))
+    st = rollback_state(
+        StateStore(state_db), BlockStore(block_db), remove_block=args.hard
+    )
+    print(
+        f"Rolled back state to height {st.last_block_height} "
+        f"(app_hash {st.app_hash.hex()[:16]})"
+    )
+    block_db.close()
+    state_db.close()
+    return 0
+
+
+def cmd_compact(args) -> int:
+    import sqlite3
+
+    home = _home(args)
+    data = os.path.join(home, "data")
+    n = 0
+    for name in os.listdir(data) if os.path.isdir(data) else []:
+        if name.endswith(".db"):
+            con = sqlite3.connect(os.path.join(data, name))
+            con.execute("VACUUM")
+            con.close()
+            n += 1
+    print(f"Compacted {n} sqlite databases")
+    return 0
+
+
+def cmd_reindex_event(args) -> int:
+    """Rebuild tx/block indexes from stored blocks + finalize
+    responses (reference commands/reindex_event.go)."""
+    from ..state.execution import decode_finalize_response
+    from ..state.indexer import BlockIndexer, TxIndexer
+    from ..state.store import Store as StateStore
+    from ..store.block_store import BlockStore
+    from ..utils import kv
+
+    home = _home(args)
+    data = os.path.join(home, "data")
+    block_db = kv.open_kv("sqlite", os.path.join(data, "blockstore.db"))
+    state_db = kv.open_kv("sqlite", os.path.join(data, "state.db"))
+    index_db = kv.open_kv("sqlite", os.path.join(data, "tx_index.db"))
+    bs, ss = BlockStore(block_db), StateStore(state_db)
+    txi, bli = TxIndexer(index_db), BlockIndexer(index_db)
+    start = args.start_height or bs.base()
+    end = args.end_height or bs.height()
+    count = 0
+    for h in range(start, end + 1):
+        blk = bs.load_block(h)
+        raw = ss.load_finalize_block_response(h)
+        if blk is None or raw is None:
+            continue
+        resp = decode_finalize_response(raw)
+        for i, tx in enumerate(blk.data.txs):
+            if i < len(resp.tx_results):
+                txi.index_tx(h, i, tx, resp.tx_results[i])
+        bli.index_block(h, resp.events)
+        count += 1
+    print(f"Reindexed {count} blocks [{start},{end}]")
+    for db in (block_db, state_db, index_db):
+        db.close()
+    return 0
+
+
+def cmd_replay(args) -> int:
+    """Re-execute stored blocks against a fresh app instance via the
+    handshake replay path (reference commands/replay.go)."""
+    from ..node.inprocess import build_node
+    from ..types.genesis import GenesisDoc
+
+    home = _home(args)
+    p = _paths(home)
+    cfg = _load_config(home)
+    with open(p["genesis"]) as f:
+        gen = GenesisDoc.from_json(f.read())
+    parts = build_node(
+        gen, None, config=cfg, home=os.path.join(home, "data")
+    )
+    print(
+        f"Replayed to height {parts.state.last_block_height} "
+        f"(app_hash {parts.state.app_hash.hex()[:16]})"
+    )
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    """Read-only RPC over the data dirs of a stopped node (reference
+    inspect/inspect.go:32)."""
+    from ..rpc.env import Environment
+    from ..rpc.server import RPCServer
+    from ..state.store import Store as StateStore
+    from ..store.block_store import BlockStore
+    from ..types import events as ev
+    from ..types.genesis import GenesisDoc
+    from ..utils import kv
+
+    home = _home(args)
+    p = _paths(home)
+    cfg = _load_config(home)
+    data = os.path.join(home, "data")
+    with open(p["genesis"]) as f:
+        gen = GenesisDoc.from_json(f.read())
+    env = Environment(
+        chain_id=gen.chain_id,
+        block_store=BlockStore(
+            kv.open_kv("sqlite", os.path.join(data, "blockstore.db"))
+        ),
+        state_store=StateStore(
+            kv.open_kv("sqlite", os.path.join(data, "state.db"))
+        ),
+        event_bus=ev.EventBus(),
+        genesis=gen,
+        config=cfg,
+    )
+
+    async def main():
+        srv = RPCServer(env)
+        await srv.start(args.rpc_laddr)
+        print(f"Inspect RPC serving on {srv.listen_addr} (read-only)")
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await srv.stop()
+
+    asyncio.run(main())
+    return 0
+
+
+def cmd_light(args) -> int:
+    """Light client daemon: bisection-verify new headers from a
+    primary against witnesses (reference cmd light + light/proxy)."""
+    from ..light import Client, TrustOptions
+    from ..light.http_provider import HTTPProvider
+
+    primary = HTTPProvider(args.chain_id, args.primary)
+    witnesses = [
+        HTTPProvider(args.chain_id, w)
+        for w in (args.witnesses.split(",") if args.witnesses else [])
+        if w
+    ]
+    cli = Client(
+        args.chain_id,
+        TrustOptions(
+            period_ns=int(args.trust_period_h * 3600 * 1e9),
+            height=args.trust_height,
+            hash=bytes.fromhex(args.trust_hash),
+        ),
+        primary=primary,
+        witnesses=witnesses,
+    )
+    import time as _t
+
+    print(f"light client tracking {args.chain_id} via {args.primary}")
+    try:
+        while True:
+            lb = cli.update()
+            if lb is not None:
+                print(
+                    f"verified height {lb.height} "
+                    f"hash {lb.hash().hex()[:16]}"
+                )
+            _t.sleep(args.interval_s)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_version(args) -> int:
+    print(f"cometbft-tpu v{VERSION}")
+    return 0
+
+
+# --- parser --------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="cometbft-tpu",
+        description="TPU-native BFT consensus engine",
+    )
+    ap.add_argument(
+        "--home",
+        default=os.environ.get("CMTHOME", "~/.cometbft-tpu"),
+        help="node home directory",
+    )
+    sub = ap.add_subparsers(dest="command")
+
+    p = sub.add_parser("init", help="initialise a node home dir")
+    p.add_argument("--chain-id", default="")
+    p.set_defaults(fn=cmd_init)
+
+    p = sub.add_parser("start", help="run the node")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("testnet", help="generate a local testnet")
+    p.add_argument("--v", type=int, default=4, help="number of validators")
+    p.add_argument("--o", default="./mytestnet", help="output directory")
+    p.add_argument("--chain-id", default="")
+    p.add_argument("--starting-port", type=int, default=26656)
+    p.set_defaults(fn=cmd_testnet)
+
+    for name, fn in (
+        ("gen-node-key", cmd_gen_node_key),
+        ("show-node-id", cmd_show_node_id),
+        ("gen-validator", cmd_gen_validator),
+        ("show-validator", cmd_show_validator),
+        ("version", cmd_version),
+        ("compact", cmd_compact),
+        ("replay", cmd_replay),
+    ):
+        p = sub.add_parser(name)
+        p.set_defaults(fn=fn)
+
+    p = sub.add_parser("reset", help="delete data, keep keys")
+    p.set_defaults(fn=cmd_reset)
+    p = sub.add_parser("unsafe-reset-all", help="delete data, keep keys")
+    p.set_defaults(fn=lambda a: cmd_reset(a, all_=True))
+
+    p = sub.add_parser("rollback", help="rewind state by one height")
+    p.add_argument(
+        "--hard", action="store_true", help="also delete the tip block"
+    )
+    p.set_defaults(fn=cmd_rollback)
+
+    p = sub.add_parser("reindex-event", help="rebuild tx/block indexes")
+    p.add_argument("--start-height", type=int, default=0)
+    p.add_argument("--end-height", type=int, default=0)
+    p.set_defaults(fn=cmd_reindex_event)
+
+    p = sub.add_parser("inspect", help="read-only RPC over data dirs")
+    p.add_argument("--rpc-laddr", default="127.0.0.1:26657")
+    p.set_defaults(fn=cmd_inspect)
+
+    p = sub.add_parser("light", help="light client daemon")
+    p.add_argument("chain_id")
+    p.add_argument("-p", "--primary", required=True)
+    p.add_argument("-w", "--witnesses", default="")
+    p.add_argument("--trust-height", type=int, required=True)
+    p.add_argument("--trust-hash", required=True)
+    p.add_argument("--trust-period-h", type=float, default=168.0)
+    p.add_argument("--interval-s", type=float, default=1.0)
+    p.set_defaults(fn=cmd_light)
+
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if not getattr(args, "fn", None):
+        build_parser().print_help()
+        return 1
+    try:
+        return args.fn(args)
+    except KeyboardInterrupt:
+        return 130
+    except FileNotFoundError as e:
+        print(
+            f"Error: {e.filename or e} not found — "
+            "did you run `init` in this home dir?",
+            file=sys.stderr,
+        )
+        return 1
+    except Exception as e:
+        if os.environ.get("CMT_DEBUG"):
+            raise
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,8 +103,14 @@ def build_node(
     # path free of indexing work
     tx_indexer = block_indexer = None
     if config.tx_index.indexer == "kv":
-        tx_indexer = TxIndexer(kv.MemKV())
-        block_indexer = BlockIndexer(kv.MemKV())
+        index_db = kv.open_kv(
+            config.base.db_backend,
+            None
+            if config.base.db_backend == "memdb"
+            else os.path.join(home, "tx_index.db"),
+        )
+        tx_indexer = TxIndexer(index_db)
+        block_indexer = BlockIndexer(index_db)
         IndexerService(tx_indexer, block_indexer, event_bus).start()
     mempool = CListMempool(proxy.mempool)
     block_exec = BlockExecutor(

@@ -205,16 +205,14 @@ def blockchain(env, minHeight=None, maxHeight=None) -> Dict[str, Any]:
 def block(env, height=None) -> Dict[str, Any]:
     h = _norm_height(env, height)
     blk = env.block_store.load_block(h)
-    if blk is None:
+    meta = env.block_store.load_block_meta(h)
+    if blk is None or meta is None:
         raise RPCError(-32603, f"block at height {h} not found")
     commit = env.block_store.load_seen_commit(
         h
     ) or env.block_store.load_block_commit(h)
     return {
-        "block_id": enc.block_id_json(
-            T.BlockID(blk.hash(), T.PartSet.from_data(
-                codec.encode_block(blk)).header)
-        ),
+        "block_id": enc.block_id_json(meta.block_id),
         "block": enc.block_json(blk),
         "block_b64": enc.b64(codec.encode_block(blk)),
         "commit_b64": enc.b64(codec.encode_commit(commit)) if commit else "",

@@ -117,6 +117,29 @@ class BlockStore:
     def save_extended_commit(self, height: int, ec_bytes: bytes) -> None:
         self.db.set(_hkey(b"EC:", height), ec_bytes)
 
+    def delete_latest_block(self) -> None:
+        """Remove the tip block (reference store.go DeleteLatestBlock,
+        used by rollback --hard)."""
+        h = self._height
+        if h == 0:
+            return
+        meta = self.load_block_meta(h)
+        deletes = [
+            _hkey(b"H:", h),
+            _hkey(b"C:", h - 1),
+            _hkey(b"SC:", h),
+            _hkey(b"EC:", h),
+        ]
+        if meta is not None:
+            deletes.append(b"BH:" + meta.block_id.hash)
+            for i in range(meta.block_id.part_set_header.total):
+                deletes.append(_hkey(b"P:", h) + i.to_bytes(4, "big"))
+        with self._lock:
+            self._height = h - 1
+            self.db.write_batch(
+                [(b"height", (h - 1).to_bytes(8, "big"))], deletes
+            )
+
     # --- load ---------------------------------------------------------
 
     def load_block_meta(self, height: int) -> Optional[BlockMeta]:
