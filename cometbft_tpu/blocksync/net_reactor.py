@@ -106,6 +106,21 @@ class BlockSyncNetReactor(Reactor):
             self._started_pool = True
         self._status_task = asyncio.create_task(self._status_routine())
 
+    async def activate(self, state) -> None:
+        """Begin syncing from a statesync-bootstrapped state
+        (reference statesync -> blocksync phase hand-off)."""
+        self.inner.state = state
+        self.inner.pool.start_height = state.last_block_height + 1
+        self.inner.pool.height = state.last_block_height + 1
+        self.active = True
+        await self.inner.start()
+        self._started_pool = True
+        # re-announce + re-query statuses so the pool learns ranges
+        if self.switch is not None:
+            self.switch.broadcast(
+                BLOCKSYNC_CHANNEL, bytes([MSG_STATUS_REQUEST])
+            )
+
     async def stop(self) -> None:
         if self._status_task:
             self._status_task.cancel()

@@ -894,4 +894,6 @@ class ConsensusState:
         await self.queue.put((kind, payload, peer_id))
 
     def enqueue_nowait(self, kind: str, payload, peer_id: str) -> None:
+        if self.queue is None:
+            return  # not started yet (sync phase); drop
         self.queue.put_nowait((kind, payload, peer_id))

@@ -44,7 +44,7 @@ class HTTPProvider(Provider):
         except ProviderError:
             raise
         except Exception as e:
-            raise ProviderError(f"rpc failure: {e}")
+            raise ProviderError(f"rpc failure: {e!r}")
 
     async def _light_block(self, height: Optional[int]) -> LightBlock:
         hdr, commit = await self._client.commit_decoded(height)

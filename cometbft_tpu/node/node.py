@@ -72,11 +72,15 @@ class Node:
 
         blocksync_active = config.blocksync.enable and not config.statesync.enable
         adaptive = config.blocksync.adaptive_sync
-
+        # consensus gossip stays off until every sync phase completes
+        # (statesync hand-off re-enables blocksync, which re-enables us)
+        sync_pending = config.statesync.enable or (
+            blocksync_active and not adaptive
+        )
         self.consensus_reactor = ConsensusReactor(
             self.parts.cs,
             self.parts.block_store,
-            wait_sync=blocksync_active and not adaptive,
+            wait_sync=sync_pending,
         )
         self.mempool_reactor = MempoolReactor(
             self.parts.mempool, broadcast=config.mempool.broadcast
@@ -91,13 +95,21 @@ class Node:
             active=blocksync_active,
             local_blocks_chain=self._local_blocks_chain,
         )
+        from ..statesync.reactor import StateSyncReactor
+
+        self.statesync_reactor = StateSyncReactor(
+            self.parts.proxy, enabled=config.statesync.enable
+        )
         self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("mempool", self.mempool_reactor)
         self.switch.add_reactor("evidence", self.evidence_reactor)
         self.switch.add_reactor("blocksync", self.blocksync_reactor)
+        self.switch.add_reactor("statesync", self.statesync_reactor)
         self._adaptive = adaptive
         self._cs_started = False
         self.rpc_server = None
+        self._statesync_task = None
+        self.statesync_error = None
 
     # --- phase switching ----------------------------------------------
 
@@ -115,6 +127,57 @@ class Node:
         if val is None:
             return False
         return val.voting_power >= state.validators.total_voting_power() / 3
+
+    async def _statesync_routine(self) -> None:
+        """Phase 1: snapshot-restore, then hand off to blocksync
+        (reference node/setup.go:560 performStateSync)."""
+        from ..statesync.stateprovider import LightClientStateProvider
+
+        cfg = self.config.statesync
+        try:
+            # constructor light-verifies the trust root (blocking
+            # HTTP) — keep it off this event loop
+            provider = await asyncio.to_thread(
+                LightClientStateProvider,
+                self.genesis.chain_id,
+                cfg.rpc_servers,
+                cfg.trust_height,
+                bytes.fromhex(cfg.trust_hash)
+                if isinstance(cfg.trust_hash, str)
+                else cfg.trust_hash,
+                int(cfg.trust_period_s * 1e9),
+                genesis=self.genesis,
+            )
+            try:
+                state = await self.statesync_reactor.sync(
+                    provider,
+                    self.parts.state_store,
+                    self.parts.block_store,
+                    discovery_time_s=cfg.discovery_time_s,
+                )
+            finally:
+                provider.close()
+            self.parts.state = state
+            print(
+                f"statesync complete at height {state.last_block_height}; "
+                "switching to blocksync"
+            )
+            if self._adaptive:
+                # adaptive: consensus runs DURING blocksync and is the
+                # block ingestor — align it with the synced state first
+                self.parts.cs.update_to_state(state)
+                await self.parts.cs.start()
+                self._cs_started = True
+                self.consensus_reactor.switch_to_consensus()
+            await self.blocksync_reactor.activate(state)
+        except Exception as e:
+            # statesync failure is fatal (reference node/setup.go
+            # performStateSync): a node that can't bootstrap must not
+            # linger half-alive
+            self.statesync_error = e
+            traceback.print_exc()
+            print(f"statesync failed, stopping node: {e}")
+            asyncio.ensure_future(self.stop())
 
     def _on_caught_up(self, state) -> None:
         asyncio.ensure_future(self._switch_to_consensus(state))
@@ -146,7 +209,11 @@ class Node:
             self.rpc_server = RPCServer(Environment.from_node(self))
             await self.rpc_server.start(_strip_proto(self.config.rpc.laddr))
         # consensus starts now unless a sync phase must complete first
-        if not self.blocksync_reactor.active or self._adaptive:
+        if self.config.statesync.enable:
+            self._statesync_task = asyncio.create_task(
+                self._statesync_routine()
+            )
+        elif not self.blocksync_reactor.active or self._adaptive:
             await self.parts.cs.start()
             self._cs_started = True
         if self.config.p2p.persistent_peers:
@@ -160,6 +227,8 @@ class Node:
             )
 
     async def stop(self) -> None:
+        if self._statesync_task is not None:
+            self._statesync_task.cancel()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
         if self._cs_started:

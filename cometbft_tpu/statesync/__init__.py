@@ -1,0 +1,13 @@
+"""Statesync: bootstrap a fresh node from application snapshots,
+light-client verified (reference statesync/)."""
+
+from .reactor import StateSyncReactor
+from .stateprovider import LightClientStateProvider
+from .syncer import SyncError, Syncer
+
+__all__ = [
+    "StateSyncReactor",
+    "Syncer",
+    "SyncError",
+    "LightClientStateProvider",
+]

@@ -1,0 +1,52 @@
+"""Chunk queue (reference statesync/chunks.go): ordered delivery of
+snapshot chunks to the app, with refetch support."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional, Set
+
+
+class ChunkQueue:
+    def __init__(self, total: int):
+        self.total = total
+        self.chunks: Dict[int, bytes] = {}
+        self.senders: Dict[int, str] = {}
+        self.next_index = 0
+        self._available = asyncio.Event()
+
+    def wanted(self) -> Set[int]:
+        return {
+            i for i in range(self.total) if i not in self.chunks
+        }
+
+    def add(self, index: int, chunk: bytes, sender: str) -> bool:
+        if index < 0 or index >= self.total or index in self.chunks:
+            return False
+        self.chunks[index] = chunk
+        self.senders[index] = sender
+        if index == self.next_index:
+            self._available.set()
+        return True
+
+    def discard(self, index: int) -> None:
+        """App asked for a refetch of this chunk."""
+        self.chunks.pop(index, None)
+        self.senders.pop(index, None)
+        if index <= self.next_index:
+            self.next_index = min(self.next_index, index)
+            self._available.clear()
+
+    async def next(self, timeout: float = 10.0):
+        """(index, chunk, sender) in strict order."""
+        while self.next_index not in self.chunks:
+            self._available.clear()
+            await asyncio.wait_for(self._available.wait(), timeout)
+        i = self.next_index
+        self.next_index += 1
+        if self.next_index in self.chunks:
+            self._available.set()
+        return i, self.chunks[i], self.senders.get(i, "")
+
+    def done(self) -> bool:
+        return self.next_index >= self.total

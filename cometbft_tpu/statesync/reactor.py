@@ -1,0 +1,155 @@
+"""Statesync reactor: snapshot/chunk wire protocol on channels
+0x60/0x61 (reference statesync/reactor.go:21-23) + the node-side sync
+entrypoint that bootstraps the stores (reference node/setup.go:560
+performStateSync)."""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Dict, Optional
+
+from ..abci import types as abci
+from ..p2p.node_info import ChannelDescriptor
+from ..p2p.reactor import Reactor
+from ..utils import proto
+from .syncer import Syncer
+
+SNAPSHOT_CHANNEL = 0x60
+CHUNK_CHANNEL = 0x61
+
+MSG_SNAPSHOTS_REQUEST = 0x01
+MSG_SNAPSHOTS_RESPONSE = 0x02
+MSG_CHUNK_REQUEST = 0x03
+MSG_CHUNK_RESPONSE = 0x04
+
+MAX_ADVERTISED_SNAPSHOTS = 10
+
+
+def _encode_snapshot(s: abci.Snapshot) -> bytes:
+    return (
+        proto.field_varint(1, s.height)
+        + proto.field_varint(2, s.format)
+        + proto.field_varint(3, s.chunks)
+        + proto.field_bytes(4, s.hash)
+        + proto.field_bytes(5, s.metadata)
+    )
+
+
+def _decode_snapshot(b: bytes) -> abci.Snapshot:
+    m = proto.parse(b)
+    return abci.Snapshot(
+        height=proto.get1(m, 1, 0),
+        format=proto.get1(m, 2, 0),
+        chunks=proto.get1(m, 3, 0),
+        hash=proto.get1(m, 4, b""),
+        metadata=proto.get1(m, 5, b""),
+    )
+
+
+class StateSyncReactor(Reactor):
+    name = "statesync"
+
+    def __init__(self, proxy, enabled: bool = False):
+        super().__init__()
+        self.proxy = proxy  # AppConns (serves snapshots to peers)
+        self.enabled = enabled
+        self.syncer: Optional[Syncer] = None
+        # pending chunk requests: (peer, height, format, index) -> fut
+        self._pending: Dict[tuple, asyncio.Future] = {}
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(SNAPSHOT_CHANNEL, priority=5, max_msg_size=1 << 20),
+            ChannelDescriptor(CHUNK_CHANNEL, priority=3, max_msg_size=1 << 22),
+        ]
+
+    # --- node-side sync entrypoint --------------------------------------
+
+    async def sync(
+        self,
+        state_provider,
+        state_store,
+        block_store,
+        discovery_time_s: float = 5.0,
+    ):
+        """Discover + restore a snapshot, bootstrap the stores, return
+        the new State (reference syncer.SyncAny + node bootstrap)."""
+        self.syncer = Syncer(
+            self.proxy,
+            state_provider,
+            request_chunk=self._request_chunk,
+            discovery_time_s=discovery_time_s,
+        )
+        # ask everyone we know for their snapshots
+        self.switch.broadcast(
+            SNAPSHOT_CHANNEL, bytes([MSG_SNAPSHOTS_REQUEST])
+        )
+        state, commit = await self.syncer.sync_any()
+        state_store.bootstrap(state)
+        block_store.save_seen_commit(state.last_block_height, commit)
+        return state
+
+    async def _request_chunk(self, peer_id, height, format_, index):
+        peer = self.switch.peers.get(peer_id)
+        if peer is None:
+            return None
+        key = (peer_id, height, format_, index)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[key] = fut
+        try:
+            await peer.send(
+                CHUNK_CHANNEL,
+                bytes([MSG_CHUNK_REQUEST])
+                + struct.pack(">qii", height, format_, index),
+            )
+            return await fut
+        finally:
+            self._pending.pop(key, None)
+
+    # --- peers ----------------------------------------------------------
+
+    def add_peer(self, peer) -> None:
+        if self.enabled and self.syncer is not None:
+            peer.try_send(SNAPSHOT_CHANNEL, bytes([MSG_SNAPSHOTS_REQUEST]))
+
+    def remove_peer(self, peer, reason) -> None:
+        if self.syncer is not None:
+            self.syncer.pool.remove_peer(peer.peer_id)
+
+    # --- wire -----------------------------------------------------------
+
+    def receive(self, chan_id: int, peer, msg: bytes) -> None:
+        mtype = msg[0]
+        body = msg[1:]
+        if mtype == MSG_SNAPSHOTS_REQUEST:
+            for snap in (self.proxy.snapshot.list_snapshots() or [])[
+                -MAX_ADVERTISED_SNAPSHOTS:
+            ]:
+                peer.try_send(
+                    SNAPSHOT_CHANNEL,
+                    bytes([MSG_SNAPSHOTS_RESPONSE])
+                    + _encode_snapshot(snap),
+                )
+        elif mtype == MSG_SNAPSHOTS_RESPONSE:
+            if self.syncer is not None:
+                self.syncer.pool.add(peer.peer_id, _decode_snapshot(body))
+        elif mtype == MSG_CHUNK_REQUEST:
+            height, format_, index = struct.unpack(">qii", body)
+            chunk = self.proxy.snapshot.load_snapshot_chunk(
+                height, format_, index
+            )
+            peer.try_send(
+                CHUNK_CHANNEL,
+                bytes([MSG_CHUNK_RESPONSE])
+                + struct.pack(">qii?", height, format_, index, bool(chunk))
+                + (chunk or b""),
+            )
+        elif mtype == MSG_CHUNK_RESPONSE:
+            height, format_, index, ok = struct.unpack(">qii?", body[:17])
+            chunk = body[17:] if ok else None
+            fut = self._pending.get((peer.peer_id, height, format_, index))
+            if fut is not None and not fut.done():
+                fut.set_result(chunk)
+        else:
+            raise ValueError(f"unknown statesync msg type {mtype}")

@@ -1,0 +1,95 @@
+"""Light-client-backed state provider (reference
+statesync/stateprovider.go:48-125 lightClientStateProvider).
+
+Builds the post-snapshot State entirely from light-verified headers:
+the app hash OF height h lives in header h+1, validator sets come from
+the verified valset chain, and the commit for h proves the header. All
+fetches ride the light client, so a statesyncing node trusts only its
+configured (height, hash) root."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from .. import types as T
+from ..light import Client, TrustOptions
+from ..light.http_provider import HTTPProvider
+from ..state.state_types import State
+
+
+class LightClientStateProvider:
+    def __init__(
+        self,
+        chain_id: str,
+        rpc_servers: List[str],
+        trust_height: int,
+        trust_hash: bytes,
+        trust_period_ns: int,
+        genesis=None,
+    ):
+        if not rpc_servers:
+            raise ValueError("statesync requires at least one RPC server")
+        self.chain_id = chain_id
+        self.genesis = genesis
+        self.primary = HTTPProvider(chain_id, rpc_servers[0])
+        self.witnesses = [
+            HTTPProvider(chain_id, s) for s in rpc_servers[1:]
+        ]
+        self.client = Client(
+            chain_id,
+            TrustOptions(
+                period_ns=trust_period_ns,
+                height=trust_height,
+                hash=trust_hash,
+            ),
+            primary=self.primary,
+            witnesses=self.witnesses,
+        )
+
+    def app_hash(self, height: int) -> bytes:
+        """App hash AFTER executing block `height` (header h+1)."""
+        return self.client.verify_light_block_at_height(
+            height + 1
+        ).header.app_hash
+
+    def commit(self, height: int) -> T.Commit:
+        return self.client.verify_light_block_at_height(height).commit
+
+    def state(self, height: int) -> State:
+        """State as of height h, ready for ApplyBlock(h+1)."""
+        cur = self.client.verify_light_block_at_height(height)
+        nxt = self.client.verify_light_block_at_height(height + 1)
+        prev = (
+            self.client.verify_light_block_at_height(height - 1)
+            if height > 1
+            else None
+        )
+        initial_height = (
+            self.genesis.initial_height if self.genesis else 1
+        )
+        params = (
+            self.genesis.consensus_params
+            if self.genesis is not None
+            else State().consensus_params
+        )
+        return State(
+            chain_id=self.chain_id,
+            initial_height=initial_height,
+            last_block_height=cur.height,
+            last_block_id=nxt.header.last_block_id,
+            last_block_time_ns=cur.header.time_ns,
+            validators=cur.validator_set,
+            next_validators=nxt.validator_set,
+            last_validators=prev.validator_set if prev else None,
+            last_height_validators_changed=0,
+            consensus_params=params,
+            last_height_consensus_params_changed=0,
+            last_results_hash=nxt.header.last_results_hash,
+            app_hash=nxt.header.app_hash,
+        )
+
+    def close(self) -> None:
+        self.primary.close()
+        for w in self.witnesses:
+            w.close()

@@ -1,0 +1,204 @@
+"""Snapshot syncer (reference statesync/syncer.go:150,246,327,363).
+
+Flow: collect snapshot advertisements -> pick best (highest height,
+light-verified app hash) -> OfferSnapshot to app -> fetch + apply
+chunks in order (refetch / sender-rejection honored) -> verify app
+Info against the trusted app hash -> return the light-verified State
++ commit for store bootstrap."""
+
+from __future__ import annotations
+
+import asyncio
+import traceback
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..abci import types as abci
+from .chunks import ChunkQueue
+
+DISCOVERY_SLEEP_S = 0.3
+CHUNK_TIMEOUT_S = 10.0
+MAX_CHUNK_FETCHERS = 4
+
+
+class SyncError(Exception):
+    pass
+
+
+class SnapshotRejected(SyncError):
+    pass
+
+
+@dataclass(frozen=True)
+class SnapshotKey:
+    height: int
+    format: int
+    chunks: int
+    hash: bytes
+
+
+@dataclass
+class SnapshotPool:
+    """Advertised snapshots and which peers can serve them."""
+
+    snapshots: Dict[SnapshotKey, Set[str]] = field(default_factory=dict)
+
+    def add(self, peer_id: str, snap: abci.Snapshot) -> None:
+        key = SnapshotKey(
+            snap.height, snap.format, snap.chunks, bytes(snap.hash)
+        )
+        self.snapshots.setdefault(key, set()).add(peer_id)
+
+    def remove_peer(self, peer_id: str) -> None:
+        for peers in self.snapshots.values():
+            peers.discard(peer_id)
+
+    def reject(self, key: SnapshotKey) -> None:
+        self.snapshots.pop(key, None)
+
+    def best(self) -> Optional[Tuple[SnapshotKey, Set[str]]]:
+        live = {
+            k: p for k, p in self.snapshots.items() if p
+        }
+        if not live:
+            return None
+        key = max(live, key=lambda k: (k.height, k.format))
+        return key, live[key]
+
+
+class Syncer:
+    def __init__(
+        self,
+        proxy,  # AppConns (snapshot + query)
+        state_provider,
+        request_chunk: Callable,  # async (peer_id, height, format, index) -> Optional[bytes]
+        discovery_time_s: float = 5.0,
+        chunk_timeout_s: float = CHUNK_TIMEOUT_S,
+    ):
+        self.proxy = proxy
+        self.provider = state_provider
+        self.request_chunk = request_chunk
+        self.pool = SnapshotPool()
+        self.discovery_time_s = discovery_time_s
+        self.chunk_timeout_s = chunk_timeout_s
+        self.banned_snapshots: Set[bytes] = set()
+
+    # --- entry --------------------------------------------------------
+
+    async def sync_any(self):
+        """Try snapshots until one applies. Returns (state, commit)."""
+        deadline = (
+            asyncio.get_running_loop().time() + self.discovery_time_s
+        )
+        while True:
+            pick = self.pool.best()
+            if pick is None:
+                if asyncio.get_running_loop().time() > deadline:
+                    raise SyncError(
+                        "no viable snapshots discovered in time"
+                    )
+                await asyncio.sleep(DISCOVERY_SLEEP_S)
+                continue
+            key, peers = pick
+            if key.hash in self.banned_snapshots:
+                self.pool.reject(key)
+                continue
+            try:
+                return await self._sync_one(key, peers)
+            except SnapshotRejected:
+                self.banned_snapshots.add(key.hash)
+                self.pool.reject(key)
+            except asyncio.TimeoutError:
+                self.pool.reject(key)
+
+    async def _sync_one(self, key: SnapshotKey, peers: Set[str]):
+        # light-verify the app hash BEFORE trusting anything the
+        # snapshot claims (reference syncer.go:246 Sync)
+        app_hash = await asyncio.to_thread(
+            self.provider.app_hash, key.height
+        )
+        snap = abci.Snapshot(
+            height=key.height,
+            format=key.format,
+            chunks=key.chunks,
+            hash=key.hash,
+        )
+        resp = self.proxy.snapshot.offer_snapshot(snap, app_hash)
+        if resp.result != abci.OFFER_SNAPSHOT_ACCEPT:
+            if resp.result == abci.OFFER_SNAPSHOT_ABORT:
+                raise SyncError("app aborted snapshot restore")
+            raise SnapshotRejected(f"app rejected snapshot ({resp.result})")
+
+        queue = ChunkQueue(key.chunks)
+        fetchers = [
+            asyncio.create_task(
+                self._fetch_routine(queue, key, list(peers))
+            )
+            for _ in range(min(MAX_CHUNK_FETCHERS, max(1, len(peers))))
+        ]
+        try:
+            while not queue.done():
+                index, chunk, sender = await queue.next(
+                    self.chunk_timeout_s
+                )
+                r = self.proxy.snapshot.apply_snapshot_chunk(
+                    index, chunk, sender
+                )
+                if r.result == abci.APPLY_CHUNK_ACCEPT:
+                    continue
+                if r.result == abci.APPLY_CHUNK_RETRY:
+                    queue.discard(index)
+                    continue
+                if r.result in (
+                    abci.APPLY_CHUNK_REJECT_SNAPSHOT,
+                    abci.APPLY_CHUNK_RETRY_SNAPSHOT,
+                ):
+                    raise SnapshotRejected("app rejected chunk set")
+                raise SyncError(f"chunk apply aborted ({r.result})")
+        finally:
+            for f in fetchers:
+                f.cancel()
+
+        # verify the app landed exactly where the light client says
+        info = self.proxy.query.info(abci.RequestInfo())
+        if info.last_block_height != key.height:
+            raise SnapshotRejected(
+                f"app restored to height {info.last_block_height}, "
+                f"snapshot was {key.height}"
+            )
+        if bytes(info.last_block_app_hash) != bytes(app_hash):
+            raise SnapshotRejected("app hash mismatch after restore")
+
+        state = await asyncio.to_thread(self.provider.state, key.height)
+        commit = await asyncio.to_thread(
+            self.provider.commit, key.height
+        )
+        return state, commit
+
+    async def _fetch_routine(
+        self, queue: ChunkQueue, key: SnapshotKey, peers: List[str]
+    ) -> None:
+        i = 0
+        try:
+            while not queue.done():
+                wanted = sorted(queue.wanted() - set(queue.chunks))
+                if not wanted:
+                    await asyncio.sleep(0.05)
+                    continue
+                index = wanted[i % len(wanted)]
+                i += 1
+                peer = peers[index % len(peers)]
+                try:
+                    chunk = await asyncio.wait_for(
+                        self.request_chunk(
+                            peer, key.height, key.format, index
+                        ),
+                        self.chunk_timeout_s,
+                    )
+                except (asyncio.TimeoutError, Exception):
+                    await asyncio.sleep(0.1)
+                    continue
+                if chunk is not None:
+                    queue.add(index, chunk, peer)
+        except asyncio.CancelledError:
+            raise

@@ -1,0 +1,145 @@
+"""Statesync end-to-end: a fresh node restores an app snapshot
+(light-verified against the source net's RPC), blocksyncs the tail,
+and follows the chain (reference analog: statesync/syncer_test.go +
+e2e statesync nodes)."""
+
+import asyncio
+
+from cometbft_tpu.config.config import test_config as make_test_cfg
+from cometbft_tpu.node.inprocess import make_genesis
+from cometbft_tpu.node.node import Node
+
+N_VALS = 3
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def test_statesync_bootstrap_then_follow():
+    gen, pvs = make_genesis(N_VALS, chain_id="ss-chain")
+
+    async def main():
+        vals = []
+        for i, pv in enumerate(pvs):
+            cfg = make_test_cfg(".")
+            cfg.base.moniker = f"val{i}"
+            cfg.blocksync.enable = False
+            vals.append(Node(cfg, gen, privval=pv))
+        for n in vals:
+            await n.start()
+        for i, a in enumerate(vals):
+            for b in vals[i + 1:]:
+                await a.dial(b.listen_addr)
+        # kvstore snapshots every 10 heights; wait for one + margin
+        while vals[0].height < 13:
+            await asyncio.sleep(0.05)
+
+        trust = vals[0].parts.block_store.load_block(1)
+        cfg = make_test_cfg(".")
+        cfg.base.moniker = "statesyncer"
+        cfg.statesync.enable = True
+        cfg.statesync.rpc_servers = [
+            vals[0].rpc_server.listen_addr,
+            vals[1].rpc_server.listen_addr,
+        ]
+        cfg.statesync.trust_height = 1
+        cfg.statesync.trust_hash = bytes(trust.hash()).hex()
+        cfg.statesync.discovery_time_s = 10.0
+        cfg.blocksync.enable = True
+        fresh = Node(cfg, gen, privval=None)
+        await fresh.start()
+        for v in vals:
+            await fresh.dial(v.listen_addr)
+
+        # must statesync (skipping early blocks), then follow the tip
+        target = vals[0].height + 3
+        for _ in range(1200):
+            if fresh.height >= target:
+                break
+            await asyncio.sleep(0.1)
+        assert fresh.height >= target, f"stuck at {fresh.height}"
+        # early blocks were NEVER replayed: store base is post-snapshot
+        assert fresh.parts.block_store.base() > 1
+        # app state converged with the network
+        h = fresh.height
+        assert bytes(
+            fresh.parts.block_store.load_block(h).hash()
+        ) == bytes(vals[0].parts.block_store.load_block(h).hash())
+        for n in vals + [fresh]:
+            await n.stop()
+
+    run(main())
+
+
+def test_statesync_adaptive_handoff():
+    """statesync -> adaptive blocksync: verified blocks are ingested
+    straight into the (freshly started) consensus state machine."""
+    gen, pvs = make_genesis(N_VALS, chain_id="ssa-chain")
+
+    async def main():
+        vals = []
+        for i, pv in enumerate(pvs):
+            cfg = make_test_cfg(".")
+            cfg.base.moniker = f"val{i}"
+            cfg.blocksync.enable = False
+            vals.append(Node(cfg, gen, privval=pv))
+        for n in vals:
+            await n.start()
+        for i, a in enumerate(vals):
+            for b in vals[i + 1:]:
+                await a.dial(b.listen_addr)
+        while vals[0].height < 13:
+            await asyncio.sleep(0.05)
+
+        trust = vals[0].parts.block_store.load_block(1)
+        cfg = make_test_cfg(".")
+        cfg.base.moniker = "adaptive-ss"
+        cfg.statesync.enable = True
+        cfg.statesync.rpc_servers = [vals[0].rpc_server.listen_addr]
+        cfg.statesync.trust_height = 1
+        cfg.statesync.trust_hash = bytes(trust.hash()).hex()
+        cfg.statesync.discovery_time_s = 10.0
+        cfg.blocksync.enable = True
+        cfg.blocksync.adaptive_sync = True
+        fresh = Node(cfg, gen, privval=None)
+        await fresh.start()
+        for v in vals:
+            await fresh.dial(v.listen_addr)
+
+        target = vals[0].height + 3
+        for _ in range(1200):
+            if fresh.height >= target:
+                break
+            await asyncio.sleep(0.1)
+        assert fresh.height >= target, f"stuck at {fresh.height}"
+        assert fresh._cs_started  # consensus was live during sync
+        assert fresh.parts.block_store.base() > 1
+        for n in vals + [fresh]:
+            await n.stop()
+
+    run(main())
+
+
+def test_statesync_failure_is_fatal():
+    """Unreachable RPC servers: the node must stop, not idle."""
+    gen, pvs = make_genesis(1, chain_id="ssf-chain")
+
+    async def main():
+        cfg = make_test_cfg(".")
+        cfg.statesync.enable = True
+        cfg.statesync.rpc_servers = ["127.0.0.1:1"]  # nothing there
+        cfg.statesync.trust_height = 1
+        cfg.statesync.trust_hash = "ab" * 32
+        cfg.statesync.discovery_time_s = 1.0
+        node = Node(cfg, gen, privval=None)
+        await node.start()
+        for _ in range(200):
+            if node.statesync_error is not None:
+                break
+            await asyncio.sleep(0.1)
+        assert node.statesync_error is not None
+        assert not node._cs_started
+        await node.stop()
+
+    run(main())
