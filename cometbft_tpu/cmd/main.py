@@ -101,11 +101,21 @@ def cmd_start(args) -> int:
     cfg = _load_config(home)
     with open(p["genesis"]) as f:
         gen = GenesisDoc.from_json(f.read())
-    pv = (
-        FilePV.load(p["pv_key"], p["pv_state"])
-        if os.path.exists(p["pv_key"])
-        else None
-    )
+    if cfg.base.priv_validator_laddr:
+        from ..privval.signer import SignerClient
+
+        pv = SignerClient(cfg.base.priv_validator_laddr)
+        print(
+            f"waiting for remote signer on {pv.listen_addr} ..."
+        )
+        pv.wait_for_signer()
+        pv.pub_key()  # prefetch + cache the validator identity
+    else:
+        pv = (
+            FilePV.load(p["pv_key"], p["pv_state"])
+            if os.path.exists(p["pv_key"])
+            else None
+        )
     nk = NodeKey.load_or_gen(p["node_key"])
 
     async def main():
@@ -443,6 +453,40 @@ def cmd_light(args) -> int:
         return 0
 
 
+def cmd_signer(args) -> int:
+    """Run a remote signer daemon serving this home dir's validator
+    key to a node (the reference ecosystem's tmkms role)."""
+    from ..privval.file_pv import FilePV
+    from ..privval.signer import SignerServer
+
+    p = _paths(_home(args))
+    pv = FilePV.load(p["pv_key"], p["pv_state"])
+    server = SignerServer(pv, args.address)
+
+    async def main():
+        print(
+            f"signer for {pv.pub_key().address().hex()[:16]} "
+            f"dialing {args.address}"
+        )
+        while True:
+            try:
+                await server.serve()
+            except (
+                ConnectionError,
+                OSError,
+                EOFError,  # IncompleteReadError: node closed mid-handshake
+                asyncio.TimeoutError,
+            ) as e:
+                print(f"connection lost ({e}); retrying in 1s")
+            await asyncio.sleep(1.0)
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_version(args) -> int:
     print(f"cometbft-tpu v{VERSION}")
     return 0
@@ -508,6 +552,13 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("inspect", help="read-only RPC over data dirs")
     p.add_argument("--rpc-laddr", default="127.0.0.1:26657")
     p.set_defaults(fn=cmd_inspect)
+
+    p = sub.add_parser("signer", help="remote signer daemon")
+    p.add_argument(
+        "-a", "--address", required=True,
+        help="validator node's priv_validator_laddr to dial",
+    )
+    p.set_defaults(fn=cmd_signer)
 
     p = sub.add_parser("light", help="light client daemon")
     p.add_argument("chain_id")

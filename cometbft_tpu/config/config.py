@@ -28,6 +28,9 @@ class BaseConfig:
     priv_validator_key_file: str = "config/priv_validator_key.json"
     priv_validator_state_file: str = "data/priv_validator_state.json"
     node_key_file: str = "config/node_key.json"
+    # when set, keys live with a REMOTE signer that dials in here
+    # (reference PrivValidatorListenAddr)
+    priv_validator_laddr: str = ""
     abci: str = "kvstore"
     filter_peers: bool = False
 

@@ -201,6 +201,14 @@ class ConsensusState:
             self._try_add_vote(payload.vote, peer_id)
         elif kind == "commit_block":
             self._handle_commit_block(payload, peer_id)
+        elif kind == "retry_sign":
+            self._handle_sign_retry(payload)
+        elif kind == "signed_vote":
+            # our own vote, signed off-loop by a remote signer
+            self._commit_own_vote(payload.vote)
+        elif kind == "signed_proposal":
+            prop, parts = payload
+            self._publish_own_proposal(prop, parts)
 
     def _handle_commit_block(self, payload, peer_id: str) -> None:
         """Catch-up: a peer sent us a committed block + its commit
@@ -456,7 +464,12 @@ class ConsensusState:
         if self.privval is None:
             self._maybe_finish_propose(height, round_)
             return
-        our_addr = self.privval.pub_key().address()
+        try:
+            our_addr = self.privval.pub_key().address()
+        except Exception:
+            # remote signer unavailable; propose timeout cycles round
+            self._maybe_finish_propose(height, round_)
+            return
         if not rs.validators.has_address(our_addr):
             self._maybe_finish_propose(height, round_)
             return
@@ -502,21 +515,42 @@ class ConsensusState:
             block_id=bid,
             timestamp_ns=time.time_ns(),
         )
+        if getattr(self.privval, "REMOTE_BLOCKING", False) and self.queue:
+            chain_id = self.state.chain_id
+
+            async def sign_off_loop():
+                try:
+                    await asyncio.to_thread(
+                        self.privval.sign_proposal, chain_id, prop
+                    )
+                except Exception:
+                    traceback.print_exc()
+                    return  # propose timeout moves the round along
+                self.enqueue_nowait("signed_proposal", (prop, parts), "")
+
+            asyncio.create_task(sign_off_loop())
+            return
         try:
             self.privval.sign_proposal(self.state.chain_id, prop)
         except Exception:
             traceback.print_exc()
             return
-        # feed to ourselves through the internal queue path (synchronously
-        # here: we ARE the single writer)
+        self._publish_own_proposal(prop, parts)
+
+    def _publish_own_proposal(self, prop: T.Proposal, parts) -> None:
+        """Feed our signed proposal + parts to ourselves and the
+        gossip hooks (we ARE the single writer here)."""
+        rs = self.rs
+        if prop.height != rs.height or prop.round != rs.round:
+            return  # round moved on while signing remotely
         self._wal_write_msg("proposal", ProposalMessage(prop), "")
         self._set_proposal(prop)
         self._broadcast("proposal", ProposalMessage(prop))
         for i in range(parts.header.total):
             part = parts.get_part(i)
-            msg = BlockPartMessage(height, round_, part)
+            msg = BlockPartMessage(prop.height, prop.round, part)
             self._wal_write_msg("block_part", msg, "")
-            self._add_proposal_block_part(height, round_, part)
+            self._add_proposal_block_part(prop.height, prop.round, part)
             self._broadcast("block_part", msg)
 
     def _set_proposal(self, proposal: T.Proposal) -> bool:
@@ -754,7 +788,14 @@ class ConsensusState:
         rs = self.rs
         if self.privval is None:
             return
-        addr = self.privval.pub_key().address()
+        try:
+            addr = self.privval.pub_key().address()
+        except Exception:
+            traceback.print_exc()
+            self._schedule_sign_retry(
+                type_, block_hash, psh, rs.height, rs.round
+            )
+            return
         if not rs.validators.has_address(addr):
             return
         idx, _ = rs.validators.get_by_address(addr)
@@ -772,20 +813,99 @@ class ConsensusState:
             validator_address=addr,
             validator_index=idx,
         )
+        want_ext = (
+            type_ == T.PRECOMMIT
+            and not bid.is_nil()
+            and self.state.consensus_params.vote_extensions_enabled(rs.height)
+        )
+        if getattr(self.privval, "REMOTE_BLOCKING", False) and self.queue:
+            # remote signer: a socket round trip must not block the
+            # event loop — sign in a worker thread and feed the signed
+            # vote back through the single-writer queue
+            chain_id = self.state.chain_id
+
+            def do_sign():
+                self.privval.sign_vote(chain_id, vote)
+                if want_ext:
+                    self.privval.sign_vote_extension(chain_id, vote)
+
+            async def sign_off_loop():
+                try:
+                    await asyncio.to_thread(do_sign)
+                except Exception:
+                    traceback.print_exc()
+                    self._schedule_sign_retry(
+                        type_, block_hash, psh, vote.height, vote.round
+                    )
+                    return
+                self.enqueue_nowait("signed_vote", VoteMessage(vote), "")
+
+            asyncio.create_task(sign_off_loop())
+            return
         try:
             self.privval.sign_vote(self.state.chain_id, vote)
-            if (
-                type_ == T.PRECOMMIT
-                and not bid.is_nil()
-                and self.state.consensus_params.vote_extensions_enabled(rs.height)
-            ):
+            if want_ext:
                 self.privval.sign_vote_extension(self.state.chain_id, vote)
         except Exception:
             traceback.print_exc()
+            # signing can fail transiently (remote signer down):
+            # retry while the round is still current, else a lone or
+            # pivotal validator stalls forever even after the signer
+            # returns. Safe: FilePV re-serves the signature for votes
+            # differing only by timestamp, so no double-sign risk.
+            self._schedule_sign_retry(
+                type_, block_hash, psh, rs.height, rs.round
+            )
             return
+        self._commit_own_vote(vote)
+
+    def _commit_own_vote(self, vote: T.Vote) -> None:
         self._wal_write_msg("vote", VoteMessage(vote), "")
         self._try_add_vote(vote, "")
         self._broadcast("vote", VoteMessage(vote))
+
+    def _schedule_sign_retry(
+        self, type_, block_hash, psh, height: int, round_: int
+    ) -> None:
+        if self.queue is None:
+            return
+
+        async def retry():
+            await asyncio.sleep(1.0)
+            try:
+                self.queue.put_nowait(
+                    ("retry_sign", (type_, block_hash, psh, height, round_), "")
+                )
+            except asyncio.QueueFull:
+                pass
+
+        asyncio.create_task(retry())
+
+    def _handle_sign_retry(self, payload) -> None:
+        type_, block_hash, psh, height, round_ = payload
+        rs = self.rs
+        if rs.height != height or rs.round != round_:
+            return  # round moved on; normal flow takes over
+        if rs.votes is not None:
+            vs = (
+                rs.votes.prevotes(round_)
+                if type_ == T.PREVOTE
+                else rs.votes.precommits(round_)
+            )
+            if vs is not None and self.privval is not None:
+                try:
+                    addr = self.privval.pub_key().address()
+                    idx, _ = rs.validators.get_by_address(addr)
+                    if idx >= 0 and vs.votes[idx] is not None:
+                        return  # already signed + added
+                except Exception:
+                    # signer STILL down (the very case retries exist
+                    # for): keep the chain of retries alive
+                    self._schedule_sign_retry(
+                        type_, block_hash, psh, height, round_
+                    )
+                    return
+        self._sign_add_vote(type_, block_hash, psh)
 
     def _try_add_vote(self, vote: T.Vote, peer_id: str) -> None:
         rs = self.rs

@@ -12,6 +12,7 @@ instead (reference blocksync/reactor_adaptive.go)."""
 from __future__ import annotations
 
 import asyncio
+import os
 import traceback
 from typing import Optional
 
@@ -95,21 +96,42 @@ class Node:
             active=blocksync_active,
             local_blocks_chain=self._local_blocks_chain,
         )
+        from ..p2p.pex import AddrBook, PexReactor
         from ..statesync.reactor import StateSyncReactor
 
         self.statesync_reactor = StateSyncReactor(
             self.parts.proxy, enabled=config.statesync.enable
+        )
+        self.addr_book = AddrBook(
+            os.path.join(home, "addrbook.json") if home else None,
+            our_id=self.node_key.node_id,
+        )
+        for seed in (config.p2p.seeds or "").split(","):
+            if seed.strip():
+                self.addr_book.add_address(seed.strip())
+        self.pex_reactor = (
+            PexReactor(
+                self.addr_book,
+                seed_mode=config.p2p.seed_mode,
+                target_outbound=config.p2p.max_num_outbound_peers,
+            )
+            if config.p2p.pex
+            else None
         )
         self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("mempool", self.mempool_reactor)
         self.switch.add_reactor("evidence", self.evidence_reactor)
         self.switch.add_reactor("blocksync", self.blocksync_reactor)
         self.switch.add_reactor("statesync", self.statesync_reactor)
+        if self.pex_reactor is not None:
+            self.switch.add_reactor("pex", self.pex_reactor)
         self._adaptive = adaptive
         self._cs_started = False
         self.rpc_server = None
         self._statesync_task = None
         self.statesync_error = None
+        self.metrics = None
+        self.metrics_server = None
 
     # --- phase switching ----------------------------------------------
 
@@ -208,6 +230,17 @@ class Node:
 
             self.rpc_server = RPCServer(Environment.from_node(self))
             await self.rpc_server.start(_strip_proto(self.config.rpc.laddr))
+        if self.config.instrumentation.prometheus:
+            from ..utils.metrics import MetricsServer, NodeMetrics
+
+            self.metrics = NodeMetrics(self.genesis.chain_id)
+            self.metrics.attach(self)
+            self.metrics_server = MetricsServer(self.metrics)
+            await self.metrics_server.start(
+                _strip_proto(
+                    self.config.instrumentation.prometheus_listen_addr
+                )
+            )
         # consensus starts now unless a sync phase must complete first
         if self.config.statesync.enable:
             self._statesync_task = asyncio.create_task(
@@ -229,6 +262,8 @@ class Node:
     async def stop(self) -> None:
         if self._statesync_task is not None:
             self._statesync_task.cancel()
+        if self.metrics_server is not None:
+            await self.metrics_server.stop()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
         if self._cs_started:

@@ -1,0 +1,252 @@
+"""Remote signer protocol (reference privval/signer_client.go:18,
+privval/signer_listener_endpoint.go, privval/signer_server.go,
+privval/msgs.go).
+
+Topology matches the reference: the VALIDATOR NODE listens on
+priv_validator_laddr; the SIGNER (HSM-holder) dials in and serves
+signing requests over an authenticated-encrypted stream (the same
+SecretConnection as p2p). The node-side SignerClient implements the
+PrivValidator interface; each call does one request/response round
+trip with a deadline. Double-sign protection lives with the KEY (the
+signer's FilePV), exactly like the reference.
+
+The endpoint runs its own background event loop thread so the
+synchronous PrivValidator interface (called from inside the consensus
+routine) can block on the socket with a timeout without re-entering
+the node's loop."""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import threading
+import traceback
+from typing import Optional
+
+from ..crypto.keys import Ed25519PrivKey, Ed25519PubKey
+from ..p2p.conn.secret_connection import SecretConnection
+from ..types.vote import Proposal, Vote
+from ..utils import codec
+
+MSG_PUBKEY_REQUEST = 0x01
+MSG_PUBKEY_RESPONSE = 0x02
+MSG_SIGN_VOTE_REQUEST = 0x03
+MSG_SIGNED_VOTE_RESPONSE = 0x04
+MSG_SIGN_PROPOSAL_REQUEST = 0x05
+MSG_SIGNED_PROPOSAL_RESPONSE = 0x06
+MSG_PING_REQUEST = 0x07
+MSG_PING_RESPONSE = 0x08
+MSG_ERROR_RESPONSE = 0x7F
+
+
+class RemoteSignerError(Exception):
+    pass
+
+
+async def _send(sconn: SecretConnection, mtype: int, payload: bytes = b""):
+    await sconn.write_msg(
+        struct.pack(">BI", mtype, len(payload)) + payload
+    )
+
+
+async def _recv(sconn: SecretConnection):
+    buf = await sconn.read_chunk()
+    mtype, ln = struct.unpack(">BI", buf[:5])
+    body = buf[5:]
+    while len(body) < ln:
+        body += await sconn.read_chunk()
+    return mtype, body[:ln]
+
+
+def _strip_scheme(addr: str) -> str:
+    for pfx in ("tcp://", "unix://"):
+        if addr.startswith(pfx):
+            return addr[len(pfx):]
+    return addr
+
+
+class SignerClient:
+    """Node-side PrivValidator backed by a remote signer (reference
+    privval/signer_client.go). Listens for the signer to dial in."""
+
+    # consensus offloads our (socket-blocking) sign calls to a worker
+    # thread instead of blocking its event loop
+    REMOTE_BLOCKING = True
+
+    def __init__(self, laddr: str, node_priv: Optional[Ed25519PrivKey] = None,
+                 timeout_s: float = 5.0):
+        # node_priv authenticates the NODE end of the secret conn
+        # (a throwaway key is fine; the signer's identity is what
+        # matters operationally)
+        self._auth_priv = node_priv or Ed25519PrivKey.generate()
+        self.timeout_s = timeout_s
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, daemon=True
+        )
+        self._thread.start()
+        self._sconn: Optional[SecretConnection] = None
+        self._connected = threading.Event()
+        self._lock = threading.Lock()
+        self.listen_addr = ""
+        fut = asyncio.run_coroutine_threadsafe(
+            self._listen(laddr), self._loop
+        )
+        fut.result(10.0)
+        self._pubkey: Optional[Ed25519PubKey] = None
+
+    async def _listen(self, laddr: str) -> None:
+        host, _, port = _strip_scheme(laddr).rpartition(":")
+
+        async def on_accept(reader, writer):
+            try:
+                sconn = await SecretConnection.handshake(
+                    reader, writer, self._auth_priv
+                )
+            except Exception:
+                writer.close()
+                return
+            self._sconn = sconn
+            self._connected.set()
+
+        self._server = await asyncio.start_server(
+            on_accept, host or "127.0.0.1", int(port)
+        )
+        h, p = self._server.sockets[0].getsockname()[:2]
+        self.listen_addr = f"{h}:{p}"
+
+    def wait_for_signer(self, timeout_s: float = 30.0) -> None:
+        if not self._connected.wait(timeout_s):
+            raise RemoteSignerError("no remote signer connected")
+
+    # --- request/response ------------------------------------------------
+
+    def _call(self, mtype: int, payload: bytes = b""):
+        self.wait_for_signer(self.timeout_s)
+        with self._lock:
+            fut = asyncio.run_coroutine_threadsafe(
+                self._roundtrip(mtype, payload), self._loop
+            )
+            return fut.result(self.timeout_s)
+
+    async def _roundtrip(self, mtype: int, payload: bytes):
+        await _send(self._sconn, mtype, payload)
+        rtype, body = await _recv(self._sconn)
+        if rtype == MSG_ERROR_RESPONSE:
+            raise RemoteSignerError(body.decode() or "remote signer error")
+        return rtype, body
+
+    # --- PrivValidator interface ----------------------------------------
+
+    def pub_key(self) -> Ed25519PubKey:
+        if self._pubkey is None:
+            rtype, body = self._call(MSG_PUBKEY_REQUEST)
+            if rtype != MSG_PUBKEY_RESPONSE or len(body) != 32:
+                raise RemoteSignerError("bad pubkey response")
+            self._pubkey = Ed25519PubKey(body)
+        return self._pubkey
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        payload = (
+            struct.pack(">H", len(chain_id))
+            + chain_id.encode()
+            + codec.encode_vote(vote)
+        )
+        rtype, body = self._call(MSG_SIGN_VOTE_REQUEST, payload)
+        if rtype != MSG_SIGNED_VOTE_RESPONSE:
+            raise RemoteSignerError("bad sign-vote response")
+        signed = codec.decode_vote(body)
+        vote.signature = signed.signature
+        vote.timestamp_ns = signed.timestamp_ns
+
+    def sign_vote_extension(self, chain_id: str, vote: Vote) -> None:
+        pass  # extensions unsupported over the wire yet (like tmkms)
+
+    def sign_proposal(self, chain_id: str, prop: Proposal) -> None:
+        payload = (
+            struct.pack(">H", len(chain_id))
+            + chain_id.encode()
+            + codec.encode_proposal(prop)
+        )
+        rtype, body = self._call(MSG_SIGN_PROPOSAL_REQUEST, payload)
+        if rtype != MSG_SIGNED_PROPOSAL_RESPONSE:
+            raise RemoteSignerError("bad sign-proposal response")
+        signed = codec.decode_proposal(body)
+        prop.signature = signed.signature
+        prop.timestamp_ns = signed.timestamp_ns
+
+    def close(self) -> None:
+        def _shut():
+            if self._sconn:
+                self._sconn.close()
+            self._server.close()
+
+        self._loop.call_soon_threadsafe(_shut)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+class SignerServer:
+    """Signer-side daemon: dials the validator node and serves signing
+    requests from a FilePV (reference privval/signer_server.go +
+    signer_dialer_endpoint.go). Run via `await serve()`."""
+
+    def __init__(self, file_pv, addr: str,
+                 auth_priv: Optional[Ed25519PrivKey] = None):
+        self.pv = file_pv
+        self.addr = addr
+        self._auth_priv = auth_priv or self.pv.priv_key
+        self._stopped = False
+
+    async def serve(self) -> None:
+        host, _, port = _strip_scheme(self.addr).rpartition(":")
+        reader, writer = await asyncio.open_connection(host, int(port))
+        sconn = await SecretConnection.handshake(
+            reader, writer, self._auth_priv
+        )
+        while not self._stopped:
+            try:
+                mtype, body = await _recv(sconn)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return
+            try:
+                await self._handle(sconn, mtype, body)
+            except Exception as e:
+                traceback.print_exc()
+                await _send(
+                    sconn, MSG_ERROR_RESPONSE, str(e).encode()
+                )
+
+    async def _handle(self, sconn, mtype: int, body: bytes) -> None:
+        if mtype == MSG_PUBKEY_REQUEST:
+            await _send(
+                sconn,
+                MSG_PUBKEY_RESPONSE,
+                bytes(self.pv.pub_key().key_bytes),
+            )
+        elif mtype == MSG_PING_REQUEST:
+            await _send(sconn, MSG_PING_RESPONSE)
+        elif mtype in (MSG_SIGN_VOTE_REQUEST, MSG_SIGN_PROPOSAL_REQUEST):
+            (ln,) = struct.unpack(">H", body[:2])
+            chain_id = body[2 : 2 + ln].decode()
+            rest = body[2 + ln:]
+            if mtype == MSG_SIGN_VOTE_REQUEST:
+                vote = codec.decode_vote(rest)
+                self.pv.sign_vote(chain_id, vote)  # double-sign guard HERE
+                await _send(
+                    sconn,
+                    MSG_SIGNED_VOTE_RESPONSE,
+                    codec.encode_vote(vote),
+                )
+            else:
+                prop = codec.decode_proposal(rest)
+                self.pv.sign_proposal(chain_id, prop)
+                await _send(
+                    sconn,
+                    MSG_SIGNED_PROPOSAL_RESPONSE,
+                    codec.encode_proposal(prop),
+                )
+        else:
+            raise RemoteSignerError(f"unknown request type {mtype}")
+
+    def stop(self) -> None:
+        self._stopped = True

@@ -100,6 +100,8 @@ class BlockExecutor:
         self.tolerance_ns = block_time_tolerance_ns
         # fork feature: skip re-validating a block we already validated
         self._last_validated: Optional[bytes] = None
+        # set by metrics: fn(seconds) per applied block
+        self.block_processing_observer = None
 
     # --- proposal creation (reference :114) ---------------------------
 
@@ -204,6 +206,7 @@ class BlockExecutor:
         self, state: State, block_id: T.BlockID, block: T.Block,
         verified: bool = False,
     ) -> State:
+        t0 = time.monotonic()
         if not verified:
             self.validate_block(state, block)
         req = abci.RequestFinalizeBlock(
@@ -226,6 +229,13 @@ class BlockExecutor:
             self.evpool.update(new_state, block.evidence)
         self._prune(new_state)
         self._fire_events(block, block_id, resp)
+        # observability hook (reference state/execution.go:292
+        # BlockProcessingTime metric)
+        if self.block_processing_observer is not None:
+            try:
+                self.block_processing_observer(time.monotonic() - t0)
+            except Exception:
+                pass
         return new_state
 
     def apply_verified_block(

@@ -1,0 +1,45 @@
+"""Metrics endpoint test: /metrics serves live consensus gauges."""
+
+import asyncio
+
+import aiohttp
+
+from cometbft_tpu.config.config import test_config as make_test_cfg
+from cometbft_tpu.node.inprocess import make_genesis
+from cometbft_tpu.node.node import Node
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def test_prometheus_metrics_endpoint():
+    gen, pvs = make_genesis(1, chain_id="metrics-chain")
+
+    async def main():
+        cfg = make_test_cfg(".")
+        cfg.instrumentation.prometheus = True
+        cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
+        node = Node(cfg, gen, privval=pvs[0])
+        await node.start()
+        node.parts.mempool.check_tx(b"m=1")
+        while node.height < 3:
+            await asyncio.sleep(0.05)
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://{node.metrics_server.listen_addr}/metrics"
+            ) as resp:
+                text = await resp.text()
+        assert 'cometbft_consensus_height{chain_id="metrics-chain"}' in text
+        h = [
+            ln
+            for ln in text.splitlines()
+            if ln.startswith("cometbft_consensus_height{")
+        ][0]
+        assert float(h.split()[-1]) >= 3
+        assert "cometbft_mempool_size" in text
+        assert "cometbft_p2p_peers" in text
+        assert "cometbft_consensus_total_txs" in text
+        await node.stop()
+
+    run(main())

@@ -1,0 +1,61 @@
+"""PEX / address book tests: discovery of indirect peers, seed-mode
+hang-up, unsolicited-response banning (reference p2p/pex tests)."""
+
+import asyncio
+
+from cometbft_tpu.config.config import test_config as make_test_cfg
+from cometbft_tpu.node.inprocess import make_genesis
+from cometbft_tpu.node.node import Node
+from cometbft_tpu.p2p.pex import AddrBook, KnownAddress
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def test_addrbook_basics(tmp_path):
+    book = AddrBook(str(tmp_path / "addrbook.json"), our_id="me")
+    assert book.add_address("aa@1.2.3.4:1")
+    assert not book.add_address("aa@1.2.3.4:1")  # dup
+    assert not book.add_address("me@5.6.7.8:1")  # self
+    book.mark_good("aa", "aa@1.2.3.4:1")
+    book.add_address("bb@2.3.4.5:2", src="aa")
+    sel = book.selection()
+    assert "aa@1.2.3.4:1" in sel and "bb@2.3.4.5:2" in sel
+    book.save()
+    book2 = AddrBook(str(tmp_path / "addrbook.json"), our_id="me")
+    assert book2.size() == 2
+    assert book2.addrs["aa"].is_old
+
+
+def test_pex_discovers_indirect_peer():
+    """A knows only B; B knows C. PEX must connect A to C."""
+    gen, pvs = make_genesis(3, chain_id="pex-chain")
+
+    async def main():
+        nodes = []
+        for i, pv in enumerate(pvs):
+            cfg = make_test_cfg(".")
+            cfg.base.moniker = f"node{i}"
+            cfg.blocksync.enable = False
+            cfg.p2p.pex = True
+            nodes.append(Node(cfg, gen, privval=pv))
+        for n in nodes:
+            await n.start()
+        a, b, c = nodes
+        await b.dial(c.listen_addr)  # B <-> C
+        await asyncio.sleep(0.2)
+        await a.dial(b.listen_addr)  # A -> B (outbound: requests addrs)
+        # crawl interval is 5s; wait for A to find C via the book
+        for _ in range(300):
+            if c.node_key.node_id in a.switch.peers:
+                break
+            await asyncio.sleep(0.1)
+        assert c.node_key.node_id in a.switch.peers, (
+            f"A peers: {list(a.switch.peers)}, "
+            f"book: {list(a.addr_book.addrs)}"
+        )
+        for n in nodes:
+            await n.stop()
+
+    run(main())
