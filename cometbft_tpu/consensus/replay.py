@@ -14,7 +14,11 @@ from typing import List, Optional
 from .. import types as T
 from ..abci import types as abci
 from ..state.state_types import State
-from ..state.execution import BlockExecutor, results_hash
+from ..state.execution import (
+    BlockExecutor,
+    encode_finalize_response,
+    results_hash,
+)
 
 
 class Handshaker:
@@ -95,6 +99,12 @@ class Handshaker:
             )
             resp = proxy_app.consensus.finalize_block(req)
             proxy_app.consensus.commit()
+            # persist the response: if the crash predated the original
+            # apply, state re-derivation below needs exactly this
+            # (reference ExecCommitBlock feeding replay recovery)
+            self.state_store.save_finalize_block_response(
+                h, encode_finalize_response(resp)
+            )
             self.n_blocks_replayed += 1
             app_hash = resp.app_hash
 

@@ -26,6 +26,7 @@ from ..config import ConsensusConfig
 from ..state.state_types import State
 from ..types import events as ev
 from ..utils import codec
+from ..utils.fail import fail_point
 from . import wal as walmod
 from .types import HeightVoteSet, RoundState, Step
 
@@ -275,15 +276,19 @@ class ConsensusState:
         """Shared tail of _finalize_commit and ingest_verified_block:
         persist, WAL-barrier, apply, advance to the next height."""
         height = block.height
+        fail_point("cs-before-save-block")  # reference state.go:1769
         if self.block_store.height() < height:
             self.block_store.save_block(block, parts, commit)
         else:
             self.block_store.save_seen_commit(height, commit)
+        fail_point("cs-after-save-block")  # :1786
         if self.wal:
             self.wal.write_end_height(height)
+        fail_point("cs-after-wal-end-height")  # :1809
         new_state = self.block_exec.apply_verified_block(
             self.state, bid, block
         )
+        fail_point("cs-after-apply")  # :1837
         self.decided_heights += 1
         if self.on_decided:
             try:

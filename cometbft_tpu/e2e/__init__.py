@@ -1,0 +1,6 @@
+"""End-to-end multi-process test harness (reference test/e2e/)."""
+
+from .manifest import Manifest, NodeSpec, Perturbation
+from .runner import Runner
+
+__all__ = ["Manifest", "NodeSpec", "Perturbation", "Runner"]

@@ -1,0 +1,108 @@
+"""Testnet manifests (reference test/e2e/pkg/manifest.go).
+
+TOML schema:
+
+    chain_id = "e2e-net"
+    target_height = 20
+    load_tx_rate = 5          # txs/sec across the net (0 = off)
+
+    [node.validator0]         # any number of [node.X] tables
+    mode = "validator"        # validator | full | seed | light (full = no key)
+    power = 10
+    start_at = 0              # join later (height); 0 = from genesis
+    block_sync = false
+    state_sync = false
+    adaptive_sync = false
+    mempool = "clist"         # clist | nop
+    kill_at = 0               # perturbations: height to SIGKILL then restart
+    pause_at = 0              # height to SIGSTOP for pause_s seconds
+    pause_s = 3.0
+    restart_delay_s = 2.0
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class Perturbation:
+    kind: str  # "kill" | "pause"
+    height: int
+    pause_s: float = 3.0
+    restart_delay_s: float = 2.0
+
+
+@dataclass
+class NodeSpec:
+    name: str
+    mode: str = "validator"
+    power: int = 10
+    start_at: int = 0
+    block_sync: bool = False
+    state_sync: bool = False
+    adaptive_sync: bool = False
+    mempool: str = "clist"
+    perturbations: List[Perturbation] = field(default_factory=list)
+
+
+@dataclass
+class Manifest:
+    chain_id: str = "e2e-net"
+    target_height: int = 20
+    load_tx_rate: float = 0.0
+    nodes: Dict[str, NodeSpec] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str) -> "Manifest":
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+        return cls.from_dict(raw)
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "Manifest":
+        m = cls(
+            chain_id=raw.get("chain_id", "e2e-net"),
+            target_height=int(raw.get("target_height", 20)),
+            load_tx_rate=float(raw.get("load_tx_rate", 0.0)),
+        )
+        for name, nd in (raw.get("node") or {}).items():
+            spec = NodeSpec(
+                name=name,
+                mode=nd.get("mode", "validator"),
+                power=int(nd.get("power", 10)),
+                start_at=int(nd.get("start_at", 0)),
+                block_sync=bool(nd.get("block_sync", False)),
+                state_sync=bool(nd.get("state_sync", False)),
+                adaptive_sync=bool(nd.get("adaptive_sync", False)),
+                mempool=nd.get("mempool", "clist"),
+            )
+            if nd.get("kill_at"):
+                spec.perturbations.append(
+                    Perturbation(
+                        "kill",
+                        int(nd["kill_at"]),
+                        restart_delay_s=float(
+                            nd.get("restart_delay_s", 2.0)
+                        ),
+                    )
+                )
+            if nd.get("pause_at"):
+                spec.perturbations.append(
+                    Perturbation(
+                        "pause",
+                        int(nd["pause_at"]),
+                        pause_s=float(nd.get("pause_s", 3.0)),
+                    )
+                )
+            m.nodes[name] = spec
+        if not m.nodes:
+            raise ValueError("manifest has no nodes")
+        if not any(
+            n.mode == "validator" and n.start_at == 0
+            for n in m.nodes.values()
+        ):
+            raise ValueError("manifest needs a genesis validator")
+        return m

@@ -1,0 +1,369 @@
+"""E2E runner (reference test/e2e/runner/): provision node homes from
+a manifest, launch real OS processes, apply tx load over RPC, inject
+perturbations (kill/restart, pause/resume), wait for the target
+height, then assert network-wide agreement.
+
+Usage:
+    python -m cometbft_tpu.e2e.runner manifest.toml [--dir DIR]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .. import types as T
+from ..config.config import default_config, write_toml
+from ..p2p.key import NodeKey
+from ..privval.file_pv import FilePV
+from ..types.genesis import GenesisDoc
+from .manifest import Manifest, NodeSpec
+
+BASE_PORT = 27000
+
+
+@dataclass
+class RunnerNode:
+    spec: NodeSpec
+    home: str
+    p2p_port: int
+    rpc_port: int
+    node_id: str = ""
+    proc: Optional[subprocess.Popen] = None
+    started: bool = False
+
+    @property
+    def rpc(self) -> str:
+        return f"http://127.0.0.1:{self.rpc_port}"
+
+
+class Runner:
+    def __init__(self, manifest: Manifest, base_dir: str,
+                 base_port: int = BASE_PORT):
+        self.m = manifest
+        self.dir = base_dir
+        self.nodes: Dict[str, RunnerNode] = {}
+        port = base_port
+        for name, spec in manifest.nodes.items():
+            self.nodes[name] = RunnerNode(
+                spec, os.path.join(base_dir, name), port, port + 1
+            )
+            port += 2
+        self.failures: List[str] = []
+
+    # --- provisioning -------------------------------------------------
+
+    def setup(self) -> None:
+        shutil.rmtree(self.dir, ignore_errors=True)
+        validators = []
+        pvs = {}
+        for name, rn in self.nodes.items():
+            os.makedirs(os.path.join(rn.home, "config"), exist_ok=True)
+            os.makedirs(os.path.join(rn.home, "data"), exist_ok=True)
+            pv = FilePV.load_or_generate(
+                os.path.join(rn.home, "config", "priv_validator_key.json"),
+                os.path.join(rn.home, "data", "priv_validator_state.json"),
+            )
+            pvs[name] = pv
+            nk = NodeKey.load_or_gen(
+                os.path.join(rn.home, "config", "node_key.json")
+            )
+            rn.node_id = nk.node_id
+            if rn.spec.mode == "validator":
+                validators.append(T.Validator(pv.pub_key(), rn.spec.power))
+        gen = GenesisDoc(chain_id=self.m.chain_id, validators=validators)
+        peers = ",".join(
+            f"{rn.node_id}@127.0.0.1:{rn.p2p_port}"
+            for rn in self.nodes.values()
+        )
+        for name, rn in self.nodes.items():
+            cfg = default_config(rn.home)
+            cfg.base.moniker = name
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{rn.p2p_port}"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{rn.rpc_port}"
+            cfg.p2p.persistent_peers = ",".join(
+                p for p in peers.split(",")
+                if not p.startswith(rn.node_id)
+            )
+            cfg.blocksync.enable = rn.spec.block_sync or rn.spec.state_sync
+            cfg.blocksync.adaptive_sync = rn.spec.adaptive_sync
+            cfg.mempool.type_ = rn.spec.mempool
+            cfg.consensus.timeout_commit_s = 0.2
+            if rn.spec.state_sync:
+                cfg.statesync.enable = True
+                cfg.statesync.rpc_servers = [
+                    f"127.0.0.1:{o.rpc_port}"
+                    for o in self.nodes.values()
+                    if o.spec.start_at == 0 and o.spec.name != name
+                ][:2]
+                cfg.statesync.trust_height = 1  # filled at start_at time
+                cfg.statesync.discovery_time_s = 15.0
+            write_toml(cfg, os.path.join(rn.home, "config", "config.toml"))
+            with open(
+                os.path.join(rn.home, "config", "genesis.json"), "w"
+            ) as f:
+                f.write(gen.to_json())
+            if rn.spec.mode in ("full", "light", "seed"):
+                os.remove(
+                    os.path.join(
+                        rn.home, "config", "priv_validator_key.json"
+                    )
+                )
+
+    # --- process control ----------------------------------------------
+
+    def _launch(self, rn: RunnerNode) -> None:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        rn.proc = subprocess.Popen(
+            [sys.executable, "-m", "cometbft_tpu", "--home", rn.home, "start"],
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+            env=env,
+            stdout=open(os.path.join(rn.home, "node.log"), "a"),
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        rn.started = True
+
+    def _rpc(self, rn: RunnerNode, path: str, timeout: float = 3.0):
+        with urllib.request.urlopen(
+            f"{rn.rpc}/{path}", timeout=timeout
+        ) as r:
+            body = json.load(r)
+        if "result" not in body:
+            raise RuntimeError(body.get("error"))
+        return body["result"]
+
+    def _rpc_post(self, rn: RunnerNode, method: str, params: dict,
+                  timeout: float = 3.0):
+        req = urllib.request.Request(
+            rn.rpc + "/",
+            data=json.dumps(
+                {"jsonrpc": "2.0", "id": 1, "method": method,
+                 "params": params}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            body = json.load(r)
+        if "result" not in body:
+            raise RuntimeError(body.get("error"))
+        return body["result"]
+
+    def _height(self, rn: RunnerNode) -> int:
+        try:
+            return int(
+                self._rpc(rn, "status")["sync_info"]["latest_block_height"]
+            )
+        except Exception:
+            return -1
+
+    def network_height(self) -> int:
+        return max(
+            (self._height(rn) for rn in self.nodes.values() if rn.started),
+            default=-1,
+        )
+
+    async def _network_height(self) -> int:
+        # a SIGSTOP'd node accepts TCP but never answers; keep the 3s
+        # stalls off the event loop
+        return await asyncio.to_thread(self.network_height)
+
+    # --- phases -------------------------------------------------------
+
+    async def run(self, timeout_s: float = 300.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        # start genesis nodes
+        for rn in self.nodes.values():
+            if rn.spec.start_at == 0:
+                self._launch(rn)
+        load_task = (
+            asyncio.create_task(self._load_routine())
+            if self.m.load_tx_rate > 0
+            else None
+        )
+        pert_tasks = [
+            asyncio.create_task(self._perturb_routine(rn))
+            for rn in self.nodes.values()
+            if rn.spec.perturbations
+        ]
+        late = [
+            rn for rn in self.nodes.values() if rn.spec.start_at > 0
+        ]
+        try:
+            while time.monotonic() < deadline:
+                h = await self._network_height()
+                for rn in late[:]:
+                    if h >= rn.spec.start_at:
+                        self._fill_trust(rn)
+                        self._launch(rn)
+                        late.remove(rn)
+                if h >= self.m.target_height:
+                    break
+                await asyncio.sleep(0.5)
+            else:
+                self.failures.append(
+                    f"timed out below target height "
+                    f"({self.network_height()}/{self.m.target_height})"
+                )
+            # wait for EVERY node (incl. late joiners) to converge —
+            # pointless if the net never reached the target at all
+            conv_deadline = time.monotonic() + (
+                120.0 if not self.failures else 0.0
+            )
+            hs = {}
+            while time.monotonic() < conv_deadline:
+                hs = {
+                    n: await asyncio.to_thread(self._height, rn)
+                    for n, rn in self.nodes.items()
+                    if rn.started
+                }
+                if all(h >= self.m.target_height for h in hs.values()):
+                    break
+                await asyncio.sleep(0.5)
+            else:
+                self.failures.append(f"nodes failed to converge: {hs}")
+        finally:
+            if load_task:
+                load_task.cancel()
+            for t in pert_tasks:
+                t.cancel()
+        self._check_agreement()
+        return not self.failures
+
+    def _fill_trust(self, rn: RunnerNode) -> None:
+        """Late statesync nodes need a live trust root."""
+        if not rn.spec.state_sync:
+            return
+        src = next(
+            o for o in self.nodes.values()
+            if o.started and o.spec.start_at == 0
+        )
+        blk = self._rpc(src, "block?height=1")
+        import tomllib
+
+        cfg_path = os.path.join(rn.home, "config", "config.toml")
+        with open(cfg_path) as f:
+            text = f.read()
+        text = text.replace(
+            'trust_hash = ""',
+            f'trust_hash = "{blk["block_id"]["hash"].lower()}"',
+        )
+        with open(cfg_path, "w") as f:
+            f.write(text)
+
+    # --- load + perturbations -----------------------------------------
+
+    async def _load_routine(self) -> None:
+        import base64
+
+        seq = 0
+        interval = 1.0 / self.m.load_tx_rate
+        targets = [
+            rn for rn in self.nodes.values() if rn.spec.start_at == 0
+        ]
+        while True:
+            rn = targets[seq % len(targets)]
+            tx = base64.b64encode(
+                b"load-%06d=v%d" % (seq, seq)
+            ).decode()
+            seq += 1
+            try:
+                # JSON-RPC POST: base64 '+'/'/' chars survive (GET
+                # query strings decode '+' to space)
+                await asyncio.to_thread(
+                    self._rpc_post, rn, "broadcast_tx_sync",
+                    {"tx": tx}, 2.0,
+                )
+            except Exception:
+                pass
+            await asyncio.sleep(interval)
+
+    async def _perturb_routine(self, rn: RunnerNode) -> None:
+        for pert in sorted(rn.spec.perturbations, key=lambda p: p.height):
+            while self.network_height() < pert.height:
+                await asyncio.sleep(0.3)
+            if not rn.proc:
+                continue
+            if pert.kind == "kill":
+                print(f"[perturb] SIGKILL {rn.spec.name}", flush=True)
+                rn.proc.send_signal(signal.SIGKILL)
+                rn.proc.wait()
+                await asyncio.sleep(pert.restart_delay_s)
+                print(f"[perturb] restart {rn.spec.name}", flush=True)
+                self._launch(rn)
+            elif pert.kind == "pause":
+                print(f"[perturb] SIGSTOP {rn.spec.name}", flush=True)
+                rn.proc.send_signal(signal.SIGSTOP)
+                await asyncio.sleep(pert.pause_s)
+                print(f"[perturb] SIGCONT {rn.spec.name}", flush=True)
+                rn.proc.send_signal(signal.SIGCONT)
+
+    # --- assertions ---------------------------------------------------
+
+    def _check_agreement(self) -> None:
+        """All nodes must agree on the block at target height."""
+        target = self.m.target_height
+        hashes = {}
+        for name, rn in self.nodes.items():
+            if not rn.started:
+                continue
+            try:
+                res = self._rpc(rn, f"block?height={target}")
+                hashes[name] = res["block_id"]["hash"]
+            except Exception as e:
+                self.failures.append(f"{name}: no block {target}: {e}")
+        if len(set(hashes.values())) > 1:
+            self.failures.append(f"HASH DISAGREEMENT at {target}: {hashes}")
+
+    def stop(self) -> None:
+        for rn in self.nodes.values():
+            if rn.proc is not None:
+                try:
+                    rn.proc.send_signal(signal.SIGCONT)  # unfreeze
+                    rn.proc.terminate()
+                except ProcessLookupError:
+                    pass
+        for rn in self.nodes.values():
+            if rn.proc is not None:
+                try:
+                    rn.proc.wait(timeout=5)
+                except Exception:
+                    rn.proc.kill()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="cometbft-tpu-e2e")
+    ap.add_argument("manifest")
+    ap.add_argument("--dir", default="/tmp/cometbft-e2e")
+    ap.add_argument("--timeout", type=float, default=300.0)
+    args = ap.parse_args(argv)
+    m = Manifest.load(args.manifest)
+    runner = Runner(m, args.dir)
+    runner.setup()
+    try:
+        ok = asyncio.run(runner.run(args.timeout))
+    finally:
+        runner.stop()
+    if ok:
+        print(f"PASS: {len(m.nodes)} nodes converged at height "
+              f">= {m.target_height}")
+        return 0
+    print("FAIL:")
+    for f in runner.failures:
+        print(f"  - {f}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

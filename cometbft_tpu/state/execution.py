@@ -18,6 +18,7 @@ from ..abci import types as abci
 from ..crypto import merkle
 from ..types import events as ev
 from ..utils import codec, proto
+from ..utils.fail import fail_point
 from .state_types import BLOCK_VERSION, State
 from .validation import validate_block
 
@@ -218,11 +219,13 @@ class BlockExecutor:
             proposer_address=block.header.proposer_address,
         )
         resp = self.proxy.finalize_block(req)
+        fail_point("exec-after-finalize")  # reference execution.go:313
         if len(resp.tx_results) != len(block.data.txs):
             raise RuntimeError("app returned wrong number of tx results")
         self.store.save_finalize_block_response(
             block.height, encode_finalize_response(resp)
         )
+        fail_point("exec-after-save-response")  # :320
         new_state = self._update_state(state, block_id, block, resp)
         self._commit(new_state, block, resp)
         if self.evpool:
@@ -248,7 +251,9 @@ class BlockExecutor:
     def _commit(self, state: State, block: T.Block, resp) -> None:
         self.mempool.lock()
         try:
+            fail_point("exec-before-abci-commit")  # :355
             cres = self.proxy.commit()
+            fail_point("exec-after-abci-commit")  # :363
             self.mempool.update(
                 block.height, block.data.txs, resp.tx_results
             )

@@ -1,0 +1,106 @@
+"""Crash-point recovery tests (reference consensus/replay_test.go +
+libs/fail): kill a node at exact WAL/commit interleavings via
+FAIL_TEST_INDEX, restart it, and require full recovery — the
+subtle-bug farm called out in SURVEY.md §7."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rpc(port, path, timeout=3.0):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/{path}", timeout=timeout
+    ) as r:
+        return json.load(r)["result"]
+
+
+def _wait_height(port, h, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            cur = int(
+                _rpc(port, "status")["sync_info"]["latest_block_height"]
+            )
+            if cur >= h:
+                return cur
+        except Exception:
+            pass
+        time.sleep(0.3)
+    raise TimeoutError(f"port {port} never reached height {h}")
+
+
+def _launch(home, port, fail_index=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if fail_index is not None:
+        env["FAIL_TEST_INDEX"] = str(fail_index)
+    return subprocess.Popen(
+        [sys.executable, "-m", "cometbft_tpu", "--home", home, "start"],
+        cwd=REPO,
+        env=env,
+        stdout=open(os.path.join(home, "node.log"), "a"),
+        stderr=subprocess.STDOUT,
+        start_new_session=True,
+    )
+
+
+@pytest.mark.parametrize("fail_index", [0, 2, 5, 9, 17])
+def test_crash_at_fail_point_then_recover(tmp_path, fail_index):
+    """Crash at the fail_index'th crash-point call, restart, verify the
+    chain recovers and keeps producing (handshake replay repairs any
+    store/app divergence)."""
+    home = str(tmp_path / "node")
+    port = 27400 + fail_index
+    subprocess.run(
+        [sys.executable, "-m", "cometbft_tpu", "--home", home, "init",
+         "--chain-id", f"crash-{fail_index}"],
+        cwd=REPO, check=True, capture_output=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    # point config at our test port + fast blocks
+    cfg_path = os.path.join(home, "config", "config.toml")
+    with open(cfg_path) as f:
+        text = f.read()
+    text = text.replace(
+        'laddr = "tcp://0.0.0.0:26656"', 'laddr = "tcp://127.0.0.1:0"'
+    ).replace(
+        'laddr = "tcp://127.0.0.1:26657"',
+        f'laddr = "tcp://127.0.0.1:{port}"',
+    ).replace("timeout_commit_s = 1.0", "timeout_commit_s = 0.1")
+    with open(cfg_path, "w") as f:
+        f.write(text)
+
+    # run with the crash armed; it must die with exit code 99
+    proc = _launch(home, port, fail_index=fail_index)
+    try:
+        rc = proc.wait(timeout=120)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        raise
+    assert rc == 99, f"expected fail-point death, got exit {rc}"
+
+    # restart WITHOUT injection: must recover and keep producing
+    proc = _launch(home, port)
+    try:
+        h = _wait_height(port, 3, timeout=90)
+        # app state consistent: replayed chain serves queries
+        res = _rpc(port, "abci_info")
+        assert int(res["response"]["last_block_height"]) >= 1
+        # and it's still advancing
+        h2 = _wait_height(port, h + 2, timeout=30)
+        assert h2 > h
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()

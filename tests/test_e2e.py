@@ -1,0 +1,62 @@
+"""E2E harness smoke test: manifest-driven multi-process net with a
+kill/restart perturbation, a paused node, tx load, and a late
+blocksync joiner (reference test/e2e, scaled down for CI)."""
+
+import asyncio
+import os
+
+import pytest
+
+from cometbft_tpu.e2e.manifest import Manifest
+from cometbft_tpu.e2e.runner import Runner
+
+MANIFEST = {
+    "chain_id": "e2e-smoke",
+    "target_height": 12,
+    "load_tx_rate": 4,
+    "node": {
+        "val0": {"mode": "validator"},
+        "val1": {"mode": "validator", "kill_at": 5},
+        "val2": {"mode": "validator", "pause_at": 4, "pause_s": 2.0},
+        "val3": {"mode": "validator"},
+        "full0": {
+            "mode": "full",
+            "start_at": 6,
+            "block_sync": True,
+        },
+    },
+}
+
+
+@pytest.mark.slow
+def test_e2e_smoke(tmp_path):
+    m = Manifest.from_dict(MANIFEST)
+    runner = Runner(m, str(tmp_path / "net"), base_port=27300)
+    runner.setup()
+    heights = {}
+    try:
+        ok = asyncio.run(
+            asyncio.wait_for(runner.run(timeout_s=240.0), 280)
+        )
+        heights = {
+            name: runner._height(rn)
+            for name, rn in runner.nodes.items()
+        }
+    finally:
+        runner.stop()
+    assert ok, runner.failures
+    # the killed validator recovered; the late full node blocksynced
+    assert heights["val1"] >= m.target_height, heights
+    assert heights["full0"] >= m.target_height, heights
+
+
+def test_manifest_validation():
+    with pytest.raises(ValueError):
+        Manifest.from_dict({"node": {}})
+    with pytest.raises(ValueError):
+        Manifest.from_dict(
+            {"node": {"a": {"mode": "full"}}}
+        )
+    m = Manifest.from_dict(MANIFEST)
+    assert m.nodes["val1"].perturbations[0].kind == "kill"
+    assert m.nodes["val2"].perturbations[0].kind == "pause"
