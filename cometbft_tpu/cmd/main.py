@@ -117,10 +117,16 @@ def cmd_start(args) -> int:
             else None
         )
     nk = NodeKey.load_or_gen(p["node_key"])
+    app = None
+    if cfg.base.abci == "kvstore-appmem":
+        from ..models.kvstore import AppMempoolKVStore
+
+        app = AppMempoolKVStore()
 
     async def main():
         node = Node(
-            cfg, gen, privval=pv, node_key=nk, home=os.path.join(home, "data")
+            cfg, gen, privval=pv, node_key=nk, app=app,
+            home=os.path.join(home, "data"),
         )
         await node.start()
         print(

@@ -60,6 +60,7 @@ class P2PConfig:
     handshake_timeout_s: float = 20.0
     dial_timeout_s: float = 3.0
     use_libp2p_equivalent: bool = False  # fork: lp2p transport selection
+    use_autopool: bool = False  # fork: autopool reactor msg draining
 
 
 @dataclass

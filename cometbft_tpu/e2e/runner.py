@@ -203,7 +203,7 @@ class Runner:
                 h = await self._network_height()
                 for rn in late[:]:
                     if h >= rn.spec.start_at:
-                        self._fill_trust(rn)
+                        await asyncio.to_thread(self._fill_trust, rn)
                         self._launch(rn)
                         late.remove(rn)
                 if h >= self.m.target_height:
@@ -216,21 +216,31 @@ class Runner:
                 )
             # wait for EVERY node (incl. late joiners) to converge —
             # pointless if the net never reached the target at all
-            conv_deadline = time.monotonic() + (
-                120.0 if not self.failures else 0.0
-            )
-            hs = {}
-            while time.monotonic() < conv_deadline:
-                hs = {
-                    n: await asyncio.to_thread(self._height, rn)
-                    for n, rn in self.nodes.items()
-                    if rn.started
-                }
-                if all(h >= self.m.target_height for h in hs.values()):
-                    break
-                await asyncio.sleep(0.5)
-            else:
-                self.failures.append(f"nodes failed to converge: {hs}")
+            if not self.failures:
+                conv_deadline = time.monotonic() + 120.0
+                hs = {}
+                while time.monotonic() < conv_deadline:
+                    started = [
+                        (n, rn)
+                        for n, rn in self.nodes.items()
+                        if rn.started
+                    ]
+                    heights = await asyncio.gather(
+                        *(
+                            asyncio.to_thread(self._height, rn)
+                            for _, rn in started
+                        )
+                    )
+                    hs = dict(zip((n for n, _ in started), heights))
+                    if all(
+                        h >= self.m.target_height for h in hs.values()
+                    ):
+                        break
+                    await asyncio.sleep(0.5)
+                else:
+                    self.failures.append(
+                        f"nodes failed to converge: {hs}"
+                    )
         finally:
             if load_task:
                 load_task.cancel()
@@ -248,8 +258,6 @@ class Runner:
             if o.started and o.spec.start_at == 0
         )
         blk = self._rpc(src, "block?height=1")
-        import tomllib
-
         cfg_path = os.path.join(rn.home, "config", "config.toml")
         with open(cfg_path) as f:
             text = f.read()
@@ -289,7 +297,7 @@ class Runner:
 
     async def _perturb_routine(self, rn: RunnerNode) -> None:
         for pert in sorted(rn.spec.perturbations, key=lambda p: p.height):
-            while self.network_height() < pert.height:
+            while await self._network_height() < pert.height:
                 await asyncio.sleep(0.3)
             if not rn.proc:
                 continue

@@ -299,6 +299,9 @@ class AppMempool(Mempool):
     def reap_max_bytes_max_gas(self, max_bytes, max_gas):
         return self.proxy.reap_txs(max_bytes, max_gas)
 
+    def iter_txs(self):
+        return []  # the app owns the pool; nothing to walk
+
     def update(self, height, txs, results):
         self._txs_available.clear()
 

@@ -83,3 +83,41 @@ class MempoolReactor(Reactor):
             self.mempool.check_tx(msg, sender=peer.peer_id)
         except Exception:
             pass  # invalid txs are dropped, not fatal to the peer
+
+
+class AppMempoolReactor(Reactor):
+    """Fork feature: gossip for the app-side mempool (reference
+    mempool/app_reactor.go). The app owns tx storage, so there is no
+    pool to walk — relaying is flood-with-dedup: a tx accepted by
+    InsertTx (guard-deduplicated) is forwarded to every OTHER peer
+    exactly once."""
+
+    name = "mempool"
+
+    def __init__(self, mempool, broadcast: bool = True):
+        super().__init__()
+        self.mempool = mempool  # AppMempool
+        self.broadcast = broadcast
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(MEMPOOL_CHANNEL, priority=5, max_msg_size=1 << 20)
+        ]
+
+    def submit_local(self, tx: bytes):
+        """Entry for locally-submitted txs (RPC broadcast_tx path)."""
+        res = self.mempool.check_tx(tx)
+        if res.is_ok() and self.broadcast and self.switch is not None:
+            self.switch.broadcast(MEMPOOL_CHANNEL, tx)
+        return res
+
+    def receive(self, chan_id: int, peer, msg: bytes) -> None:
+        try:
+            res = self.mempool.check_tx(msg, sender=peer.peer_id)
+        except Exception:
+            return
+        if res.is_ok() and self.broadcast and self.switch is not None:
+            # forward to everyone but the sender (guard stops loops)
+            for p in self.switch.peers.values():
+                if p.peer_id != peer.peer_id:
+                    p.try_send(MEMPOOL_CHANNEL, msg)

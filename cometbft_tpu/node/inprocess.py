@@ -112,7 +112,23 @@ def build_node(
         tx_indexer = TxIndexer(index_db)
         block_indexer = BlockIndexer(index_db)
         IndexerService(tx_indexer, block_indexer, event_bus).start()
-    mempool = CListMempool(proxy.mempool)
+    # mempool flavor by config: clist | app (fork) | nop (ADR-111)
+    if config.mempool.type_ == "app":
+        from ..mempool.mempool import AppMempool
+
+        mempool = AppMempool(proxy.mempool)
+    elif config.mempool.type_ == "nop":
+        from ..mempool.mempool import NopMempool
+
+        mempool = NopMempool()
+    else:
+        mempool = CListMempool(
+            proxy.mempool,
+            cache_size=config.mempool.cache_size,
+            max_tx_bytes=config.mempool.max_tx_bytes,
+            max_txs=config.mempool.size,
+            recheck=config.mempool.recheck,
+        )
     block_exec = BlockExecutor(
         state_store,
         proxy.consensus,

@@ -69,6 +69,7 @@ class Node:
                 "recv_rate": config.p2p.recv_rate,
                 "flush_throttle_s": config.p2p.flush_throttle_ms / 1000.0,
             },
+            use_autopool=config.p2p.use_autopool,
         )
 
         blocksync_active = config.blocksync.enable and not config.statesync.enable
@@ -83,9 +84,16 @@ class Node:
             self.parts.block_store,
             wait_sync=sync_pending,
         )
-        self.mempool_reactor = MempoolReactor(
-            self.parts.mempool, broadcast=config.mempool.broadcast
-        )
+        if config.mempool.type_ == "app":
+            from ..mempool.reactor import AppMempoolReactor
+
+            self.mempool_reactor = AppMempoolReactor(
+                self.parts.mempool, broadcast=config.mempool.broadcast
+            )
+        else:
+            self.mempool_reactor = MempoolReactor(
+                self.parts.mempool, broadcast=config.mempool.broadcast
+            )
         self.evidence_reactor = EvidenceReactor(self.parts.evpool)
         self.blocksync_reactor = BlockSyncNetReactor(
             self.parts.state,

@@ -35,7 +35,13 @@ class Switch:
         node_info: NodeInfo,
         max_peers: int = DEFAULT_MAX_PEERS,
         mconn_config: Optional[dict] = None,
+        use_autopool: bool = False,
     ):
+        # fork feature: reactor messages can be drained by an
+        # auto-scaling worker pool (reference lp2p/reactor_set.go +
+        # internal/autopool) instead of inline dispatch
+        self._autopool = None
+        self._use_autopool = use_autopool
         self.transport = transport
         self.node_info = node_info
         self.reactors: Dict[str, Reactor] = {}
@@ -71,12 +77,19 @@ class Switch:
     # --- lifecycle ----------------------------------------------------
 
     async def start(self) -> None:
+        if self._use_autopool:
+            from ..utils.autopool import AutoPool
+
+            self._autopool = AutoPool(min_workers=2, max_workers=16)
+            self._autopool.start()
         for r in self.reactors.values():
             await r.start()
         self._accept_task = asyncio.create_task(self._accept_routine())
 
     async def stop(self) -> None:
         self._stopped = True
+        if self._autopool is not None:
+            await self._autopool.stop()
         if self._accept_task:
             self._accept_task.cancel()
         for t in self._reconnect_tasks.values():
@@ -191,6 +204,17 @@ class Switch:
                 peer, ValueError(f"msg on unclaimed channel {chan_id:#x}")
             )
             return
+        if self._autopool is not None:
+            if not self._autopool.submit(
+                self._dispatch, reactor, chan_id, peer, msg
+            ):
+                # saturated pool: dispatch inline rather than dropping
+                # (a lost vote/part can stall a consensus round)
+                self._dispatch(reactor, chan_id, peer, msg)
+            return
+        self._dispatch(reactor, chan_id, peer, msg)
+
+    def _dispatch(self, reactor, chan_id: int, peer: Peer, msg: bytes):
         try:
             reactor.receive(chan_id, peer, msg)
         except Exception as e:

@@ -24,6 +24,14 @@ class Environment:
     node_info: object = None
     privval_pubkey: object = None
     config: object = None
+    mempool_reactor: object = None  # for app-mempool local submission
+
+    def submit_tx(self, tx: bytes):
+        """CheckTx + (app-mempool) gossip: RPC broadcast entry point."""
+        r = self.mempool_reactor
+        if r is not None and hasattr(r, "submit_local"):
+            return r.submit_local(tx)
+        return self.mempool.check_tx(tx)
 
     @classmethod
     def from_node(cls, node) -> "Environment":
@@ -46,4 +54,5 @@ class Environment:
                 p.privval.pub_key() if p.privval is not None else None
             ),
             config=node.config,
+            mempool_reactor=node.mempool_reactor,
         )

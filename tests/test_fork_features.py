@@ -1,0 +1,154 @@
+"""Fork-feature tests: app-side mempool gossip, autopool scaling,
+light RPC proxy (reference app_mempool/app_reactor, internal/autopool,
+light/proxy)."""
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from cometbft_tpu.config.config import test_config as make_test_cfg
+from cometbft_tpu.node.inprocess import make_genesis
+from cometbft_tpu.node.node import Node
+from cometbft_tpu.utils.autopool import AutoPool
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def test_app_mempool_net_commits_txs():
+    """Nodes with the app-owned mempool: tx submitted at one node is
+    gossiped, stored by the APP, reaped into a block network-wide."""
+    gen, pvs = make_genesis(3, chain_id="appmem-chain")
+
+    async def main():
+        from cometbft_tpu.models.kvstore import AppMempoolKVStore
+
+        nodes = []
+        for i, pv in enumerate(pvs):
+            cfg = make_test_cfg(".")
+            cfg.base.moniker = f"node{i}"
+            cfg.blocksync.enable = False
+            cfg.mempool.type_ = "app"
+            nodes.append(
+                Node(cfg, gen, privval=pv, app=AppMempoolKVStore())
+            )
+        for n in nodes:
+            await n.start()
+        for i, a in enumerate(nodes):
+            for b in nodes[i + 1:]:
+                await a.dial(b.listen_addr)
+        # submit through the reactor's local path (RPC equivalent)
+        nodes[1].mempool_reactor.submit_local(b"appmem=works")
+
+        async def committed():
+            while True:
+                for n in nodes:
+                    for h in range(1, n.height + 1):
+                        blk = n.parts.block_store.load_block(h)
+                        if blk and b"appmem=works" in blk.data.txs:
+                            return True
+                await asyncio.sleep(0.05)
+
+        assert await asyncio.wait_for(committed(), 30)
+        # the app answers queries for the committed tx (node 2 may
+        # apply the block a moment after the first node commits)
+        from cometbft_tpu.abci import types as abci
+
+        async def queryable():
+            while True:
+                res = nodes[2].parts.proxy.query.query(
+                    abci.RequestQuery(path="/store", data=b"appmem")
+                )
+                if res.value == b"works":
+                    return True
+                await asyncio.sleep(0.05)
+
+        assert await asyncio.wait_for(queryable(), 15)
+        for n in nodes:
+            await n.stop()
+
+    run(main())
+
+
+def test_autopool_scales_up_and_down():
+    async def main():
+        pool = AutoPool(min_workers=1, max_workers=4)
+        pool.start()
+        assert pool.size == 1
+        gate = asyncio.Event()
+
+        async def slow_job():
+            await gate.wait()
+
+        for _ in range(400):
+            pool.submit(slow_job)
+        # scaler should grow the pool against the backlog
+        for _ in range(40):
+            if pool.size >= 2:
+                break
+            await asyncio.sleep(0.1)
+        assert pool.size >= 2
+        gate.set()
+        # drain, then shrink back toward min
+        for _ in range(100):
+            if pool.queue.qsize() == 0 and pool.size == 1:
+                break
+            await asyncio.sleep(0.1)
+        assert pool.queue.qsize() == 0
+        assert pool.size == 1
+        assert pool.processed >= 400
+        await pool.stop()
+
+    run(main())
+
+
+def test_light_proxy_serves_verified_data():
+    gen, pvs = make_genesis(2, chain_id="lproxy-chain")
+
+    async def main():
+        n0 = Node(make_test_cfg("."), gen, privval=pvs[0])
+        n1 = Node(make_test_cfg("."), gen, privval=pvs[1])
+        await n0.start()
+        await n1.start()
+        await n0.dial(n1.listen_addr)
+        while n0.height < 5:
+            await asyncio.sleep(0.05)
+
+        from cometbft_tpu.light import Client, TrustOptions
+        from cometbft_tpu.light.http_provider import HTTPProvider
+        from cometbft_tpu.light.proxy import LightProxy
+
+        trust = n0.parts.block_store.load_block(1)
+        lc = await asyncio.to_thread(
+            Client,
+            "lproxy-chain",
+            TrustOptions(
+                period_ns=3600 * 10**9, height=1, hash=trust.hash()
+            ),
+            HTTPProvider("lproxy-chain", n0.rpc_server.listen_addr),
+        )
+        proxy = LightProxy(lc, n0.rpc_server.listen_addr)
+        await proxy.start("127.0.0.1:0")
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://{proxy.listen_addr}/commit?height=3"
+            ) as resp:
+                body = await resp.json()
+        r = body["result"]
+        assert r["verified"] is True
+        assert int(r["signed_header"]["header"]["height"]) == 3
+        # block route cross-checks primary data against verified header
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://{proxy.listen_addr}/block?height=3"
+            ) as resp:
+                body = await resp.json()
+        assert body["result"]["verified"] is True
+        await proxy.stop()
+        await n0.stop()
+        await n1.stop()
+
+    run(main())
