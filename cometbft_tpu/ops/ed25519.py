@@ -8,10 +8,12 @@ verifies N signatures in parallel lanes:
     per lane:  h  = SHA-512(R || A || M)  mod L          (on device)
                ok = [8]([S]B - [h]A - R) == identity     (ZIP-215, cofactored)
 
-The double-scalar multiplication [S]B + [L-h]A runs as a shared 253-step
-Straus ladder (1 doubling + 1 complete addition per step, 4-way
-branch-free point select), vectorized over the batch on the 8x128 VPU
-lanes. All point/field math is int32 limb arithmetic (see fe25519).
+The double-scalar multiplication [S]B + [L-h]A runs as a shared 4-bit
+windowed Straus ladder (64 windows x 4 doublings, one cached add from a
+per-lane [d]A table and one affine-cached add from a host-precomputed
+[d]B table per window, branch-free 16-way point selects), vectorized
+over the batch on the 8x128 VPU lanes. All point/field math is int32
+limb arithmetic (see fe25519).
 
 Unlike the reference's random-linear-combination batch verify (which
 rejects the whole batch on one bad signature and needs a CPU fallback
@@ -50,28 +52,73 @@ def bucket_cap(max_len: int) -> int:
     raise ValueError(f"message too long for verify kernel: {max_len}")
 
 
+_B_TABLE = None
+
+
+def _b_table():
+    global _B_TABLE
+    if _B_TABLE is None:
+        _B_TABLE = curve.base_window_table()  # (16, 3, 20) host const
+    return _B_TABLE
+
+
 def _straus(s_limbs, hneg_limbs, A):
-    """[s]B + [hneg]A over (20, N) lanes; 253-step joint ladder."""
+    """[s]B + [hneg]A over (20, N) lanes.
+
+    4-bit windowed joint ladder: 64 windows x (4 doublings) — the first
+    group acts on the identity — plus per window one cached add from
+    the per-lane A table (8M) and one affine-cached add from the shared
+    host-precomputed B table (7M). ~27% fewer field multiplies than the
+    bitwise ladder (253 x (double + 9M add)), and the window tables'
+    d=0 entries are the identity in cached form so the adds stay
+    branch-free and complete."""
     shape = s_limbs.shape[1:]
-    bits_s = sc.bits(s_limbs)      # (253, N)
-    bits_h = sc.bits(hneg_limbs)
-    B = curve.base_lanes(shape)
-    AB = curve.add(A, B)
+    ds = sc.digits4(s_limbs)      # (64, N) windows, LSB-first
+    dh = sc.digits4(hneg_limbs)
     ident = curve.identity(shape)
 
-    def body(i, q):
-        j = 252 - i
-        bs = lax.dynamic_index_in_dim(bits_s, j, 0, keepdims=False)
-        bh = lax.dynamic_index_in_dim(bits_h, j, 0, keepdims=False)
-        sel = jnp.broadcast_to((bs + 2 * bh)[None], (fe.NLIMBS,) + shape)
-        q = curve.double(q)
-        addend = tuple(
-            lax.select_n(sel, ic, bc, ac, abc)
-            for ic, bc, ac, abc in zip(ident, B, A, AB)
-        )
-        return curve.add(q, addend)
+    # per-lane A table: cached([d]A) for d in 0..15, stacked (16, 20, N)
+    ext = ident
+    a_cached = [curve.to_cached(ident)]
+    for _ in range(15):
+        ext = curve.add(ext, A)
+        a_cached.append(curve.to_cached(ext))
+    a_tbl = tuple(
+        jnp.stack([c[k] for c in a_cached], axis=0) for k in range(4)
+    )
 
-    return lax.fori_loop(0, 253, body, ident)
+    # shared B table: (16, 3, 20) constants broadcast per select
+    bt = jnp.asarray(_b_table())  # (16, 3, 20) int32
+    b_tbl = tuple(
+        bt[:, k, :].reshape((16, fe.NLIMBS) + (1,) * len(shape))
+        for k in range(3)
+    )
+
+    def body(i, q):
+        j = 63 - i
+        d_s = lax.dynamic_index_in_dim(ds, j, 0, keepdims=False)
+        d_h = lax.dynamic_index_in_dim(dh, j, 0, keepdims=False)
+        q = curve.double(curve.double(curve.double(curve.double(q))))
+        sel_h = jnp.broadcast_to(d_h[None], (fe.NLIMBS,) + shape)
+        addend_a = tuple(
+            lax.select_n(sel_h, *[comp[d] for d in range(16)])
+            for comp in a_tbl
+        )
+        q = curve.add_cached(q, addend_a)
+        sel_s = jnp.broadcast_to(d_s[None], (fe.NLIMBS,) + shape)
+        addend_b = tuple(
+            lax.select_n(
+                sel_s,
+                *[
+                    jnp.broadcast_to(comp[d], (fe.NLIMBS,) + shape)
+                    for d in range(16)
+                ],
+            )
+            for comp in b_tbl
+        )
+        return curve.add_affine_cached(q, addend_b)
+
+    return lax.fori_loop(0, 64, body, ident)
 
 
 def _verify_core(msgs, lens, pks, rs, ss):

@@ -87,11 +87,15 @@ def carry(x, rounds: int = 3):
 
     Preserves the value mod p. With inputs bounded by 2^31 the default 3
     rounds bring limbs into (-2^13, 2^13 + WRAP]; see module docstring.
+
+    Written as concat-adds (not .at[] scatters): scatter-add forces XLA
+    to materialize the full accumulator in HBM per step, turning the
+    whole ladder memory-bound.
     """
     for _ in range(rounds):
         c = lax.shift_right_arithmetic(x, LIMB_BITS)
         r = jnp.bitwise_and(x, MASK)
-        x = r.at[1:].add(c[:-1]).at[0].add(c[-1] * WRAP)
+        x = r + jnp.concatenate([c[-1:] * WRAP, c[:-1]], axis=0)
     return x
 
 
@@ -129,21 +133,35 @@ def neg(a):
 def _conv_mul(a, b):
     """Schoolbook 20x20 limb convolution -> 41-limb int32.
 
-    The convolution proper spans limbs 0..38; limbs 39-40 are headroom for
-    the carry rounds (limb 38 can carry ~2^13.5 into limb 39, which can
-    carry 1 into limb 40 — dropping that bit would lose 2^520 ≡ WRAP^2)."""
-    shape = _bshape(a, b)
-    c = jnp.zeros((2 * NLIMBS + 1,) + shape, jnp.int32)
-    for i in range(NLIMBS):
-        c = c.at[i : i + NLIMBS].add(a[i] * b)
-    return c
+    Output-stationary: each result limb is an independent sum of <= 20
+    lane-wise products, a pure fusable expression — the previous
+    accumulator form (20 sequential .at[i:i+20].add scatters) made XLA
+    round-trip the (41, N) accumulator through HBM twenty times per
+    field multiply, which dominated the whole verify kernel's runtime.
+
+    The convolution proper spans limbs 0..38; limbs 39-40 are headroom
+    for the carry rounds (limb 38 can carry ~2^13.5 into limb 39, which
+    can carry 1 into limb 40 — dropping that bit would lose
+    2^520 ≡ WRAP^2)."""
+    outs = []
+    for k in range(2 * NLIMBS - 1):
+        lo = max(0, k - NLIMBS + 1)
+        hi = min(NLIMBS - 1, k)
+        s = a[lo] * b[k - lo]
+        for i in range(lo + 1, hi + 1):
+            s = s + a[i] * b[k - i]
+        outs.append(s)
+    z = jnp.zeros_like(outs[0])
+    outs.append(z)  # limb 39 headroom
+    outs.append(z)  # limb 40 headroom
+    return jnp.stack(outs, axis=0)
 
 
 def _carry_noWrap(c, rounds: int = 3):
     for _ in range(rounds):
         cc = lax.shift_right_arithmetic(c, LIMB_BITS)
         r = jnp.bitwise_and(c, MASK)
-        c = r.at[1:].add(cc[:-1])
+        c = r + jnp.concatenate([jnp.zeros_like(cc[-1:]), cc[:-1]], axis=0)
     return c
 
 
@@ -154,8 +172,12 @@ def mul(a, b):
     lo = c[:NLIMBS]
     hi = c[NLIMBS : 2 * NLIMBS]
     out = lo + hi * WRAP
-    out = out.at[0].add(c[2 * NLIMBS] * (WRAP * WRAP))
-    return carry(out, 3)
+    tail = jnp.concatenate(
+        [c[2 * NLIMBS :] * (WRAP * WRAP),
+         jnp.zeros((NLIMBS - 1,) + c.shape[1:], jnp.int32)],
+        axis=0,
+    )
+    return carry(out + tail, 3)
 
 
 def square(a):

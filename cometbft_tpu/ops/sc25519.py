@@ -67,18 +67,29 @@ def carry_plain(x, rounds=None):
     for _ in range(rounds):
         c = lax.shift_right_arithmetic(x, LIMB_BITS)
         r = jnp.bitwise_and(x, MASK)
-        x = r.at[1:].add(c[:-1])
+        x = r + jnp.concatenate(
+            [jnp.zeros_like(c[-1:]), c[:-1]], axis=0
+        )
     return x
 
 
 def _conv(a, b_const: np.ndarray):
-    """Full product limbs(a) x constant limbs -> len(a)+len(b) limbs."""
+    """Full product limbs(a) x constant limbs -> len(a)+len(b) limbs.
+
+    Output-stationary (see fe25519._conv_mul): each limb an independent
+    fusable sum, no scatter-add accumulator."""
     na, nb = a.shape[0], b_const.shape[0]
-    c = jnp.zeros((na + nb,) + a.shape[1:], jnp.int32)
     bc = _cst(b_const, a.ndim)
-    for i in range(na):
-        c = c.at[i : i + nb].add(a[i] * bc)
-    return c
+    outs = []
+    for k in range(na + nb - 1):
+        lo = max(0, k - nb + 1)
+        hi = min(na - 1, k)
+        s = a[lo] * bc[k - lo]
+        for i in range(lo + 1, hi + 1):
+            s = s + a[i] * bc[k - i]
+        outs.append(s)
+    outs.append(jnp.zeros_like(outs[0]))
+    return jnp.stack(outs, axis=0)
 
 
 def _split_252(x):
@@ -163,6 +174,22 @@ def bits(s, n: int = 253):
             jnp.bitwise_and(lax.shift_right_arithmetic(s[limb], off), 1)
         )
     return jnp.stack(planes, axis=0)
+
+
+def digits4(s, nwin: int = 64):
+    """(20, N...) canonical limbs -> (nwin, N...) 4-bit windows,
+    little-endian window order (window j = bits 4j..4j+3). Feeds the
+    windowed double-scalar ladder."""
+    pad = jnp.zeros((1,) + s.shape[1:], jnp.int32)
+    sp = jnp.concatenate([s, pad], axis=0)
+    outs = []
+    for j in range(nwin):
+        limb, off = divmod(4 * j, LIMB_BITS)
+        v = lax.shift_right_arithmetic(sp[limb], off)
+        if off > LIMB_BITS - 4:
+            v = v | (sp[limb + 1] << (LIMB_BITS - off))
+        outs.append(jnp.bitwise_and(v, 15))
+    return jnp.stack(outs, axis=0)
 
 
 def hash_bytes_to_limbs(b):
