@@ -1,0 +1,281 @@
+"""Stream multiplexer over a SecretConnection (yamux-flavored).
+
+Frames ride inside the encrypted channel; each frame is
+
+    stream_id:u32 | flag:u8 | length:u32 | payload[length]
+
+flags: SYN opens a stream (payload = protocol id, utf-8), DATA carries
+one complete message (the mux is message-oriented like the reference's
+length-prefixed libp2p streams, not byte-oriented), FIN half-closes,
+RST aborts, PING/PONG keep the connection alive. Stream-id parity
+avoids open collisions: the connection initiator allocates odd ids,
+the accepter even ids (reference analog: yamux under go-libp2p).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Callable, Dict, Optional
+
+SYN, DATA, FIN, RST, PING, PONG = range(6)
+_HDR = struct.Struct(">IBI")
+
+MAX_FRAME_PAYLOAD = 16 * 1024 * 1024
+DEFAULT_STREAM_QUEUE = 256
+PING_INTERVAL_S = 20.0
+PONG_TIMEOUT_S = 45.0
+
+
+class MuxError(Exception):
+    pass
+
+
+class MuxStream:
+    """One logical stream: ordered message queue in, writes out via
+    the shared muxer."""
+
+    def __init__(self, mux: "Muxer", stream_id: int, protocol: str):
+        self.mux = mux
+        self.stream_id = stream_id
+        self.protocol = protocol
+        self.recv_q: asyncio.Queue = asyncio.Queue(DEFAULT_STREAM_QUEUE)
+        self.closed = False
+        self.reset = False
+
+    async def send(self, msg: bytes) -> None:
+        if self.closed:
+            raise MuxError(f"stream {self.stream_id} closed")
+        await self.mux._send_frame(self.stream_id, DATA, msg)
+
+    def try_send(self, msg: bytes) -> bool:
+        """Best-effort enqueue; False when the connection's outbound
+        queue is saturated (caller drops, matching Peer.try_send)."""
+        if self.closed:
+            return False
+        return self.mux._try_send_frame(self.stream_id, DATA, msg)
+
+    async def recv(self) -> Optional[bytes]:
+        """Next message, or None at clean EOF."""
+        if self.closed and self.recv_q.empty():
+            return None
+        msg = await self.recv_q.get()
+        return msg  # None sentinel = FIN/RST
+
+    async def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            try:
+                await self.mux._send_frame(self.stream_id, FIN, b"")
+            except Exception:
+                pass
+            self.mux._drop_stream(self.stream_id)
+
+    def abort(self) -> None:
+        if not self.closed:
+            self.closed = True
+            self.reset = True
+            self.mux._try_send_frame(self.stream_id, RST, b"")
+            self.mux._drop_stream(self.stream_id)
+
+
+class Muxer:
+    """Multiplexes MuxStreams over one SecretConnection.
+
+    on_stream(stream) fires for every remotely-opened stream after its
+    SYN arrives. on_error(exc) fires once when the connection dies.
+    """
+
+    def __init__(
+        self,
+        sconn,
+        initiator: bool,
+        on_stream: Callable[[MuxStream], None],
+        on_error: Optional[Callable[[Exception], None]] = None,
+        max_streams: int = 64,
+        send_queue: int = 1024,
+        send_rate: int = 0,
+        recv_rate: int = 0,
+    ):
+        self.sconn = sconn
+        self.streams: Dict[int, MuxStream] = {}
+        self.on_stream = on_stream
+        self.on_error = on_error
+        self.max_streams = max_streams
+        self._next_id = 1 if initiator else 2
+        self._send_q: asyncio.Queue = asyncio.Queue(send_queue)
+        self._tasks = []
+        self._dead = False
+        self._pong = asyncio.Event()
+        self.sent_bytes = 0
+        self.recv_bytes = 0
+        # operator bandwidth caps apply to the lp2p stack too (the
+        # native stack throttles inside MConnection); 0 = unlimited
+        from ..p2p.conn.connection import FlowRate
+
+        self._send_flow = FlowRate(send_rate) if send_rate > 0 else None
+        self._recv_flow = FlowRate(recv_rate) if recv_rate > 0 else None
+
+    def start(self) -> None:
+        self._tasks = [
+            asyncio.create_task(self._send_routine()),
+            asyncio.create_task(self._recv_routine()),
+            asyncio.create_task(self._ping_routine()),
+        ]
+
+    async def stop(self) -> None:
+        self._dead = True
+        for s in list(self.streams.values()):
+            s.closed = True
+        self.streams.clear()
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self.sconn.close()
+
+    # --- stream open --------------------------------------------------
+
+    async def open_stream(self, protocol: str) -> MuxStream:
+        if self._dead:
+            raise MuxError("muxer closed")
+        if len(self.streams) >= self.max_streams:
+            raise MuxError("stream limit reached")
+        sid = self._next_id
+        self._next_id += 2
+        st = MuxStream(self, sid, protocol)
+        self.streams[sid] = st
+        await self._send_frame(sid, SYN, protocol.encode())
+        return st
+
+    # --- framing ------------------------------------------------------
+
+    async def _send_frame(self, sid: int, flag: int, payload: bytes):
+        if self._dead:
+            raise MuxError("muxer closed")
+        await self._send_q.put(_HDR.pack(sid, flag, len(payload)) + payload)
+
+    def _try_send_frame(self, sid: int, flag: int, payload: bytes) -> bool:
+        if self._dead:
+            return False
+        try:
+            self._send_q.put_nowait(
+                _HDR.pack(sid, flag, len(payload)) + payload
+            )
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    def _drop_stream(self, sid: int) -> None:
+        self.streams.pop(sid, None)
+
+    def _die(self, exc: Exception) -> None:
+        if self._dead:
+            return
+        self._dead = True
+        for s in list(self.streams.values()):
+            s.closed = True
+            try:
+                s.recv_q.put_nowait(None)
+            except asyncio.QueueFull:
+                pass
+        if self.on_error:
+            self.on_error(exc)
+
+    # --- routines -----------------------------------------------------
+
+    async def _send_routine(self) -> None:
+        try:
+            while True:
+                frame = await self._send_q.get()
+                if self._send_flow is not None:
+                    await self._send_flow.throttle(len(frame))
+                self.sent_bytes += len(frame)
+                await self.sconn.write_msg(frame)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._die(e)
+
+    async def _recv_routine(self) -> None:
+        buf = b""
+        try:
+            while True:
+                while len(buf) < _HDR.size:
+                    buf += await self._read()
+                sid, flag, n = _HDR.unpack(buf[: _HDR.size])
+                if n > MAX_FRAME_PAYLOAD:
+                    raise MuxError(f"oversized frame ({n} bytes)")
+                buf = buf[_HDR.size :]
+                while len(buf) < n:
+                    buf += await self._read()
+                payload, buf = buf[:n], buf[n:]
+                self._handle(sid, flag, payload)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._die(e)
+
+    async def _read(self) -> bytes:
+        chunk = await self.sconn.read_chunk()
+        if not chunk:
+            raise MuxError("connection closed")
+        if self._recv_flow is not None:
+            await self._recv_flow.throttle(len(chunk))
+        self.recv_bytes += len(chunk)
+        return chunk
+
+    def _handle(self, sid: int, flag: int, payload: bytes) -> None:
+        if flag == SYN:
+            if sid in self.streams or len(self.streams) >= self.max_streams:
+                self._try_send_frame(sid, RST, b"")
+                return
+            st = MuxStream(self, sid, payload.decode("utf-8", "replace"))
+            self.streams[sid] = st
+            try:
+                self.on_stream(st)
+            except Exception:
+                st.abort()
+        elif flag == DATA:
+            st = self.streams.get(sid)
+            if st is None:
+                return  # late data on a dropped stream
+            try:
+                st.recv_q.put_nowait(payload)
+            except asyncio.QueueFull:
+                # receiver is not draining: reset rather than stall the
+                # whole connection (per-stream isolation is the point)
+                st.abort()
+        elif flag in (FIN, RST):
+            st = self.streams.pop(sid, None)
+            if st is not None:
+                st.closed = True
+                st.reset = flag == RST
+                try:
+                    st.recv_q.put_nowait(None)
+                except asyncio.QueueFull:
+                    pass
+        elif flag == PING:
+            self._try_send_frame(0, PONG, b"")
+        elif flag == PONG:
+            self._pong.set()
+
+    async def _ping_routine(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(PING_INTERVAL_S)
+                self._pong.clear()
+                self._try_send_frame(0, PING, b"")
+                try:
+                    await asyncio.wait_for(
+                        self._pong.wait(), PONG_TIMEOUT_S
+                    )
+                except asyncio.TimeoutError:
+                    raise MuxError("ping timeout")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._die(e)

@@ -61,16 +61,29 @@ class Node:
         if transport is None:
             transport = TCPTransport(self.node_key, self.node_info)
         self.transport = transport
-        self.switch = Switch(
-            self.transport,
-            self.node_info,
-            mconn_config={
-                "send_rate": config.p2p.send_rate,
-                "recv_rate": config.p2p.recv_rate,
-                "flush_throttle_s": config.p2p.flush_throttle_ms / 1000.0,
-            },
-            use_autopool=config.p2p.use_autopool,
-        )
+        if config.p2p.use_libp2p_equivalent:
+            # fork feature: alternative stream-multiplexed switcher
+            # (reference lp2p selection at node/node.go:476-575)
+            from ..lp2p import Lp2pSwitch
+
+            self.switch = Lp2pSwitch(
+                self.transport,
+                self.node_info,
+                send_rate=config.p2p.send_rate,
+                recv_rate=config.p2p.recv_rate,
+                use_autopool=config.p2p.use_autopool,
+            )
+        else:
+            self.switch = Switch(
+                self.transport,
+                self.node_info,
+                mconn_config={
+                    "send_rate": config.p2p.send_rate,
+                    "recv_rate": config.p2p.recv_rate,
+                    "flush_throttle_s": config.p2p.flush_throttle_ms / 1000.0,
+                },
+                use_autopool=config.p2p.use_autopool,
+            )
 
         blocksync_active = config.blocksync.enable and not config.statesync.enable
         adaptive = config.blocksync.adaptive_sync

@@ -65,6 +65,16 @@ class Peer:
     def try_send(self, chan_id: int, msg: bytes) -> bool:
         return self.mconn.try_send(chan_id, msg)
 
+    # --- traffic totals (uniform across peer implementations) ---------
+
+    @property
+    def recv_total(self) -> int:
+        return self.mconn.recv_flow.total
+
+    @property
+    def send_total(self) -> int:
+        return self.mconn.send_flow.total
+
     # --- per-peer reactor state ---------------------------------------
 
     def get(self, key: str, default=None):

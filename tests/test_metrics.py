@@ -43,3 +43,40 @@ def test_prometheus_metrics_endpoint():
         await node.stop()
 
     run(main())
+
+
+def test_prometheus_metrics_over_lp2p():
+    """Traffic gauges must read Lp2pPeer muxer counters, not mconn
+    (regression: /metrics returned 500 with the lp2p switcher)."""
+    gen, pvs = make_genesis(2, chain_id="metrics-lp2p")
+
+    async def main():
+        nodes = []
+        for i, pv in enumerate(pvs):
+            cfg = make_test_cfg(".")
+            cfg.p2p.laddr = "tcp://127.0.0.1:0"
+            cfg.p2p.use_libp2p_equivalent = True
+            cfg.instrumentation.prometheus = True
+            cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
+            nodes.append(Node(cfg, gen, privval=pv))
+        for n in nodes:
+            await n.start()
+        await nodes[0].dial(nodes[1].listen_addr)
+        while any(n.height < 2 for n in nodes):
+            await asyncio.sleep(0.05)
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://{nodes[0].metrics_server.listen_addr}/metrics"
+            ) as resp:
+                assert resp.status == 200
+                text = await resp.text()
+        recv = [
+            ln
+            for ln in text.splitlines()
+            if ln.startswith("cometbft_p2p_message_receive_bytes_total{")
+        ]
+        assert recv and float(recv[0].split()[-1]) > 0
+        for n in nodes:
+            await n.stop()
+
+    run(main())
