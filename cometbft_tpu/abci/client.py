@@ -108,11 +108,11 @@ class AppConns:
     """Four named logical connections sharing one client (reference
     proxy/multi_app_conn.go:21-62: consensus/mempool/query/snapshot)."""
 
-    def __init__(self, client):
+    def __init__(self, client, mempool=None, query=None, snapshot=None):
         self.consensus = client
-        self.mempool = client
-        self.query = client
-        self.snapshot = client
+        self.mempool = mempool or client
+        self.query = query or client
+        self.snapshot = snapshot or client
 
     @classmethod
     def local(cls, app: abci.Application) -> "AppConns":

@@ -75,6 +75,43 @@ class ExecTxResult:
         )
 
 
+BLOCK_ID_FLAG_ABSENT = 1
+BLOCK_ID_FLAG_COMMIT = 2
+BLOCK_ID_FLAG_NIL = 3
+
+MISBEHAVIOR_DUPLICATE_VOTE = 1
+MISBEHAVIOR_LIGHT_CLIENT_ATTACK = 2
+
+
+@dataclass
+class VoteInfo:
+    """One validator's participation in the decided commit (reference
+    abci/types.proto VoteInfo): apps use it for reward distribution."""
+
+    validator_address: bytes = b""
+    power: int = 0
+    block_id_flag: int = BLOCK_ID_FLAG_ABSENT
+
+
+@dataclass
+class CommitInfo:
+    round: int = 0
+    votes: List[VoteInfo] = field(default_factory=list)
+
+
+@dataclass
+class Misbehavior:
+    """Evidence of validator misbehavior handed to the app for slashing
+    (reference abci/types.proto Misbehavior)."""
+
+    type_: int = MISBEHAVIOR_DUPLICATE_VOTE
+    validator_address: bytes = b""
+    validator_power: int = 0
+    height: int = 0
+    time_ns: int = 0
+    total_voting_power: int = 0
+
+
 @dataclass
 class RequestInfo:
     version: str = ""

@@ -493,6 +493,49 @@ def cmd_signer(args) -> int:
     return 0
 
 
+def cmd_abci_server(args) -> int:
+    """Host the example kvstore app out-of-process (the reference
+    abci-cli's `kvstore` server command, abci/cmd/abci-cli): a node
+    configured with proxy_app = this address drives it over the
+    socket/grpc ABCI protocol."""
+    from ..models.kvstore import KVStoreApplication
+
+    app = KVStoreApplication(
+        persist_path=os.path.join(_home(args), "data", "kvstore.json")
+        if args.persist
+        else None
+    )
+    if args.transport == "grpc":
+        from ..abci.server import GRPCServer
+
+        server = GRPCServer(app, args.address)
+        server.start()
+        print(f"abci grpc server on port {server.port}")
+        try:
+            import time as _t
+
+            while True:
+                _t.sleep(3600)
+        except KeyboardInterrupt:
+            server.stop()
+        return 0
+
+    from ..abci.server import ABCIServer
+
+    server = ABCIServer(app, args.address)
+
+    async def main():
+        await server.start()
+        print(f"abci socket server on {server.listen_addr}")
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_version(args) -> int:
     print(f"cometbft-tpu v{VERSION}")
     return 0
@@ -565,6 +608,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="validator node's priv_validator_laddr to dial",
     )
     p.set_defaults(fn=cmd_signer)
+
+    p = sub.add_parser(
+        "abci-server", help="host the kvstore app over socket/grpc ABCI"
+    )
+    p.add_argument("-a", "--address", default="tcp://127.0.0.1:26658")
+    p.add_argument(
+        "-t", "--transport", choices=("socket", "grpc"), default="socket"
+    )
+    p.add_argument(
+        "--persist", action="store_true", help="persist app state to home"
+    )
+    p.set_defaults(fn=cmd_abci_server)
 
     p = sub.add_parser("light", help="light client daemon")
     p.add_argument("chain_id")

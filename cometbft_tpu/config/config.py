@@ -32,6 +32,11 @@ class BaseConfig:
     # (reference PrivValidatorListenAddr)
     priv_validator_laddr: str = ""
     abci: str = "kvstore"
+    # out-of-process app: address of an abci.server.ABCIServer /
+    # GRPCServer (reference proxy_app, config/config.go Base); when set
+    # (and abci is "socket" or "grpc") the node dials instead of
+    # building an in-process app
+    proxy_app: str = ""
     filter_peers: bool = False
 
 

@@ -36,12 +36,14 @@ class MuxStream:
     the shared muxer."""
 
     def __init__(self, mux: "Muxer", stream_id: int, protocol: str):
+        qsize = getattr(mux, "stream_queue", DEFAULT_STREAM_QUEUE)
         self.mux = mux
         self.stream_id = stream_id
         self.protocol = protocol
-        self.recv_q: asyncio.Queue = asyncio.Queue(DEFAULT_STREAM_QUEUE)
+        self.recv_q: asyncio.Queue = asyncio.Queue(qsize)
         self.closed = False
         self.reset = False
+        self.dropped = 0  # inbound messages discarded on queue overflow
 
     async def send(self, msg: bytes) -> None:
         if self.closed:
@@ -96,12 +98,15 @@ class Muxer:
         send_queue: int = 1024,
         send_rate: int = 0,
         recv_rate: int = 0,
+        stream_queue: int = DEFAULT_STREAM_QUEUE,
     ):
         self.sconn = sconn
         self.streams: Dict[int, MuxStream] = {}
         self.on_stream = on_stream
         self.on_error = on_error
         self.max_streams = max_streams
+        self.stream_queue = stream_queue
+        self._initiator = initiator
         self._next_id = 1 if initiator else 2
         self._send_q: asyncio.Queue = asyncio.Queue(send_queue)
         self._tasks = []
@@ -139,16 +144,34 @@ class Muxer:
 
     # --- stream open --------------------------------------------------
 
-    async def open_stream(self, protocol: str) -> MuxStream:
+    def _alloc_stream(self, protocol: str) -> MuxStream:
         if self._dead:
             raise MuxError("muxer closed")
         if len(self.streams) >= self.max_streams:
             raise MuxError("stream limit reached")
         sid = self._next_id
         self._next_id += 2
+        if sid in self.streams:  # unreachable with parity enforcement
+            raise MuxError(f"stream id {sid} already in use")
         st = MuxStream(self, sid, protocol)
         self.streams[sid] = st
-        await self._send_frame(sid, SYN, protocol.encode())
+        return st
+
+    async def open_stream(self, protocol: str) -> MuxStream:
+        st = self._alloc_stream(protocol)
+        await self._send_frame(st.stream_id, SYN, protocol.encode())
+        return st
+
+    def open_stream_nowait(self, protocol: str) -> MuxStream:
+        """Synchronous open: enqueue the SYN without awaiting, so
+        callers can hand out usable streams before yielding to the
+        loop (peers must be sendable the moment reactors see them).
+        Raises MuxError if the send queue is full (only possible on an
+        already-saturated connection)."""
+        st = self._alloc_stream(protocol)
+        if not self._try_send_frame(st.stream_id, SYN, protocol.encode()):
+            self._drop_stream(st.stream_id)
+            raise MuxError("send queue full during stream open")
         return st
 
     # --- framing ------------------------------------------------------
@@ -201,18 +224,26 @@ class Muxer:
             self._die(e)
 
     async def _recv_routine(self) -> None:
-        buf = b""
+        # bytearray + consume offset: appending chunks and slicing the
+        # head stays O(bytes) per frame (repeated bytes concatenation
+        # over ~1KB SecretConnection chunks would be O(n^2))
+        buf = bytearray()
+        pos = 0
         try:
             while True:
-                while len(buf) < _HDR.size:
+                while len(buf) - pos < _HDR.size:
                     buf += await self._read()
-                sid, flag, n = _HDR.unpack(buf[: _HDR.size])
+                sid, flag, n = _HDR.unpack_from(buf, pos)
                 if n > MAX_FRAME_PAYLOAD:
                     raise MuxError(f"oversized frame ({n} bytes)")
-                buf = buf[_HDR.size :]
-                while len(buf) < n:
+                pos += _HDR.size
+                while len(buf) - pos < n:
                     buf += await self._read()
-                payload, buf = buf[:n], buf[n:]
+                payload = bytes(buf[pos : pos + n])
+                pos += n
+                if pos > 1 << 16:
+                    del buf[:pos]
+                    pos = 0
                 self._handle(sid, flag, payload)
         except asyncio.CancelledError:
             raise
@@ -230,7 +261,16 @@ class Muxer:
 
     def _handle(self, sid: int, flag: int, payload: bytes) -> None:
         if flag == SYN:
-            if sid in self.streams or len(self.streams) >= self.max_streams:
+            # a remote-opened stream must carry the REMOTE side's id
+            # parity (initiator odd / accepter even); without this a
+            # peer could pre-register an id in our allocator's space
+            # and cross-wire a later local stream onto its frames
+            remote_parity = 0 if self._initiator else 1
+            if (
+                sid % 2 != remote_parity
+                or sid in self.streams
+                or len(self.streams) >= self.max_streams
+            ):
                 self._try_send_frame(sid, RST, b"")
                 return
             st = MuxStream(self, sid, payload.decode("utf-8", "replace"))
@@ -246,9 +286,11 @@ class Muxer:
             try:
                 st.recv_q.put_nowait(payload)
             except asyncio.QueueFull:
-                # receiver is not draining: reset rather than stall the
-                # whole connection (per-stream isolation is the point)
-                st.abort()
+                # receiver is not draining: drop this message, matching
+                # the send side's try_send drop semantics. Gossip
+                # protocols re-send; killing the stream would silently
+                # disable the channel for the connection's lifetime.
+                st.dropped += 1
         elif flag in (FIN, RST):
             st = self.streams.pop(sid, None)
             if st is not None:

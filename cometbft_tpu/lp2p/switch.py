@@ -59,6 +59,7 @@ class Lp2pPeer:
         outbound: bool = False,
         persistent: bool = False,
         max_streams: int = 64,
+        stream_queue: int = 0,
         send_rate: int = 0,
         recv_rate: int = 0,
     ):
@@ -76,12 +77,15 @@ class Lp2pPeer:
         self._reader_tasks: List[asyncio.Task] = []
         self._start_task: Optional[asyncio.Task] = None
         self._stopped = False
+        from .mux import DEFAULT_STREAM_QUEUE
+
         self.mux = Muxer(
             sconn,
             initiator=outbound,
             on_stream=self._on_stream,
             on_error=self._mux_error,
             max_streams=max_streams,
+            stream_queue=stream_queue or DEFAULT_STREAM_QUEUE,
             send_rate=send_rate,
             recv_rate=recv_rate,
         )
@@ -99,12 +103,13 @@ class Lp2pPeer:
 
     def start(self) -> None:
         self.mux.start()
-        self._start_task = asyncio.create_task(self._open_streams())
-
-    async def _open_streams(self) -> None:
+        # open channel streams synchronously (SYNs enqueue without
+        # awaiting): reactors call add_peer right after start() and
+        # must be able to try_send immediately — e.g. statesync's
+        # one-shot snapshots request would otherwise be silently lost
         try:
             for cid in self._chan_ids:
-                self._out[cid] = await self.mux.open_stream(
+                self._out[cid] = self.mux.open_stream_nowait(
                     channel_protocol(cid)
                 )
             self._ready.set()
@@ -215,6 +220,13 @@ class Lp2pSwitch(Switch):
         self.send_rate = send_rate
         self.recv_rate = recv_rate
 
+    def _discard_conn(self, sconn) -> None:
+        # the Host admitted this conn (rcmgr.acquire_conn); a rejection
+        # above the Host must release the slot or churn from banned /
+        # duplicate peers permanently exhausts admission capacity
+        super()._discard_conn(sconn)
+        self.host.conn_closed()
+
     def _make_peer(
         self, sconn, their_info, conn_str, outbound, persistent=False
     ) -> Lp2pPeer:
@@ -233,16 +245,11 @@ class Lp2pSwitch(Switch):
             persistent=persistent
             or their_info.node_id in self.persistent_addrs,
             max_streams=self.host.rcmgr.max_streams_per_conn,
+            stream_queue=self.host.rcmgr.stream_queue,
             send_rate=self.send_rate,
             recv_rate=self.recv_rate,
         )
-        self.peers[peer.peer_id] = peer
-        peer.start()
-        for r in self.reactors.values():
-            try:
-                r.add_peer(peer)
-            except Exception:
-                traceback.print_exc()
+        self._register_peer(peer)
         return peer
 
     async def _remove_peer(self, peer, exc, reconnect=False) -> None:

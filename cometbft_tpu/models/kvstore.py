@@ -19,15 +19,52 @@ VALIDATOR_TX_PREFIX = b"val:"
 
 
 class KVStoreApplication(abci.Application):
-    def __init__(self):
+    def __init__(self, persist_path: str = None):
         self.state: Dict[bytes, bytes] = {}
         self.height = 0
+        # reference abci/example/kvstore PersistentKVStoreApplication:
+        # survive restarts so the handshake replay path is exercised
+        self.persist_path = persist_path
+        if persist_path:
+            self._load_persisted()
         self.app_hash = self._compute_hash()
         self.staged: Dict[bytes, bytes] = {}
         self.val_updates: List[abci.ValidatorUpdate] = []
         self.snapshots: Dict[int, bytes] = {}
         self._restore_buf: List[bytes] = []
         self._restore_target = None
+
+    def _load_persisted(self) -> None:
+        import os
+
+        if not os.path.exists(self.persist_path):
+            return
+        with open(self.persist_path) as f:
+            st = json.load(f)
+        self.height = st["height"]
+        self.state = {
+            bytes.fromhex(k): bytes.fromhex(v)
+            for k, v in st["state"].items()
+        }
+
+    def _persist(self) -> None:
+        if not self.persist_path:
+            return
+        import os
+
+        os.makedirs(os.path.dirname(self.persist_path), exist_ok=True)
+        tmp = self.persist_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "height": self.height,
+                    "state": {
+                        k.hex(): v.hex() for k, v in self.state.items()
+                    },
+                },
+                f,
+            )
+        os.replace(tmp, self.persist_path)
 
     # --- hashing ------------------------------------------------------
 
@@ -155,6 +192,7 @@ class KVStoreApplication(abci.Application):
         self.staged = {}
         if self.height % 10 == 0:
             self._take_snapshot()
+        self._persist()
         return abci.ResponseCommit(retain_height=0)
 
     # --- snapshots ----------------------------------------------------

@@ -66,8 +66,20 @@ def build_node(
     wal: bool = False,
 ) -> NodeParts:
     config = config or test_config(home or ".")
-    app = app or KVStoreApplication()
-    proxy = AppConns.local(app)
+    proxy_addr = getattr(config.base, "proxy_app", "")
+    if app is None and proxy_addr:
+        # out-of-process app (reference proxy_app + abci transport
+        # config, node/setup.go:119 createAndStartProxyAppConns)
+        from ..abci.socket_client import connect_app_conns
+
+        transport = (
+            "grpc" if config.base.abci == "grpc" else "socket"
+        )
+        proxy = connect_app_conns(proxy_addr, transport)
+        app = None
+    else:
+        app = app or KVStoreApplication()
+        proxy = AppConns.local(app)
     block_db = kv.open_kv(
         config.base.db_backend,
         None

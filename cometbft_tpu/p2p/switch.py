@@ -121,7 +121,7 @@ class Switch:
                 or their_info.node_id in self.banned
                 or their_info.node_id == self.node_info.node_id
             ):
-                sconn.close()
+                self._discard_conn(sconn)
                 continue
             self._make_peer(sconn, their_info, conn_str, outbound=False)
 
@@ -146,10 +146,10 @@ class Switch:
                 self._schedule_reconnect(peer_id)
             raise e
         if their_info.node_id == self.node_info.node_id:
-            sconn.close()
+            self._discard_conn(sconn)
             raise ValueError("dialed own address (self-connection)")
         if their_info.node_id in self.peers:
-            sconn.close()
+            self._discard_conn(sconn)
             return self.peers[their_info.node_id]
         return self._make_peer(
             sconn, their_info, conn_str, outbound=True, persistent=persistent
@@ -168,6 +168,22 @@ class Switch:
             pass
 
     # --- peer management ----------------------------------------------
+
+    def _discard_conn(self, sconn) -> None:
+        """Close an upgraded connection rejected before peer
+        registration; subclasses release admission resources here."""
+        sconn.close()
+
+    def _register_peer(self, peer) -> None:
+        """Shared tail of peer construction: register, start, announce
+        to reactors."""
+        self.peers[peer.peer_id] = peer
+        peer.start()
+        for r in self.reactors.values():
+            try:
+                r.add_peer(peer)
+            except Exception:
+                traceback.print_exc()
 
     def _make_peer(
         self, sconn, their_info, conn_str, outbound, persistent=False
@@ -188,13 +204,7 @@ class Switch:
             or their_info.node_id in self.persistent_addrs,
             mconn_config=self.mconn_config,
         )
-        self.peers[peer.peer_id] = peer
-        peer.start()
-        for r in self.reactors.values():
-            try:
-                r.add_peer(peer)
-            except Exception:
-                traceback.print_exc()
+        self._register_peer(peer)
         return peer
 
     def _on_peer_msg(self, chan_id: int, msg: bytes, peer: Peer) -> None:

@@ -79,6 +79,65 @@ def decode_finalize_response(b: bytes) -> abci.ResponseFinalizeBlock:
     )
 
 
+def build_last_commit_info(lc, last_vals) -> Optional[abci.CommitInfo]:
+    """CommitInfo for a block's carried last-commit (reference
+    state/execution.go buildLastCommitInfo): one VoteInfo per validator
+    of height-1, flagged by participation — apps use this for reward
+    distribution."""
+    if lc is None or last_vals is None or not lc.signatures:
+        return None
+    votes = []
+    for i, v in enumerate(last_vals.validators):
+        flag = abci.BLOCK_ID_FLAG_ABSENT
+        if i < len(lc.signatures):
+            flag = lc.signatures[i].block_id_flag
+        votes.append(
+            abci.VoteInfo(
+                validator_address=v.address,
+                power=v.voting_power,
+                block_id_flag=flag,
+            )
+        )
+    return abci.CommitInfo(round=lc.round, votes=votes)
+
+
+def evidence_to_misbehavior(evidence) -> List[abci.Misbehavior]:
+    """ABCI Misbehavior records from block evidence (reference
+    types/evidence.go ABCI() — duplicate votes map 1:1, a light-client
+    attack yields one record per byzantine validator)."""
+    from ..evidence.types import (
+        DuplicateVoteEvidence,
+        LightClientAttackEvidence,
+    )
+
+    out = []
+    for e in evidence:
+        if isinstance(e, DuplicateVoteEvidence):
+            out.append(
+                abci.Misbehavior(
+                    type_=abci.MISBEHAVIOR_DUPLICATE_VOTE,
+                    validator_address=e.vote_a.validator_address,
+                    validator_power=e.validator_power,
+                    height=e.height(),
+                    time_ns=e.timestamp_ns,
+                    total_voting_power=e.total_voting_power,
+                )
+            )
+        elif isinstance(e, LightClientAttackEvidence):
+            for v in e.byzantine_validators:
+                out.append(
+                    abci.Misbehavior(
+                        type_=abci.MISBEHAVIOR_LIGHT_CLIENT_ATTACK,
+                        validator_address=v.address,
+                        validator_power=v.voting_power,
+                        height=e.common_height,
+                        time_ns=e.timestamp_ns,
+                        total_voting_power=e.total_voting_power,
+                    )
+                )
+    return out
+
+
 class BlockExecutor:
     def __init__(
         self,
@@ -127,9 +186,12 @@ class BlockExecutor:
             max_bytes - 2048, max_gas
         )
         t = time_ns or time.time_ns()
+        lci = build_last_commit_info(last_commit, state.last_validators)
         req = abci.RequestPrepareProposal(
             max_tx_bytes=max_bytes - 2048,
             txs=txs,
+            local_last_commit=lci,
+            misbehavior=evidence_to_misbehavior(evidence),
             height=height,
             time_ns=t,
             next_validators_hash=state.next_validators.hash(),
@@ -172,6 +234,10 @@ class BlockExecutor:
     def process_proposal(self, block: T.Block, state: State) -> bool:
         req = abci.RequestProcessProposal(
             txs=block.data.txs,
+            proposed_last_commit=build_last_commit_info(
+                block.last_commit, state.last_validators
+            ),
+            misbehavior=evidence_to_misbehavior(block.evidence),
             hash=block.hash(),
             height=block.height,
             time_ns=block.header.time_ns,
@@ -212,6 +278,10 @@ class BlockExecutor:
             self.validate_block(state, block)
         req = abci.RequestFinalizeBlock(
             txs=block.data.txs,
+            decided_last_commit=build_last_commit_info(
+                block.last_commit, state.last_validators
+            ),
+            misbehavior=evidence_to_misbehavior(block.evidence),
             hash=block.hash(),
             height=block.height,
             time_ns=block.header.time_ns,

@@ -1,0 +1,238 @@
+"""Out-of-process ABCI: socket + gRPC servers/clients (reference
+abci/server, abci/client/socket_client.go, grpc_client.go)."""
+
+import asyncio
+import threading
+
+import pytest
+
+from cometbft_tpu.abci import codec
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.server import ABCIServer, GRPCServer
+from cometbft_tpu.abci.socket_client import (
+    GRPCClient,
+    SocketClient,
+    connect_app_conns,
+)
+from cometbft_tpu.models.kvstore import KVStoreApplication
+from cometbft_tpu.state.state_types import ConsensusParams
+
+
+def test_codec_roundtrip_all_kinds():
+    cases = [
+        (codec.ECHO, "hello"),
+        (codec.FLUSH, None),
+        (codec.INFO, abci.RequestInfo(version="1.0", block_version=11)),
+        (
+            codec.INIT_CHAIN,
+            abci.RequestInitChain(
+                time_ns=123,
+                chain_id="test-chain",
+                consensus_params=ConsensusParams(),
+                validators=[abci.ValidatorUpdate("ed25519", b"\x01" * 32, 10)],
+                app_state_bytes=b"{}",
+                initial_height=7,
+            ),
+        ),
+        (codec.QUERY, abci.RequestQuery(data=b"k", path="/store", height=5)),
+        (codec.CHECK_TX, abci.RequestCheckTx(tx=b"a=1", type_=1)),
+        (
+            codec.FINALIZE_BLOCK,
+            abci.RequestFinalizeBlock(
+                txs=[b"a=1", b"", b"b=2"],
+                decided_last_commit=abci.CommitInfo(
+                    round=2,
+                    votes=[
+                        abci.VoteInfo(b"\x02" * 20, 5, abci.BLOCK_ID_FLAG_COMMIT)
+                    ],
+                ),
+                misbehavior=[
+                    abci.Misbehavior(
+                        type_=abci.MISBEHAVIOR_DUPLICATE_VOTE,
+                        validator_address=b"\x03" * 20,
+                        validator_power=9,
+                        height=44,
+                        time_ns=1,
+                        total_voting_power=100,
+                    )
+                ],
+                hash=b"\xaa" * 32,
+                height=44,
+                time_ns=99,
+            ),
+        ),
+        (codec.INSERT_TX, b"tx-bytes"),
+        (codec.REAP_TXS, (1000, -1)),
+        (codec.OFFER_SNAPSHOT, (abci.Snapshot(height=10, chunks=3), b"h")),
+        (codec.LOAD_SNAPSHOT_CHUNK, (10, 0, 2)),
+        (codec.APPLY_SNAPSHOT_CHUNK, (1, b"chunk", "peer1")),
+    ]
+    for kind, req in cases:
+        raw = codec.encode_request(kind, req)
+        k2, r2 = codec.decode_request(raw)
+        assert k2 == kind
+        assert r2 == req
+
+    resp_cases = [
+        (codec.ECHO, "hello"),
+        (codec.INFO, abci.ResponseInfo(data="kv", last_block_height=3,
+                                       last_block_app_hash=b"\x01" * 8)),
+        (codec.CHECK_TX, abci.ResponseCheckTx(code=1, log="bad",
+                                              codespace="mem")),
+        (
+            codec.FINALIZE_BLOCK,
+            abci.ResponseFinalizeBlock(
+                events=[abci.Event("commit", [abci.EventAttribute("k", "v")])],
+                tx_results=[abci.ExecTxResult(code=0, data=b"ok")],
+                validator_updates=[
+                    abci.ValidatorUpdate("ed25519", b"\x01" * 32, 0)
+                ],
+                app_hash=b"\x07" * 32,
+            ),
+        ),
+        (codec.REAP_TXS, [b"a", b"", b"c"]),
+    ]
+    for kind, resp in resp_cases:
+        raw = codec.encode_response(kind, resp)
+        k2, r2 = codec.decode_response(raw)
+        assert k2 == kind
+        assert r2 == resp
+
+
+def test_exception_response_raises():
+    raw = codec.encode_response(codec.EXCEPTION, ValueError("boom"))
+    with pytest.raises(RuntimeError, match="boom"):
+        codec.decode_response(raw)
+
+
+def _run_socket_server(app):
+    """Start an ABCIServer on an ephemeral port in a background loop."""
+    loop = asyncio.new_event_loop()
+    server = ABCIServer(app, "tcp://127.0.0.1:0")
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def go():
+            await server.start()
+            started.set()
+
+        loop.run_until_complete(go())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    started.wait(5)
+    return server, loop
+
+
+def test_socket_client_against_kvstore():
+    app = KVStoreApplication()
+    server, loop = _run_socket_server(app)
+    try:
+        addr = server.listen_addr
+        conns = connect_app_conns(addr)
+        assert conns.query.echo("ping") == "ping"
+        conns.consensus.init_chain(
+            abci.RequestInitChain(chain_id="t", initial_height=1)
+        )
+        r = conns.mempool.check_tx(abci.RequestCheckTx(tx=b"k=v"))
+        assert r.is_ok()
+        # pipelined async check_tx
+        futs = [
+            conns.mempool.check_tx_async(
+                abci.RequestCheckTx(tx=f"k{i}=v".encode())
+            )
+            for i in range(16)
+        ]
+        assert all(f.result(5).is_ok() for f in futs)
+        fr = conns.consensus.finalize_block(
+            abci.RequestFinalizeBlock(txs=[b"k=v"], height=1)
+        )
+        assert len(fr.tx_results) == 1 and fr.tx_results[0].is_ok()
+        conns.consensus.commit()
+        q = conns.query.query(abci.RequestQuery(data=b"k", path="/store"))
+        assert q.value == b"v"
+        for c in (conns.consensus, conns.mempool, conns.query, conns.snapshot):
+            c.close()
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+
+
+def test_grpc_client_against_kvstore():
+    app = KVStoreApplication()
+    server = GRPCServer(app, "tcp://127.0.0.1:0")
+    server.start()
+    try:
+        client = GRPCClient(f"tcp://127.0.0.1:{server.port}")
+        assert client.echo("ping") == "ping"
+        client.init_chain(abci.RequestInitChain(chain_id="t"))
+        assert client.check_tx(abci.RequestCheckTx(tx=b"x=1")).is_ok()
+        fr = client.finalize_block(
+            abci.RequestFinalizeBlock(txs=[b"x=1"], height=1)
+        )
+        assert fr.tx_results[0].is_ok()
+        client.commit()
+        assert client.query(
+            abci.RequestQuery(data=b"x", path="/store")
+        ).value == b"1"
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_build_node_dials_remote_app(tmp_path):
+    """config.base.proxy_app routes the node's AppConns over the socket
+    protocol (reference node/setup.go:119 createAndStartProxyAppConns)."""
+    from cometbft_tpu.abci.types import RequestInfo
+    from cometbft_tpu.config.config import test_config
+    from cometbft_tpu.node.inprocess import build_node
+    from cometbft_tpu.privval import FilePV
+    from cometbft_tpu.types.genesis import GenesisDoc
+    from cometbft_tpu.types.validator_set import Validator
+
+    app = KVStoreApplication()
+    server, loop = _run_socket_server(app)
+    try:
+        pv = FilePV.generate(
+            str(tmp_path / "key.json"), str(tmp_path / "state.json")
+        )
+        pub = pv.pub_key()
+        gen = GenesisDoc(
+            chain_id="remote-app-chain",
+            validators=[Validator(pub_key=pub, voting_power=10)],
+        )
+        cfg = test_config(str(tmp_path))
+        cfg.base.proxy_app = server.listen_addr
+        cfg.base.abci = "socket"
+        parts = build_node(gen, pv, config=cfg, home=str(tmp_path))
+        assert parts.app is None
+        info = parts.proxy.query.info(RequestInfo())
+        assert info.last_block_height == app.height
+        for c in (
+            parts.proxy.consensus,
+            parts.proxy.mempool,
+            parts.proxy.query,
+            parts.proxy.snapshot,
+        ):
+            c.close()
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+
+
+def test_socket_server_reports_app_exception():
+    class Boom(KVStoreApplication):
+        def info(self, req):
+            raise RuntimeError("app exploded")
+
+    server, loop = _run_socket_server(Boom())
+    try:
+        c = SocketClient(server.listen_addr)
+        with pytest.raises(RuntimeError, match="app exploded"):
+            c.info(abci.RequestInfo())
+        # connection survives an app-level exception
+        assert c.echo("still-alive") == "still-alive"
+        c.close()
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
