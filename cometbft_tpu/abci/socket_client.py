@@ -136,6 +136,20 @@ class SocketClient:
                     except ValueError:
                         pass
                 raise
+            # the reader thread may have drained _pending (connection
+            # death) between our first _err check and the append; an
+            # entry added after the drain would hang its caller forever
+            with self._plock:
+                if self._err is not None and not fut.done():
+                    try:
+                        self._pending.remove(entry)
+                    except ValueError:
+                        pass
+                    fut.set_exception(
+                        ConnectionError(
+                            f"abci connection lost: {self._err}"
+                        )
+                    )
         return fut
 
     def _call(self, kind: int, req=None):

@@ -536,6 +536,76 @@ def cmd_abci_server(args) -> int:
     return 0
 
 
+def cmd_debug(args) -> int:
+    """`debug dump` / `debug kill` (reference
+    cmd/cometbft/commands/debug/): archive a live node's status,
+    net_info, consensus dump, and profiles; kill additionally
+    SIGKILLs the node process after the dump."""
+    from ..utils.debug import collect_debug_dump
+
+    path = collect_debug_dump(
+        args.rpc_laddr.replace("tcp://", ""),
+        args.output_dir,
+        pprof_addr=args.pprof_laddr,
+        label=args.debug_cmd,
+    )
+    print(f"wrote {path}")
+    if args.debug_cmd == "kill":
+        import signal as _sig
+
+        if args.pid <= 0:
+            print("debug kill requires --pid <node pid>", file=sys.stderr)
+            return 1
+        os.kill(args.pid, _sig.SIGKILL)
+        print(f"killed pid {args.pid}")
+    return 0
+
+
+def cmd_load(args) -> int:
+    """Timestamped tx load + commit-latency report (reference
+    test/loadtime)."""
+    import json as _json
+
+    from ..e2e.load import LoadGenerator, latency_report
+    from ..rpc.client import HTTPClient
+
+    async def main():
+        base = args.rpc_laddr.replace("tcp://", "http://")
+        if not base.startswith("http"):
+            base = "http://" + base
+        cli = HTTPClient(base)
+        try:
+            st = await cli.status()
+            h0 = int(st["sync_info"]["latest_block_height"])
+            gen = LoadGenerator(
+                cli,
+                rate=args.rate,
+                connections=args.connections,
+                tx_size=args.size,
+            )
+            res = await gen.run(args.time)
+            await asyncio.sleep(2.0)  # let the tail commit
+            st = await cli.status()
+            h1 = int(st["sync_info"]["latest_block_height"])
+            rep = await latency_report(cli, h0 + 1, h1)
+            print(
+                _json.dumps(
+                    {
+                        "sent": res.sent,
+                        "accepted": res.accepted,
+                        "rejected": res.rejected,
+                        "send_rate_tx_s": round(res.send_rate, 1),
+                        **rep.to_dict(),
+                    }
+                )
+            )
+        finally:
+            await cli.close()
+
+    asyncio.run(main())
+    return 0
+
+
 def cmd_version(args) -> int:
     print(f"cometbft-tpu v{VERSION}")
     return 0
@@ -608,6 +678,24 @@ def build_parser() -> argparse.ArgumentParser:
         help="validator node's priv_validator_laddr to dial",
     )
     p.set_defaults(fn=cmd_signer)
+
+    p = sub.add_parser("debug", help="dump/kill a live node")
+    p.add_argument("debug_cmd", choices=("dump", "kill"))
+    p.add_argument("--pid", type=int, default=0, help="pid (kill only)")
+    p.add_argument("--rpc-laddr", default="127.0.0.1:26657")
+    p.add_argument("--pprof-laddr", default="")
+    p.add_argument("--output-dir", default=".")
+    p.set_defaults(fn=cmd_debug)
+
+    p = sub.add_parser(
+        "load", help="generate tx load and report commit latency"
+    )
+    p.add_argument("--rpc-laddr", default="127.0.0.1:26657")
+    p.add_argument("-r", "--rate", type=float, default=100.0)
+    p.add_argument("-c", "--connections", type=int, default=1)
+    p.add_argument("-s", "--size", type=int, default=256)
+    p.add_argument("-T", "--time", type=float, default=10.0)
+    p.set_defaults(fn=cmd_load)
 
     p = sub.add_parser(
         "abci-server", help="host the kvstore app over socket/grpc ABCI"

@@ -134,13 +134,19 @@ class StorageConfig:
 
 @dataclass
 class TxIndexConfig:
-    indexer: str = "kv"  # kv | null
+    indexer: str = "kv"  # kv | null | psql
+    # connection string for the psql sink (reference [tx-index]
+    # psql-conn); required when indexer = "psql"
+    psql_conn: str = ""
 
 
 @dataclass
 class InstrumentationConfig:
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
+    # profiling listener (reference pprof_laddr, node/node.go:624):
+    # serves /debug/pprof/{stacks,profile,heap} when set
+    pprof_laddr: str = ""
 
 
 @dataclass
@@ -151,6 +157,11 @@ class CryptoConfig:
     min_batch_for_tpu: int = 2
     coalesce_window_ms: float = 2.0
     max_lanes: int = 32768
+
+
+# single source of truth for the fault-injection knobs ([fuzz] TOML
+# section, reference config/config.go:896)
+from ..p2p.fuzz import FuzzConnConfig  # noqa: E402
 
 
 @dataclass
@@ -167,6 +178,7 @@ class Config:
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig
     )
+    fuzz: FuzzConnConfig = field(default_factory=FuzzConnConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
     root_dir: str = "."
 
@@ -213,6 +225,7 @@ def load_toml(path: str) -> Config:
         ("storage", "storage"),
         ("tx_index", "tx_index"),
         ("instrumentation", "instrumentation"),
+        ("fuzz", "fuzz"),
         ("crypto", "crypto"),
     ):
         if section in raw:
@@ -251,6 +264,7 @@ def write_toml(cfg: Config, path: str) -> None:
         ("storage", cfg.storage),
         ("tx_index", cfg.tx_index),
         ("instrumentation", cfg.instrumentation),
+        ("fuzz", cfg.fuzz),
         ("crypto", cfg.crypto),
     ]
     with open(path, "w") as f:

@@ -124,6 +124,12 @@ def build_node(
         tx_indexer = TxIndexer(index_db)
         block_indexer = BlockIndexer(index_db)
         IndexerService(tx_indexer, block_indexer, event_bus).start()
+    elif config.tx_index.indexer == "psql":
+        # write-only relational sink (reference state/indexer/sink/psql)
+        from ..state.psql_sink import PsqlSink
+
+        sink = PsqlSink(config.tx_index.psql_conn, genesis.chain_id)
+        IndexerService(sink, sink, event_bus).start()
     # mempool flavor by config: clist | app (fork) | nop (ADR-111)
     if config.mempool.type_ == "app":
         from ..mempool.mempool import AppMempool

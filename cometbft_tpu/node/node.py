@@ -59,7 +59,13 @@ class Node:
             moniker=config.base.moniker,
         )
         if transport is None:
-            transport = TCPTransport(self.node_key, self.node_info)
+            # fault injection by config (reference FuzzConnConfig);
+            # maybe_fuzz treats disabled/None as passthrough
+            transport = TCPTransport(
+                self.node_key,
+                self.node_info,
+                fuzz_config=getattr(config, "fuzz", None),
+            )
         self.transport = transport
         if config.p2p.use_libp2p_equivalent:
             # fork feature: alternative stream-multiplexed switcher
@@ -153,6 +159,7 @@ class Node:
         self.statesync_error = None
         self.metrics = None
         self.metrics_server = None
+        self.debug_server = None
 
     # --- phase switching ----------------------------------------------
 
@@ -262,6 +269,14 @@ class Node:
                     self.config.instrumentation.prometheus_listen_addr
                 )
             )
+        if self.config.instrumentation.pprof_laddr:
+            # reference node/node.go:624-627: profiling listener by config
+            from ..utils.debug import DebugServer
+
+            self.debug_server = DebugServer(
+                self.config.instrumentation.pprof_laddr
+            )
+            await self.debug_server.start()
         # consensus starts now unless a sync phase must complete first
         if self.config.statesync.enable:
             self._statesync_task = asyncio.create_task(
@@ -285,6 +300,8 @@ class Node:
             self._statesync_task.cancel()
         if self.metrics_server is not None:
             await self.metrics_server.stop()
+        if self.debug_server is not None:
+            await self.debug_server.stop()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
         if self._cs_started:

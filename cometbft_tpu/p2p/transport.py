@@ -77,11 +77,15 @@ class TCPTransport:
     """listen() + accept stream; dial(). Produces upgraded
     (SecretConnection, NodeInfo, conn_str) triples."""
 
-    def __init__(self, node_key: NodeKey, node_info: NodeInfo):
+    def __init__(self, node_key: NodeKey, node_info: NodeInfo,
+                 fuzz_config=None):
         self.node_key = node_key
         self.node_info = node_info
         self._server: Optional[asyncio.AbstractServer] = None
         self.accept_queue: asyncio.Queue = asyncio.Queue(64)
+        # network fault injection (reference p2p/fuzz.go via config
+        # FuzzConnConfig); None/disabled = passthrough
+        self.fuzz_config = fuzz_config
 
     @property
     def listen_addr(self) -> str:
@@ -109,8 +113,14 @@ class TCPTransport:
             except Exception:
                 pass
             return
+        from .fuzz import maybe_fuzz
+
         await self.accept_queue.put(
-            (sconn, their_info, f"{peername[0]}:{peername[1]}")
+            (
+                maybe_fuzz(sconn, self.fuzz_config),
+                their_info,
+                f"{peername[0]}:{peername[1]}",
+            )
         )
 
     async def accept(self):
@@ -124,7 +134,9 @@ class TCPTransport:
         sconn, their_info = await upgrade(
             reader, writer, self.node_key, self.node_info, expected_id
         )
-        return sconn, their_info, addr
+        from .fuzz import maybe_fuzz
+
+        return maybe_fuzz(sconn, self.fuzz_config), their_info, addr
 
     async def close(self) -> None:
         if self._server:

@@ -1,0 +1,197 @@
+"""PostgreSQL event sink (reference state/indexer/sink/psql).
+
+Streams tx results and block events into relational tables so external
+systems can query them with SQL — the reference's psql sink is
+write-only (searches still go to the kv indexer or the database
+directly; state/indexer/sink/psql/psql.go returns errors for Search*).
+Gated on psycopg2 availability exactly as the reference gates on the
+postgres conn string: selecting `indexer = "psql"` without the driver
+(or without `psql_conn`) fails loudly at node construction.
+
+Schema (created on first connect, mirroring the reference's
+schema.sql): blocks(height, chain_id, created_at), tx_results(height,
+index, tx_hash, tx_bytes, result, created_at), events(height, tx_hash
+nullable, type), attributes(event_id, key, composite_key, value).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import List, Optional
+
+from ..abci import types as abci
+
+SCHEMA = """
+CREATE TABLE IF NOT EXISTS blocks (
+    rowid      BIGSERIAL PRIMARY KEY,
+    height     BIGINT NOT NULL,
+    chain_id   VARCHAR NOT NULL,
+    created_at TIMESTAMPTZ NOT NULL,
+    UNIQUE (height, chain_id)
+);
+CREATE TABLE IF NOT EXISTS tx_results (
+    rowid      BIGSERIAL PRIMARY KEY,
+    block_id   BIGINT NOT NULL REFERENCES blocks(rowid),
+    index      INTEGER NOT NULL,
+    created_at TIMESTAMPTZ NOT NULL,
+    tx_hash    VARCHAR NOT NULL,
+    tx_result  BYTEA NOT NULL,
+    UNIQUE (block_id, index)
+);
+CREATE TABLE IF NOT EXISTS events (
+    rowid    BIGSERIAL PRIMARY KEY,
+    block_id BIGINT NOT NULL REFERENCES blocks(rowid),
+    tx_id    BIGINT NULL REFERENCES tx_results(rowid),
+    type     VARCHAR NOT NULL
+);
+CREATE TABLE IF NOT EXISTS attributes (
+    event_id      BIGINT NOT NULL REFERENCES events(rowid),
+    key           VARCHAR NOT NULL,
+    composite_key VARCHAR NOT NULL,
+    value         VARCHAR NULL,
+    UNIQUE (event_id, key)
+);
+"""
+
+
+def available() -> bool:
+    try:
+        import psycopg2  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+class PsqlSink:
+    """Write-only event sink; interface-compatible with the kv
+    indexers where IndexerService needs it (index_tx / index_block).
+
+    Writes run on a dedicated worker thread: IndexerService listeners
+    fire synchronously on the node's event loop, and a remote/slow
+    Postgres must not stall the commit path (the kv indexer's local
+    writes are bounded; network round-trips are not)."""
+
+    def __init__(self, conn_str: str, chain_id: str):
+        if not available():
+            raise RuntimeError(
+                "indexer = 'psql' requires psycopg2 (not installed)"
+            )
+        if not conn_str:
+            raise ValueError("psql indexer requires a connection string")
+        import psycopg2
+
+        self.chain_id = chain_id
+        self._conn = psycopg2.connect(conn_str)
+        with self._conn, self._conn.cursor() as cur:
+            cur.execute(SCHEMA)
+        import queue
+        import threading
+
+        self._q: "queue.Queue" = queue.Queue(maxsize=10_000)
+        self._worker = threading.Thread(
+            target=self._drain, daemon=True, name="psql-sink"
+        )
+        self._worker.start()
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, args = item
+            try:
+                fn(*args)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def flush(self, timeout: float = 10.0) -> None:
+        """Block until queued writes land (tests / shutdown)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while not self._q.empty() and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+
+    def close(self) -> None:
+        self.flush()
+        self._q.put(None)
+        self._worker.join(timeout=5.0)
+        self._conn.close()
+
+    def _block_rowid(self, cur, height: int) -> int:
+        cur.execute(
+            "INSERT INTO blocks (height, chain_id, created_at) "
+            "VALUES (%s, %s, NOW()) "
+            "ON CONFLICT (height, chain_id) DO UPDATE SET height = "
+            "EXCLUDED.height RETURNING rowid",
+            (height, self.chain_id),
+        )
+        return cur.fetchone()[0]
+
+    def _insert_events(
+        self, cur, block_id: int, tx_id: Optional[int], events
+    ) -> None:
+        for e in events:
+            cur.execute(
+                "INSERT INTO events (block_id, tx_id, type) "
+                "VALUES (%s, %s, %s) RETURNING rowid",
+                (block_id, tx_id, e.type_),
+            )
+            eid = cur.fetchone()[0]
+            for a in e.attributes:
+                k, val, _idx = abci.attr_kvi(a)
+                cur.execute(
+                    "INSERT INTO attributes "
+                    "(event_id, key, composite_key, value) "
+                    "VALUES (%s, %s, %s, %s) ON CONFLICT DO NOTHING",
+                    (eid, k, f"{e.type_}.{k}", val),
+                )
+
+    def index_block(self, height: int, events: List[abci.Event]) -> None:
+        self._q.put((self._index_block_sync, (height, events)))
+
+    def _index_block_sync(self, height: int, events) -> None:
+        with self._conn, self._conn.cursor() as cur:
+            bid = self._block_rowid(cur, height)
+            self._insert_events(cur, bid, None, events)
+
+    def index_tx(
+        self,
+        height: int,
+        index: int,
+        tx: bytes,
+        result: abci.ExecTxResult,
+    ) -> None:
+        self._q.put((self._index_tx_sync, (height, index, tx, result)))
+
+    def _index_tx_sync(self, height, index, tx, result) -> None:
+        from .indexer import _enc_tx_result
+
+        with self._conn, self._conn.cursor() as cur:
+            bid = self._block_rowid(cur, height)
+            cur.execute(
+                "INSERT INTO tx_results "
+                "(block_id, index, created_at, tx_hash, tx_result) "
+                "VALUES (%s, %s, NOW(), %s, %s) "
+                "ON CONFLICT (block_id, index) DO UPDATE SET tx_hash = "
+                "EXCLUDED.tx_hash RETURNING rowid",
+                (
+                    bid,
+                    index,
+                    hashlib.sha256(tx).hexdigest().upper(),
+                    _enc_tx_result(result),
+                ),
+            )
+            txid = cur.fetchone()[0]
+            self._insert_events(cur, bid, txid, result.events)
+
+    # the reference psql sink is write-only (psql.go Search* -> error)
+    def get(self, tx_hash: bytes):
+        raise NotImplementedError("psql sink does not support queries")
+
+    def search(self, q):
+        raise NotImplementedError("psql sink does not support queries")

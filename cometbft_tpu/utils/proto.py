@@ -107,6 +107,10 @@ def parse(buf: bytes):
     raise (we only ever parse our own writer's output)."""
     import struct as _s
 
+    if not isinstance(buf, (bytes, bytearray, memoryview)):
+        # a mis-typed wire field (varint where a message was expected)
+        # must surface as a decode error, not a TypeError
+        raise ValueError(f"expected message bytes, got {type(buf).__name__}")
     out = {}
     pos = 0
     while pos < len(buf):
@@ -115,6 +119,8 @@ def parse(buf: bytes):
         if wire == WIRE_VARINT:
             v, pos = read_varint(buf, pos)
         elif wire == WIRE_FIXED64:
+            if pos + 8 > len(buf):
+                raise ValueError("truncated fixed64 field")
             (v,) = _s.unpack_from("<q", buf, pos)
             pos += 8
         elif wire == WIRE_BYTES:
@@ -124,6 +130,8 @@ def parse(buf: bytes):
                 raise ValueError("truncated bytes field")
             pos += ln
         elif wire == WIRE_FIXED32:
+            if pos + 4 > len(buf):
+                raise ValueError("truncated fixed32 field")
             (v,) = _s.unpack_from("<i", buf, pos)
             pos += 4
         else:

@@ -1,0 +1,232 @@
+"""Aux subsystems: FuzzedConnection, loadtime, debug/pprof, BLS gate,
+psql sink gating (reference p2p/fuzz.go, test/loadtime,
+commands/debug, crypto/bls12381, state/indexer/sink/psql)."""
+
+import asyncio
+import os
+
+import pytest
+
+from cometbft_tpu.p2p.fuzz import (
+    FuzzConnConfig,
+    FuzzedConnection,
+    maybe_fuzz,
+)
+
+
+class _FakeSconn:
+    def __init__(self, chunks=None):
+        self.written = []
+        self.chunks = list(chunks or [])
+        self.closed = False
+
+    async def write_msg(self, data):
+        self.written.append(data)
+        return len(data)
+
+    async def read_chunk(self):
+        if not self.chunks:
+            raise ConnectionError("eof")
+        return self.chunks.pop(0)
+
+    def close(self):
+        self.closed = True
+
+
+def test_fuzz_passthrough_when_disabled():
+    sconn = _FakeSconn()
+    assert maybe_fuzz(sconn, None) is sconn
+    assert maybe_fuzz(sconn, FuzzConnConfig(enable=False)) is sconn
+
+
+def test_fuzz_drop_mode_drops_writes():
+    sconn = _FakeSconn()
+    cfg = FuzzConnConfig(
+        enable=True, mode="drop", prob_drop_rw=0.5, seed=7
+    )
+    fz = FuzzedConnection(sconn, cfg)
+
+    async def go():
+        for _ in range(200):
+            await fz.write_msg(b"x")
+
+    asyncio.run(go())
+    # with p=0.5 over 200 writes, both dropped and delivered are certain
+    assert fz.dropped_writes > 20
+    assert len(sconn.written) > 20
+    assert fz.dropped_writes + len(sconn.written) == 200
+
+
+def test_fuzz_drop_conn_kills():
+    sconn = _FakeSconn()
+    cfg = FuzzConnConfig(
+        enable=True, mode="drop", prob_drop_rw=0.0, prob_drop_conn=1.0
+    )
+    fz = FuzzedConnection(sconn, cfg)
+    with pytest.raises(ConnectionError):
+        asyncio.run(fz.write_msg(b"x"))
+    assert sconn.closed
+
+
+def test_fuzz_delay_mode_preserves_traffic():
+    sconn = _FakeSconn(chunks=[b"a", b"b"])
+    cfg = FuzzConnConfig(
+        enable=True, mode="delay", prob_sleep=1.0, max_delay_ms=1
+    )
+    fz = FuzzedConnection(sconn, cfg)
+
+    async def go():
+        await fz.write_msg(b"msg")
+        return await fz.read_chunk(), await fz.read_chunk()
+
+    a, b = asyncio.run(go())
+    assert (a, b) == (b"a", b"b")
+    assert sconn.written == [b"msg"]
+
+
+# --- loadtime -----------------------------------------------------------
+
+
+def test_latency_report_math():
+    from cometbft_tpu.e2e.load import latency_report, make_tx
+
+    class Hdr:
+        def __init__(self, t):
+            self.time_ns = t
+
+    class Blk:
+        def __init__(self, t, txs):
+            self.header = Hdr(t)
+            self.data = type("D", (), {"txs": txs})()
+
+    base = 1_000_000_000_000
+    blocks = {
+        1: Blk(base + int(1e9), [make_tx(1, 64, base)]),
+        2: Blk(
+            base + int(2e9),
+            [make_tx(2, 64, base), b"other=1"],
+        ),
+        3: Blk(base + int(3e9), []),
+    }
+
+    class FakeClient:
+        async def block_decoded(self, h):
+            return blocks[h]
+
+    rep = asyncio.run(latency_report(FakeClient(), 1, 3))
+    assert rep.count == 2
+    assert rep.min_s == pytest.approx(1.0)
+    assert rep.max_s == pytest.approx(2.0)
+    assert rep.mean_s == pytest.approx(1.5)
+    assert rep.heights == 3
+    assert rep.block_interval_mean_s == pytest.approx(1.0)
+
+
+# --- debug / pprof ------------------------------------------------------
+
+
+def test_all_stacks_and_heap():
+    from cometbft_tpu.utils.debug import all_stacks, heap_stats
+
+    out = all_stacks()
+    assert "thread MainThread" in out
+    heap_stats()  # starts tracing
+    out = heap_stats()
+    assert "current=" in out
+
+
+def test_debug_server_endpoints():
+    from aiohttp import ClientSession
+
+    from cometbft_tpu.utils.debug import DebugServer
+
+    async def go():
+        srv = DebugServer("127.0.0.1:0")
+        await srv.start()
+        port = srv._runner.addresses[0][1]
+        async with ClientSession() as sess:
+            async with sess.get(
+                f"http://127.0.0.1:{port}/debug/pprof/stacks"
+            ) as r:
+                assert r.status == 200
+                assert "thread" in await r.text()
+        await srv.stop()
+
+    asyncio.run(go())
+
+
+def test_collect_debug_dump(tmp_path):
+    """dump against a fake node RPC; missing endpoints become .err
+    entries rather than failures."""
+    import json
+    import zipfile
+
+    from aiohttp import web
+
+    from cometbft_tpu.utils.debug import collect_debug_dump
+
+    async def go():
+        app = web.Application()
+
+        async def status(_r):
+            return web.json_response({"result": {"ok": True}})
+
+        app.router.add_get("/status", status)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        path = await asyncio.to_thread(
+            collect_debug_dump, f"127.0.0.1:{port}", str(tmp_path)
+        )
+        await runner.cleanup()
+        return path
+
+    path = asyncio.run(go())
+    with zipfile.ZipFile(path) as z:
+        names = z.namelist()
+        assert "status.json" in names
+        assert "net_info.json.err" in names
+        meta = json.loads(z.read("meta.json"))
+        assert "rpc" in meta
+
+
+# --- BLS gate -----------------------------------------------------------
+
+
+def test_bls_gated_off_by_default(monkeypatch):
+    monkeypatch.delenv("COMETBFT_TPU_BLS12381", raising=False)
+    from cometbft_tpu.crypto.keys import Bls12381PubKey
+
+    with pytest.raises(NotImplementedError):
+        Bls12381PubKey(b"\x00" * 48)
+
+
+def test_bls_sign_verify_when_enabled(monkeypatch):
+    monkeypatch.setenv("COMETBFT_TPU_BLS12381", "1")
+    from cometbft_tpu.crypto.keys import (
+        Bls12381PrivKey,
+        pubkey_from_type_bytes,
+    )
+
+    priv = Bls12381PrivKey.from_seed(b"test-seed")
+    pub = priv.pub_key()
+    sig = priv.sign(b"vote-sign-bytes")
+    assert pub.verify(b"vote-sign-bytes", sig)
+    assert not pub.verify(b"other-bytes", sig)
+    # registry dispatch
+    pk2 = pubkey_from_type_bytes("bls12381", bytes(pub))
+    assert pk2.verify(b"vote-sign-bytes", sig)
+
+
+# --- psql sink gate -----------------------------------------------------
+
+
+def test_psql_sink_gated_without_driver():
+    from cometbft_tpu.state import psql_sink
+
+    if psql_sink.available():  # pragma: no cover
+        pytest.skip("psycopg2 installed in this image")
+    with pytest.raises(RuntimeError, match="psycopg2"):
+        psql_sink.PsqlSink("host=localhost", "chain")
