@@ -43,7 +43,10 @@ def main() -> None:
     from cometbft_tpu.ops import ed25519 as ed
 
     rng = np.random.default_rng(42)
-    N = int(os.environ.get("BENCH_N", "8192"))
+    # default batch = replay-scale coalescing (10k-block catch-up at
+    # 150 validators yields ~1.5M signatures; 131072 lanes is where the
+    # kernel saturates the chip — ~291k verifies/s vs 224k at 8192)
+    N = int(os.environ.get("BENCH_N", "131072"))
     CAP = 175  # covers canonical vote sign bytes (chain-id dependent)
     MSG_LEN = 120
 
@@ -69,10 +72,17 @@ def main() -> None:
     rs = np.zeros((32, N), np.uint8)
     ss = np.zeros((32, N), np.uint8)
     host_items = []
-    for i in range(N):
-        k = i % n_keys
+    # distinct (msg, sig) pool sized like a large commit wave; lanes
+    # cycle through it (signing N distinct messages on the host would
+    # dominate bench wall time without changing the device work)
+    pool = max(n_keys, min(N, 4096))
+    pool_items = []
+    for j in range(pool):
+        k = j % n_keys
         m = rng.bytes(MSG_LEN)
-        sig = sign(seeds[k], m)
+        pool_items.append((k, m, sign(seeds[k], m)))
+    for i in range(N):
+        k, m, sig = pool_items[i % pool]
         msgs[:MSG_LEN, i] = np.frombuffer(m, np.uint8)
         pks[:, i] = np.frombuffer(pubs[k], np.uint8)
         rs[:, i] = np.frombuffer(sig[:32], np.uint8)

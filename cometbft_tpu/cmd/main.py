@@ -536,6 +536,23 @@ def cmd_abci_server(args) -> int:
     return 0
 
 
+def cmd_bootstrap_state(args) -> int:
+    """Offline statesync: light-verify state at a height and seed the
+    stores so `start` goes straight to blocksync (reference
+    node.BootstrapState, node/node.go:161-280)."""
+    from ..node.bootstrap import bootstrap_state
+    from ..types.genesis import GenesisDoc
+
+    home = _home(args)
+    cfg = _load_config(home)
+    with open(_paths(home)["genesis"]) as f:
+        gen = GenesisDoc.from_json(f.read())
+    h = bootstrap_state(cfg, gen, os.path.join(home, "data"),
+                        height=args.height or None)
+    print(f"bootstrapped state at height {h}")
+    return 0
+
+
 def cmd_debug(args) -> int:
     """`debug dump` / `debug kill` (reference
     cmd/cometbft/commands/debug/): archive a live node's status,
@@ -678,6 +695,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="validator node's priv_validator_laddr to dial",
     )
     p.set_defaults(fn=cmd_signer)
+
+    p = sub.add_parser(
+        "bootstrap-state",
+        help="seed stores with light-verified state (offline statesync)",
+    )
+    p.add_argument("--height", type=int, default=0)
+    p.set_defaults(fn=cmd_bootstrap_state)
 
     p = sub.add_parser("debug", help="dump/kill a live node")
     p.add_argument("debug_cmd", choices=("dump", "kill"))

@@ -156,7 +156,7 @@ class CryptoConfig:
     batch_backend: str = "tpu"  # tpu | cpu
     min_batch_for_tpu: int = 2
     coalesce_window_ms: float = 2.0
-    max_lanes: int = 32768
+    max_lanes: int = 131072
 
 
 # single source of truth for the fault-injection knobs ([fuzz] TOML
@@ -242,6 +242,8 @@ def write_toml(cfg: Config, path: str) -> None:
     def emit(name, obj):
         lines = [f"[{name}]"]
         for k, v in asdict(obj).items():
+            if v is None:
+                continue  # TOML has no null; absent key loads as default
             if isinstance(v, bool):
                 lines.append(f"{k} = {'true' if v else 'false'}")
             elif isinstance(v, (int, float)):

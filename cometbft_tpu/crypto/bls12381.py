@@ -382,7 +382,6 @@ def g2_on_curve(p) -> bool:
 
 
 G1 = (G1_X, G1_Y)
-G2 = (G2_X0, G2_X1), (G2_Y0, G2_Y1)
 G2 = ((G2_X0, G2_X1), (G2_Y0, G2_Y1))
 
 
@@ -508,11 +507,7 @@ def pairing(q, p):
 def hash_to_g2(msg: bytes, dst: bytes = b"COMETBFT-TPU-BLS-SIG-V1") -> Tuple:
     """Deterministic try-and-increment map to the r-torsion of G2 (not
     RFC 9380; see module docstring). Cofactor-cleared by scalar mul."""
-    h2_cofactor = (
-        # |E'(Fq2)| / r  for the standard BLS12-381 twist
-        (P**2 + 1 - 0) // 1
-    )
-    # correct cofactor: h2 = (x^8 - 4x^7 + 5x^6 - 4x^4 + 6x^3 - 4x^2 - 4x + 13)/9
+    # G2 cofactor: h2 = (x^8 - 4x^7 + 5x^6 - 4x^4 + 6x^3 - 4x^2 - 4x + 13)/9
     x = X_PARAM
     h2 = (x**8 - 4 * x**7 + 5 * x**6 - 4 * x**4 + 6 * x**3 - 4 * x**2 - 4 * x + 13) // 9
     ctr = 0

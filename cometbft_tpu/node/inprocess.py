@@ -125,11 +125,13 @@ def build_node(
         block_indexer = BlockIndexer(index_db)
         IndexerService(tx_indexer, block_indexer, event_bus).start()
     elif config.tx_index.indexer == "psql":
-        # write-only relational sink (reference state/indexer/sink/psql)
+        # write-only relational sink (reference state/indexer/sink/psql);
+        # retained on the parts so Node.stop can flush + close it
         from ..state.psql_sink import PsqlSink
 
         sink = PsqlSink(config.tx_index.psql_conn, genesis.chain_id)
         IndexerService(sink, sink, event_bus).start()
+        tx_indexer = block_indexer = sink
     # mempool flavor by config: clist | app (fork) | nop (ADR-111)
     if config.mempool.type_ == "app":
         from ..mempool.mempool import AppMempool

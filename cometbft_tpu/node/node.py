@@ -307,6 +307,13 @@ class Node:
         if self._cs_started:
             await self.parts.cs.stop()
         await self.switch.stop()
+        # flush + close the psql sink (its writer thread is a daemon:
+        # queued rows would be dropped on process exit otherwise)
+        if hasattr(self.parts.tx_indexer, "close"):
+            try:
+                await asyncio.to_thread(self.parts.tx_indexer.close)
+            except Exception:
+                traceback.print_exc()
 
     # --- convenience --------------------------------------------------
 

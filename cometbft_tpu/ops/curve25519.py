@@ -1,8 +1,8 @@
 """Edwards25519 group operations for TPU, vectorized over batch lanes.
 
 Points are extended homogeneous coordinates ``(X, Y, Z, T)`` — a tuple of
-four limb vectors shaped ``(20, N...)`` (see
-:mod:`cometbft_tpu.ops.fe25519`) — with x = X/Z, y = Y/Z, x*y = T/Z.
+four tuple-of-limbs field elements (see :mod:`cometbft_tpu.ops.fe25519`)
+— with x = X/Z, y = Y/Z, x*y = T/Z.
 
 The addition law used ("add-2008-hwcd-3" for a = -1) is **complete** on
 edwards25519 (a = -1 is square mod p, d is non-square), so identity and
@@ -43,25 +43,22 @@ _BX = _recover_bx()
 BASE_AFFINE = (_BX, _BY)
 
 
-def _c(x: int, ndim: int = 2):
-    return fe.const(x, ndim - 1)
-
-
 def identity(shape=()):
-    one = jnp.broadcast_to(
-        fe.const(1, max(len(shape), 1)), (fe.NLIMBS,) + shape
+    z = jnp.zeros(shape, jnp.int32)
+    one = tuple(
+        jnp.full(shape, 1, jnp.int32) if i == 0 else z
+        for i in range(fe.NLIMBS)
     )
-    return (fe.zero(shape), one, one, fe.zero(shape))
+    return ((z,) * fe.NLIMBS, one, one, (z,) * fe.NLIMBS)
 
 
 def add(p, q):
     """Complete unified addition (add-2008-hwcd-3, a = -1)."""
     X1, Y1, Z1, T1 = p
     X2, Y2, Z2, T2 = q
-    nd = max(X1.ndim, X2.ndim)
     A = fe.mul(fe.sub(Y1, X1), fe.sub(Y2, X2))
     B = fe.mul(fe.add(Y1, X1), fe.add(Y2, X2))
-    C = fe.mul(fe.mul(T1, fe.const(2 * _D % P, nd - 1)), T2)
+    C = fe.mul(fe.mul(T1, fe.const(2 * _D % P)), T2)
     ZZ = fe.mul(Z1, Z2)
     Dv = fe.add(ZZ, ZZ)
     E = fe.sub(B, A)
@@ -107,11 +104,10 @@ def decompress(b):
     downstream math stays finite.
     """
     y, sign = fe.from_bytes_255(b)
-    nd = y.ndim
-    one = fe.const(1, nd - 1)
+    one = fe.const(1)
     ysq = fe.square(y)
     u = fe.sub(ysq, one)
-    v = fe.add(fe.mul(ysq, fe.const(_D, nd - 1)), one)
+    v = fe.add(fe.mul(ysq, fe.const(_D)), one)
     # candidate root r = u * v^3 * (u * v^7)^((p-5)/8)
     v3 = fe.mul(fe.square(v), v)
     v7 = fe.mul(fe.square(v3), v)
@@ -120,12 +116,16 @@ def decompress(b):
     root_ok = fe.eq(check, u)
     root_neg = fe.eq(check, fe.neg(u))
     ok = root_ok | root_neg
-    x = fe.select(root_neg, fe.mul(r, fe.const(_SQRT_M1, nd - 1)), r)
+    x = fe.select(root_neg, fe.mul(r, fe.const(_SQRT_M1)), r)
     # match requested sign (x = 0 stays 0; -0 == 0 under mod p)
     flip = fe.parity(x) != sign
     x = fe.select(flip, fe.neg(x), x)
-    shape = y.shape[1:]
-    one_b = jnp.broadcast_to(one, (fe.NLIMBS,) + shape)
+    shape = jnp.shape(sign)
+    one_b = tuple(
+        jnp.full(shape, 1, jnp.int32) if i == 0
+        else jnp.zeros(shape, jnp.int32)
+        for i in range(fe.NLIMBS)
+    )
     pt = (x, y, one_b, fe.mul(x, y))
     return select(ok, pt, identity(shape)), ok
 
@@ -144,12 +144,11 @@ def mul_by_cofactor(p):
 
 def to_cached(p):
     X, Y, Z, T = p
-    nd = X.ndim
     return (
         fe.add(Y, X),
         fe.sub(Y, X),
         Z,
-        fe.mul(T, fe.const(2 * _D % P, nd - 1)),
+        fe.mul(T, fe.const(2 * _D % P)),
     )
 
 

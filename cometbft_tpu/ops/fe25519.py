@@ -3,12 +3,15 @@
 Design notes (TPU-first, not a port):
 
 * TPU has no 64-bit integers and no big-int unit. A field element is a
-  vector of ``NLIMBS = 20`` limbs of ``LIMB_BITS = 13`` bits each held in
-  ``int32``, **limb axis first**: shape ``(20, N...)`` with the batch on
-  the trailing axes. On TPU the trailing logical axis maps to the 128-wide
-  vector lanes, so batch-last keeps every lane busy (a batch-first
-  ``(N, 20)`` layout would pad 20 -> 128 lanes and waste 6.4x memory and
-  VPU throughput).
+  **tuple of ``NLIMBS = 20`` separate int32 arrays** (one per 13-bit
+  limb), each shaped ``(N...)`` with the batch on the trailing axes.
+  The tuple-of-arrays form (rather than one stacked ``(20, N)`` array)
+  is the load-bearing choice: every field op is then a pure elementwise
+  DAG over same-shaped vectors with **zero data-movement ops** — no
+  stack/concatenate/roll — which XLA fuses into a handful of kernels.
+  The previous stacked layout made each multiply materialize its
+  (41, N) intermediates through HBM (concatenate/stack are fusion
+  breakers), leaving the verify kernel ~25x slower than its ALU cost.
 * 13-bit limbs are the sweet spot for int32 lanes: a full schoolbook
   product limb is a sum of 20 partial products each < 2^26, total < 2^31,
   so the whole convolution accumulates in plain int32 with no carries
@@ -23,9 +26,10 @@ Design notes (TPU-first, not a port):
   limb 0 multiplied by ``WRAP = 2^260 mod p = 608``. Elements stay in a
   redundant range; exact canonical comparisons are done by
   ``canonical()`` / ``is_zero()`` without a full freeze-subtract.
-* Everything is static-shaped, static-control-flow jnp code: XLA fuses
-  the elementwise limb ops; the hot loops live in
-  ``cometbft_tpu.ops.ed25519``.
+* Everything is static-shaped, static-control-flow jnp code; the hot
+  loops live in :mod:`cometbft_tpu.ops.ed25519`. ``stack``/``unstack``
+  convert to/from the (20, N) array form at module boundaries (tests,
+  the scalar module, byte IO).
 
 Reference seams replaced (behavioral parity targets, not code ports):
 the curve25519-voi field element used by the reference's
@@ -69,33 +73,48 @@ def from_limbs(limbs) -> int:
     return val % P
 
 
+# --- representation adapters -------------------------------------------
+
+
+def stack(t):
+    """tuple-of-limbs -> one (20, N...) int32 array (module boundary)."""
+    shape = jnp.broadcast_shapes(*(jnp.shape(x) for x in t))
+    return jnp.stack(
+        [jnp.broadcast_to(x, shape).astype(jnp.int32) for x in t], axis=0
+    )
+
+
+def unstack(arr):
+    """(20, N...) array -> tuple-of-limbs."""
+    return tuple(arr[i] for i in range(NLIMBS))
+
+
 def zero(shape=()):
-    return jnp.zeros((NLIMBS,) + shape, jnp.int32)
+    z = jnp.zeros(shape, jnp.int32)
+    return (z,) * NLIMBS
 
 
-def const(x: int, ndim: int = 1):
-    """Device constant shaped (20, 1, 1, ...) broadcastable to (20, N...)."""
-    return jnp.asarray(to_limbs(x)).reshape((NLIMBS,) + (1,) * ndim)
+def const(x: int):
+    """Device constant: tuple of int32 scalars (broadcasts everywhere)."""
+    return tuple(jnp.int32(int(v)) for v in to_limbs(x))
 
 
 def _bshape(*args):
-    return jnp.broadcast_shapes(*(a.shape[1:] for a in args))
+    return jnp.broadcast_shapes(*(jnp.shape(a[0]) for a in args))
 
 
 def carry(x, rounds: int = 3):
     """Propagate carries; carry-out of limb 19 wraps to limb 0 times WRAP.
 
     Preserves the value mod p. With inputs bounded by 2^31 the default 3
-    rounds bring limbs into (-2^13, 2^13 + WRAP]; see module docstring.
-
-    Written as concat-adds (not .at[] scatters): scatter-add forces XLA
-    to materialize the full accumulator in HBM per step, turning the
-    whole ladder memory-bound.
-    """
+    rounds bring limbs into (-2^13, 2^13 + WRAP]; pure per-limb
+    elementwise ops, the cross-limb shift is just tuple reindexing."""
     for _ in range(rounds):
-        c = lax.shift_right_arithmetic(x, LIMB_BITS)
-        r = jnp.bitwise_and(x, MASK)
-        x = r + jnp.concatenate([c[-1:] * WRAP, c[:-1]], axis=0)
+        c = tuple(lax.shift_right_arithmetic(v, LIMB_BITS) for v in x)
+        r = tuple(jnp.bitwise_and(v, MASK) for v in x)
+        x = (r[0] + c[NLIMBS - 1] * WRAP,) + tuple(
+            r[i] + c[i - 1] for i in range(1, NLIMBS)
+        )
     return x
 
 
@@ -110,34 +129,29 @@ def _make_bias() -> np.ndarray:
     return out.astype(np.int32)
 
 
-_BIAS = _make_bias()
-
-
-def _bias(ndim: int):
-    return jnp.asarray(_BIAS).reshape((NLIMBS,) + (1,) * (ndim - 1))
+_BIAS = tuple(int(v) for v in _make_bias())
 
 
 def add(a, b):
-    return carry(a + b, 1)
+    return carry(tuple(x + y for x, y in zip(a, b)), 1)
 
 
 def sub(a, b):
     """a - b mod p; bias keeps limbs nonneg (inputs must be carried)."""
-    return carry(a + _bias(max(a.ndim, b.ndim)) - b, 2)
+    return carry(
+        tuple(x + k - y for x, y, k in zip(a, b, _BIAS)), 2
+    )
 
 
 def neg(a):
-    return carry(_bias(a.ndim) - a, 2)
+    return carry(tuple(k - x for x, k in zip(a, _BIAS)), 2)
 
 
 def _conv_mul(a, b):
-    """Schoolbook 20x20 limb convolution -> 41-limb int32.
+    """Schoolbook 20x20 limb convolution -> 41-limb tuple.
 
     Output-stationary: each result limb is an independent sum of <= 20
-    lane-wise products, a pure fusable expression — the previous
-    accumulator form (20 sequential .at[i:i+20].add scatters) made XLA
-    round-trip the (41, N) accumulator through HBM twenty times per
-    field multiply, which dominated the whole verify kernel's runtime.
+    lane-wise products — a pure fusable elementwise expression.
 
     The convolution proper spans limbs 0..38; limbs 39-40 are headroom
     for the carry rounds (limb 38 can carry ~2^13.5 into limb 39, which
@@ -154,14 +168,15 @@ def _conv_mul(a, b):
     z = jnp.zeros_like(outs[0])
     outs.append(z)  # limb 39 headroom
     outs.append(z)  # limb 40 headroom
-    return jnp.stack(outs, axis=0)
+    return tuple(outs)
 
 
 def _carry_noWrap(c, rounds: int = 3):
+    n = len(c)
     for _ in range(rounds):
-        cc = lax.shift_right_arithmetic(c, LIMB_BITS)
-        r = jnp.bitwise_and(c, MASK)
-        c = r + jnp.concatenate([jnp.zeros_like(cc[-1:]), cc[:-1]], axis=0)
+        cc = tuple(lax.shift_right_arithmetic(v, LIMB_BITS) for v in c)
+        r = tuple(jnp.bitwise_and(v, MASK) for v in c)
+        c = (r[0],) + tuple(r[i] + cc[i - 1] for i in range(1, n))
     return c
 
 
@@ -171,13 +186,9 @@ def mul(a, b):
     c = _carry_noWrap(c, 3)
     lo = c[:NLIMBS]
     hi = c[NLIMBS : 2 * NLIMBS]
-    out = lo + hi * WRAP
-    tail = jnp.concatenate(
-        [c[2 * NLIMBS :] * (WRAP * WRAP),
-         jnp.zeros((NLIMBS - 1,) + c.shape[1:], jnp.int32)],
-        axis=0,
-    )
-    return carry(out + tail, 3)
+    out = [x + y * WRAP for x, y in zip(lo, hi)]
+    out[0] = out[0] + c[2 * NLIMBS] * (WRAP * WRAP)
+    return carry(tuple(out), 3)
 
 
 def square(a):
@@ -186,11 +197,11 @@ def square(a):
 
 def mul_scalar(a, k: int):
     """Multiply by a small nonneg python int (k < 2^17)."""
-    return carry(a * jnp.int32(k), 3)
+    return carry(tuple(v * jnp.int32(k) for v in a), 3)
 
 
 def sqn(x, n: int):
-    """x^(2^n) via n squarings inside a fori_loop (keeps HLO small)."""
+    """x^(2^n) via n squarings; fori_loop keeps the HLO small."""
     if n <= 4:
         for _ in range(n):
             x = square(x)
@@ -233,8 +244,8 @@ def invert(x):
 
 # --- canonicalization / predicates -------------------------------------
 
-_TWO_P = raw_limbs(2 * P)
-_P_LIMBS = raw_limbs(P)
+_TWO_P = tuple(int(v) for v in raw_limbs(2 * P))
+_P_LIMBS = tuple(int(v) for v in raw_limbs(P))
 
 
 def canonical(x):
@@ -245,21 +256,24 @@ def canonical(x):
     value is ``(limbs[0] & 1) ^ ge_p`` (p is odd).
     """
     x = carry(x, 4)              # limbs in (-2^13, 2^13 + WRAP]
-    x = x + jnp.asarray(_TWO_P).reshape((NLIMBS,) + (1,) * (x.ndim - 1))
+    x = tuple(v + t for v, t in zip(x, _TWO_P))
     x = carry(x, 6)              # nonneg carries converge: limbs in [0, 2^13)
     # fold bits 255+ : limb 19 holds bits 247..259
     top = lax.shift_right_arithmetic(x[19], 8)
-    x = x.at[19].set(jnp.bitwise_and(x[19], 255)).at[0].add(top * 19)
+    x = (
+        (x[0] + top * 19,)
+        + x[1:19]
+        + (jnp.bitwise_and(x[19], 255),)
+    )
     x = carry(x, 2)
     # now value < 2^255 + ~600 < 2p, limbs canonical nonneg
-    pl = jnp.asarray(_P_LIMBS)
-    gt = x > pl.reshape((NLIMBS,) + (1,) * (x.ndim - 1))
-    lt = x < pl.reshape((NLIMBS,) + (1,) * (x.ndim - 1))
-    ge = jnp.zeros(x.shape[1:], bool)
-    eq_above = jnp.ones(x.shape[1:], bool)
+    ge = jnp.zeros(_bshape(x), bool)
+    eq_above = jnp.ones(_bshape(x), bool)
     for i in reversed(range(NLIMBS)):
-        ge = ge | (eq_above & gt[i])
-        eq_above = eq_above & ~gt[i] & ~lt[i]
+        gt = x[i] > _P_LIMBS[i]
+        lt = x[i] < _P_LIMBS[i]
+        ge = ge | (eq_above & gt)
+        eq_above = eq_above & ~gt & ~lt
     ge = ge | eq_above  # x == p counts as >= p
     return x, ge
 
@@ -267,14 +281,16 @@ def canonical(x):
 def is_zero(x):
     """Exact test: value(x) ≡ 0 mod p (vectorized bool, shape = batch)."""
     limbs, _ = canonical(x)
-    pl = jnp.asarray(_P_LIMBS).reshape((NLIMBS,) + (1,) * (limbs.ndim - 1))
-    all_zero = jnp.all(limbs == 0, axis=0)
-    eq_p = jnp.all(limbs == pl, axis=0)
+    all_zero = jnp.ones(_bshape(limbs), bool)
+    eq_p = jnp.ones(_bshape(limbs), bool)
+    for i in range(NLIMBS):
+        all_zero = all_zero & (limbs[i] == 0)
+        eq_p = eq_p & (limbs[i] == _P_LIMBS[i])
     return all_zero | eq_p
 
 
 def eq(a, b):
-    return is_zero(a - b)
+    return is_zero(sub(a, b))
 
 
 def parity(x):
@@ -289,7 +305,7 @@ def parity(x):
 
 
 def from_bytes_255(b):
-    """bytes (32, N...) uint8 LE -> (limbs (20, N...), signbit (N...)).
+    """bytes (32, N...) uint8 LE -> (limbs tuple, signbit (N...)).
 
     Bit 255 split off as the sign. ZIP-215 semantics: y values >= p are
     accepted; the redundant limb form carries the excess, later ops
@@ -297,32 +313,34 @@ def from_bytes_255(b):
     """
     b = b.astype(jnp.int32)
     sign = lax.shift_right_arithmetic(b[31], 7)
-    b = b.at[31].set(jnp.bitwise_and(b[31], 0x7F))
-    return _pack_limbs(b, NLIMBS), sign
+    rows = [b[i] for i in range(32)]
+    rows[31] = jnp.bitwise_and(rows[31], 0x7F)
+    return _pack_limbs(rows, NLIMBS), sign
 
 
 def from_bytes_256(b):
     """bytes (32, N...) uint8 LE -> 20 limbs of the full 256-bit integer."""
-    return _pack_limbs(b.astype(jnp.int32), NLIMBS)
+    b = b.astype(jnp.int32)
+    return _pack_limbs([b[i] for i in range(32)], NLIMBS)
 
 
-def _pack_limbs(b, nlimbs: int):
-    """b: (nbytes, N...) int32 -> (nlimbs, N...) 13-bit limbs (static)."""
-    pad = jnp.zeros((2,) + b.shape[1:], jnp.int32)
-    b = jnp.concatenate([b, pad], axis=0)
+def _pack_limbs(rows, nlimbs: int):
+    """rows: list of (N...) int32 byte vectors -> tuple of 13-bit limbs."""
+    z = jnp.zeros_like(rows[0])
+    rows = rows + [z, z]
     limbs = []
     for i in range(nlimbs):
         bit = LIMB_BITS * i
         byte, off = bit // 8, bit % 8
         v = (
-            lax.shift_right_arithmetic(b[byte], off)
-            | (b[byte + 1] << (8 - off))
-            | (b[byte + 2] << (16 - off))
+            lax.shift_right_arithmetic(rows[byte], off)
+            | (rows[byte + 1] << (8 - off))
+            | (rows[byte + 2] << (16 - off))
         )
         limbs.append(jnp.bitwise_and(v, MASK))
-    return jnp.stack(limbs, axis=0)
+    return tuple(limbs)
 
 
 def select(mask, a, b):
-    """Lane select: mask (N...,) bool -> where(mask, a, b) over limbs."""
-    return jnp.where(mask[None], a, b)
+    """Lane select: mask (N...,) bool -> where(mask, a, b) per limb."""
+    return tuple(jnp.where(mask, x, y) for x, y in zip(a, b))

@@ -1,9 +1,11 @@
 """Scalar arithmetic mod L = 2^252 + 27742...493 for TPU lanes.
 
 Scalars are plain (non-modular-redundant) little-endian 13-bit limb
-vectors in int32, **limb axis first** (shape ``(nlimbs, N...)``), length
-20 (260 bits) unless noted. The SHA-512 output reduction (512 bits ->
-mod L) uses iterated folding at bit 252:
+**tuples** — one int32 array per limb, batch on the trailing axes (see
+the fe25519 layout note: the tuple form keeps every op a fusable
+elementwise expression with no concatenate/stack data movement).
+Length 20 (260 bits) unless noted. The SHA-512 output reduction
+(512 bits -> mod L) uses iterated folding at bit 252:
 
     X = hi * 2^252 + lo   ==>   X ≡ lo - hi*c  (mod L),  c = L - 2^252.
 
@@ -41,12 +43,8 @@ def _raw(x: int, n: int) -> np.ndarray:
     return out
 
 
-_C_LIMBS = _raw(_C, 10)
-_L_LIMBS = _raw(L, 20)
-
-
-def _cst(arr: np.ndarray, ndim: int):
-    return jnp.asarray(arr).reshape(arr.shape + (1,) * (ndim - 1))
+_C_LIMBS = tuple(int(v) for v in _raw(_C, 10))
+_L_LIMBS = tuple(int(v) for v in _raw(L, 20))
 
 
 def from_limbs(limbs) -> int:
@@ -62,61 +60,67 @@ def carry_plain(x, rounds=None):
     top limb must be impossible by construction — keep a headroom limb).
     Works for signed limbs provided the represented *value* is
     nonnegative and rounds >= nlimbs + 6 when borrows may ripple."""
+    x = tuple(x)
+    n = len(x)
     if rounds is None:
-        rounds = x.shape[0] + 6
+        rounds = n + 6
     for _ in range(rounds):
-        c = lax.shift_right_arithmetic(x, LIMB_BITS)
-        r = jnp.bitwise_and(x, MASK)
-        x = r + jnp.concatenate(
-            [jnp.zeros_like(c[-1:]), c[:-1]], axis=0
-        )
+        c = tuple(lax.shift_right_arithmetic(v, LIMB_BITS) for v in x)
+        r = tuple(jnp.bitwise_and(v, MASK) for v in x)
+        x = (r[0],) + tuple(r[i] + c[i - 1] for i in range(1, n))
     return x
 
 
-def _conv(a, b_const: np.ndarray):
+def _conv(a, b_const) -> tuple:
     """Full product limbs(a) x constant limbs -> len(a)+len(b) limbs.
 
     Output-stationary (see fe25519._conv_mul): each limb an independent
-    fusable sum, no scatter-add accumulator."""
-    na, nb = a.shape[0], b_const.shape[0]
-    bc = _cst(b_const, a.ndim)
+    fusable sum of products by int constants."""
+    a = tuple(a)
+    na, nb = len(a), len(b_const)
     outs = []
     for k in range(na + nb - 1):
         lo = max(0, k - nb + 1)
         hi = min(na - 1, k)
-        s = a[lo] * bc[k - lo]
+        s = a[lo] * jnp.int32(b_const[k - lo])
         for i in range(lo + 1, hi + 1):
-            s = s + a[i] * bc[k - i]
+            s = s + a[i] * jnp.int32(b_const[k - i])
         outs.append(s)
     outs.append(jnp.zeros_like(outs[0]))
-    return jnp.stack(outs, axis=0)
+    return tuple(outs)
 
 
 def _split_252(x):
-    """x: canonical nonneg limbs (n, N...) -> (lo = x mod 2^252 as 20
-    limbs, hi = x >> 252 with n-19 limbs)."""
-    n = x.shape[0]
-    lo = x[:NLIMBS].at[19].set(jnp.bitwise_and(x[19], 31))
-    pad = jnp.zeros((1,) + x.shape[1:], jnp.int32)
-    xp = jnp.concatenate([x, pad], axis=0)
-    hi = jnp.bitwise_and(
-        lax.shift_right_arithmetic(xp[19:n], 5)
-        | (jnp.bitwise_and(xp[20 : n + 1], 31) << 8),
-        MASK,
+    """x: canonical nonneg limb tuple -> (lo = x mod 2^252 as 20 limbs,
+    hi = x >> 252 with n-19 limbs)."""
+    x = tuple(x)
+    n = len(x)
+    lo = x[:19] + (jnp.bitwise_and(x[19], 31),)
+    z = jnp.zeros_like(x[0])
+    xp = x + (z,)
+    hi = tuple(
+        jnp.bitwise_and(
+            lax.shift_right_arithmetic(xp[i], 5)
+            | (jnp.bitwise_and(xp[i + 1], 31) << 8),
+            MASK,
+        )
+        for i in range(19, n)
     )
     return lo, hi
 
 
-def _ge_limbs(a, b_const: np.ndarray):
+def _ge_limbs(a, b_const) -> jnp.ndarray:
     """Lexicographic a >= b for canonical nonneg limb vectors."""
-    bc = _cst(b_const, a.ndim)
-    gt = a > bc
-    lt = a < bc
-    ge = jnp.zeros(a.shape[1:], bool)
-    eq_above = jnp.ones(a.shape[1:], bool)
-    for i in reversed(range(a.shape[0])):
-        ge = ge | (eq_above & gt[i])
-        eq_above = eq_above & ~gt[i] & ~lt[i]
+    a = tuple(a)
+    shape = jnp.broadcast_shapes(*(jnp.shape(v) for v in a))
+    ge = jnp.zeros(shape, bool)
+    eq_above = jnp.ones(shape, bool)
+    for i in reversed(range(len(a))):
+        b = b_const[i] if i < len(b_const) else 0
+        gt = a[i] > b
+        lt = a[i] < b
+        ge = ge | (eq_above & gt)
+        eq_above = eq_above & ~gt & ~lt
     return ge | eq_above
 
 
@@ -126,37 +130,40 @@ def _fold_once(x, shift: int):
     hic = _conv(hi, _C_LIMBS)
     k = L << shift
     nk = (k.bit_length() + LIMB_BITS - 1) // LIMB_BITS + 1
-    n = max(lo.shape[0], hic.shape[0], nk) + 1
-    kl = _cst(_raw(k, n), x.ndim)
+    n = max(len(lo), len(hic), nk) + 1
+    kl = tuple(int(v) for v in _raw(k, n))
+    z = jnp.zeros_like(lo[0])
 
-    def pad(v):
-        return jnp.concatenate(
-            [v, jnp.zeros((n - v.shape[0],) + v.shape[1:], jnp.int32)],
-            axis=0,
-        )
+    def at(t, i):
+        return t[i] if i < len(t) else z
 
-    out = pad(lo) + kl - pad(hic)
+    out = tuple(at(lo, i) + kl[i] - at(hic, i) for i in range(n))
     return carry_plain(out)
 
 
 def reduce_512(x40):
-    """(40, N...) limbs of a 512-bit LE integer -> canonical scalar mod L,
-    (20, N...) limbs in [0, L)."""
+    """40-limb tuple of a 512-bit LE integer -> canonical scalar mod L,
+    20-limb tuple in [0, L)."""
     x = carry_plain(x40)
     x = _fold_once(x, 134)   # < 2^388
     x = _fold_once(x, 10)    # < 2^263
     x = _fold_once(x, 0)     # < L + 2^252 < 2L
     x = _fold_once(x, 0)     # safety margin, keeps < 2L
-    x = x[:NLIMBS]
+    x = tuple(x)[:NLIMBS]
     ge = _ge_limbs(x, _L_LIMBS)
-    x = jnp.where(ge[None], x - _cst(_L_LIMBS, x.ndim), x)
+    x = tuple(
+        jnp.where(ge, v - jnp.int32(b), v)
+        for v, b in zip(x, _L_LIMBS)
+    )
     return carry_plain(x)
 
 
 def neg_mod_L(h):
     """L - h for canonical h in [0, L). h = 0 maps to L (a 253-bit value),
     harmless in cofactored verification: [8][L]A = identity for any A."""
-    return carry_plain(_cst(_L_LIMBS, h.ndim) - h)
+    return carry_plain(
+        tuple(jnp.int32(b) - v for v, b in zip(tuple(h), _L_LIMBS))
+    )
 
 
 def lt_L(s):
@@ -165,8 +172,9 @@ def lt_L(s):
 
 
 def bits(s, n: int = 253):
-    """(20, N...) limbs -> (n, N...) bit planes, little-endian bit order
+    """Limb tuple -> (n, N...) bit planes, little-endian bit order
     (leading axis = bit index, ready for fori_loop dynamic indexing)."""
+    s = tuple(s)
     planes = []
     for j in range(n):
         limb, off = divmod(j, LIMB_BITS)
@@ -177,11 +185,11 @@ def bits(s, n: int = 253):
 
 
 def digits4(s, nwin: int = 64):
-    """(20, N...) canonical limbs -> (nwin, N...) 4-bit windows,
-    little-endian window order (window j = bits 4j..4j+3). Feeds the
-    windowed double-scalar ladder."""
-    pad = jnp.zeros((1,) + s.shape[1:], jnp.int32)
-    sp = jnp.concatenate([s, pad], axis=0)
+    """Canonical limb tuple -> (nwin, N...) 4-bit windows, little-endian
+    window order (window j = bits 4j..4j+3). Stacked output: the ladder
+    dynamic-indexes one window per fori_loop step."""
+    s = tuple(s)
+    sp = s + (jnp.zeros_like(s[0]),)
     outs = []
     for j in range(nwin):
         limb, off = divmod(4 * j, LIMB_BITS)
@@ -193,18 +201,19 @@ def digits4(s, nwin: int = 64):
 
 
 def hash_bytes_to_limbs(b):
-    """(64, N...) uint8 digest bytes (LE integer) -> (40, N...) limbs."""
+    """(64, N...) uint8 digest bytes (LE integer) -> 40-limb tuple."""
     b = b.astype(jnp.int32)
-    pad = jnp.zeros((2,) + b.shape[1:], jnp.int32)
-    b = jnp.concatenate([b, pad], axis=0)
+    rows = [b[i] for i in range(64)]
+    z = jnp.zeros_like(rows[0])
+    rows += [z, z]
     limbs = []
     for i in range(40):
         bit = LIMB_BITS * i
         byte, off = bit // 8, bit % 8
         v = (
-            lax.shift_right_arithmetic(b[byte], off)
-            | (b[byte + 1] << (8 - off))
-            | (b[byte + 2] << (16 - off))
+            lax.shift_right_arithmetic(rows[byte], off)
+            | (rows[byte + 1] << (8 - off))
+            | (rows[byte + 2] << (16 - off))
         )
         limbs.append(jnp.bitwise_and(v, MASK))
-    return jnp.stack(limbs, axis=0)
+    return tuple(limbs)

@@ -98,23 +98,25 @@ class PsqlSink:
     def _drain(self) -> None:
         while True:
             item = self._q.get()
-            if item is None:
-                return
-            fn, args = item
             try:
-                fn(*args)
-            except Exception:
-                import traceback
+                if item is None:
+                    return
+                fn, args = item
+                try:
+                    fn(*args)
+                except Exception:
+                    import traceback
 
-                traceback.print_exc()
+                    traceback.print_exc()
+            finally:
+                # task_done AFTER the write commits: flush() uses
+                # q.join(), so emptiness of the queue alone must not
+                # signal completion (the in-flight item counts)
+                self._q.task_done()
 
-    def flush(self, timeout: float = 10.0) -> None:
-        """Block until queued writes land (tests / shutdown)."""
-        import time as _time
-
-        deadline = _time.monotonic() + timeout
-        while not self._q.empty() and _time.monotonic() < deadline:
-            _time.sleep(0.01)
+    def flush(self) -> None:
+        """Block until every queued write has committed."""
+        self._q.join()
 
     def close(self) -> None:
         self.flush()

@@ -22,3 +22,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 from cometbft_tpu.crypto import batch as _batch  # noqa: E402
 
 _batch.set_default_backend("cpu")
+
+# persistent XLA compile cache (shared with bench.py): the tuple-form
+# verify kernel costs minutes to compile per shape on this 1-core box;
+# cached recompiles land in seconds across test runs
+import jax  # noqa: E402
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)

@@ -23,11 +23,13 @@ def rand_ints(n):
 
 
 def limbs_of(vals):
-    return jnp.asarray(np.stack([fe.to_limbs(v) for v in vals], axis=1))
+    return fe.unstack(
+        jnp.asarray(np.stack([fe.to_limbs(v) for v in vals], axis=1))
+    )
 
 
 def check_all(got_limbs, want_ints):
-    got = np.asarray(got_limbs)
+    got = np.asarray(fe.stack(got_limbs))
     for i, w in enumerate(want_ints):
         assert fe.from_limbs(got[:, i]) == w % P, (
             f"lane {i}: got {fe.from_limbs(got[:, i])} want {w % P}"
@@ -62,7 +64,7 @@ def test_mul_chains_stay_bounded():
     for _ in range(30):
         acc_limbs = mulj(acc_limbs, a)
         acc_int = [x * y for x, y in zip(acc_int, vals)]
-        assert int(jnp.max(jnp.abs(acc_limbs))) < (1 << 14)
+        assert int(jnp.max(jnp.abs(fe.stack(acc_limbs)))) < (1 << 14)
     check_all(acc_limbs, acc_int)
 
 
@@ -108,7 +110,7 @@ def test_fuzz_op_sequences():
             cur_l = f_l(cur_l, a)
             cur_i = [f_i(x, y) for x, y in zip(cur_i, vals)]
         cur_i = [x % P for x in cur_i]
-        assert int(jnp.max(jnp.abs(cur_l))) < (1 << 15)
+        assert int(jnp.max(jnp.abs(fe.stack(cur_l)))) < (1 << 15)
     check_all(cur_l, cur_i)
 
 

@@ -19,7 +19,8 @@ L, P = sc.L, fe.P
 
 
 def _stack_raw(vals, n):
-    return jnp.asarray(np.stack([sc._raw(v, n) for v in vals], axis=1))
+    arr = jnp.asarray(np.stack([sc._raw(v, n) for v in vals], axis=1))
+    return tuple(arr[i] for i in range(n))
 
 
 def test_reduce_512():
@@ -27,7 +28,7 @@ def test_reduce_512():
     while len(vals) < 24:
         vals.append(rng.randrange(0, 1 << 512))
     x = _stack_raw(vals, 40)
-    got = np.asarray(jax.jit(sc.reduce_512)(x))
+    got = np.asarray(jnp.stack(jax.jit(sc.reduce_512)(x)))
     for i, v in enumerate(vals):
         assert sc.from_limbs(got[:, i]) == v % L, i
 
@@ -35,7 +36,7 @@ def test_reduce_512():
 def test_neg_lt_bits():
     vals = [0, 1, L - 1, 2**252] + [rng.randrange(0, L) for _ in range(12)]
     h = _stack_raw(vals, 20)
-    got = np.asarray(sc.neg_mod_L(h))
+    got = np.asarray(jnp.stack(sc.neg_mod_L(h)))
     for i, v in enumerate(vals):
         assert sc.from_limbs(got[:, i]) == L - v, i  # -0 -> L by design
     # lt_L
@@ -56,15 +57,24 @@ def _pt_lanes(pts):
         zi = pow(p[2], P - 2, P)
         xs.append(p[0] * zi % P)
         ys.append(p[1] * zi % P)
-    X = jnp.asarray(np.stack([fe.to_limbs(x) for x in xs], axis=1))
-    Y = jnp.asarray(np.stack([fe.to_limbs(y) for y in ys], axis=1))
-    Z = jnp.broadcast_to(fe.const(1), X.shape)
+    X = fe.unstack(
+        jnp.asarray(np.stack([fe.to_limbs(x) for x in xs], axis=1))
+    )
+    Y = fe.unstack(
+        jnp.asarray(np.stack([fe.to_limbs(y) for y in ys], axis=1))
+    )
+    shape = jnp.shape(X[0])
+    Z = tuple(
+        jnp.full(shape, 1, jnp.int32) if i == 0
+        else jnp.zeros(shape, jnp.int32)
+        for i in range(fe.NLIMBS)
+    )
     T = fe.mul(X, Y)
     return (X, Y, Z, T)
 
 
 def _lanes_to_affine(pt):
-    X, Y, Z, _ = (np.asarray(c) for c in pt)
+    X, Y, Z, _ = (np.asarray(fe.stack(c)) for c in pt)
     out = []
     for i in range(X.shape[1]):
         zi = pow(fe.from_limbs(Z[:, i]), P - 2, P)

@@ -143,3 +143,69 @@ def test_statesync_failure_is_fatal():
         await node.stop()
 
     run(main())
+
+
+def test_bootstrap_state_offline(tmp_path):
+    """Offline statesync (reference node.BootstrapState): seed an empty
+    home's stores with light-verified state, then start the node and
+    watch it blocksync from that height instead of genesis."""
+    gen, pvs = make_genesis(N_VALS, chain_id="bs-chain")
+
+    async def main():
+        vals = []
+        for i, pv in enumerate(pvs):
+            cfg = make_test_cfg(".")
+            cfg.base.moniker = f"val{i}"
+            cfg.blocksync.enable = False
+            vals.append(Node(cfg, gen, privval=pv))
+        for n in vals:
+            await n.start()
+        for i, a in enumerate(vals):
+            for b in vals[i + 1:]:
+                await a.dial(b.listen_addr)
+        while vals[0].height < 8:
+            await asyncio.sleep(0.05)
+
+        from cometbft_tpu.node.bootstrap import bootstrap_state
+
+        trust = vals[0].parts.block_store.load_block(1)
+        cfg = make_test_cfg(str(tmp_path))
+        cfg.base.db_backend = "sqlite"  # must persist across processes
+        cfg.base.moniker = "bootstrapped"
+        cfg.statesync.rpc_servers = [vals[0].rpc_server.listen_addr]
+        cfg.statesync.trust_height = 1
+        cfg.statesync.trust_hash = bytes(trust.hash()).hex()
+        target_h = 5
+        h = await asyncio.to_thread(
+            bootstrap_state, cfg, gen, str(tmp_path), target_h
+        )
+        assert h == target_h
+        # re-running against the now-populated store must refuse
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="refusing"):
+            await asyncio.to_thread(
+                bootstrap_state, cfg, gen, str(tmp_path), target_h
+            )
+
+        # node starts from the bootstrapped state and catches up
+        cfg2 = make_test_cfg(str(tmp_path))
+        cfg2.base.db_backend = cfg.base.db_backend
+        cfg2.statesync.enable = False
+        cfg2.blocksync.enable = True
+        node = Node(cfg2, gen, privval=None, home=str(tmp_path))
+        await node.start()
+        for v in vals:
+            await node.dial(v.listen_addr)
+        target = vals[0].height + 2
+        for _ in range(600):
+            if node.height >= target:
+                break
+            await asyncio.sleep(0.1)
+        assert node.height >= target, f"stuck at {node.height}"
+        # blocks before the bootstrap height were never fetched
+        assert node.parts.block_store.load_block(2) is None
+        for n in vals + [node]:
+            await n.stop()
+
+    run(main())
