@@ -55,6 +55,22 @@ class NodeParts:
     evpool: object = None
     tx_indexer: object = None
     block_indexer: object = None
+    index_db: object = None
+
+    def close_stores(self) -> None:
+        """Release every store handle (the native logdb backend holds
+        an exclusive flock; sqlite keeps fds). Idempotent."""
+        for db in (self.index_db, self.block_db, self.state_db):
+            if db is not None:
+                try:
+                    db.close()
+                except Exception:
+                    pass
+        if hasattr(self.tx_indexer, "close"):
+            try:
+                self.tx_indexer.close()
+            except Exception:
+                pass
 
 
 def build_node(
@@ -113,7 +129,7 @@ def build_node(
     # "null"); the kv indexer runs as a sync event listener — nodes
     # that never serve tx_search should set "null" to keep the commit
     # path free of indexing work
-    tx_indexer = block_indexer = None
+    tx_indexer = block_indexer = index_db = None
     if config.tx_index.indexer == "kv":
         index_db = kv.open_kv(
             config.base.db_backend,
@@ -192,6 +208,7 @@ def build_node(
         evpool=evpool,
         tx_indexer=tx_indexer,
         block_indexer=block_indexer,
+        index_db=index_db,
     )
 
 

@@ -307,13 +307,10 @@ class Node:
         if self._cs_started:
             await self.parts.cs.stop()
         await self.switch.stop()
-        # flush + close the psql sink (its writer thread is a daemon:
-        # queued rows would be dropped on process exit otherwise)
-        if hasattr(self.parts.tx_indexer, "close"):
-            try:
-                await asyncio.to_thread(self.parts.tx_indexer.close)
-            except Exception:
-                traceback.print_exc()
+        # release store handles (psql sink flush+close; logdb flocks;
+        # sqlite fds) — a restart in the same process must be able to
+        # reopen every database
+        await asyncio.to_thread(self.parts.close_stores)
 
     # --- convenience --------------------------------------------------
 

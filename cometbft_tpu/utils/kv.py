@@ -127,4 +127,10 @@ def open_kv(backend: str, path: Optional[str] = None) -> KV:
     if backend == "sqlite":
         assert path
         return SqliteKV(path)
+    if backend == "logdb":
+        # native C++ log-structured engine (the reference's pebble role)
+        assert path
+        from .logdb import LogDB
+
+        return LogDB(path)
     raise ValueError(f"unknown db backend {backend}")

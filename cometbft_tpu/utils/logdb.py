@@ -1,0 +1,251 @@
+"""Native log-structured KV backend (ctypes binding for native/logdb.cpp).
+
+The reference's block/state stores sit on goleveldb or pebble — native
+LSM engines. This is the equivalent native component here: a C++
+append-log + ordered-index engine with CRC-framed records (torn tails
+truncate on replay), atomic batches, prefix iteration, and compaction.
+Built on demand with g++ into the package build dir; `open_kv` selects
+it via db_backend = "logdb".
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, Optional, Tuple
+
+from .kv import KV
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "logdb.cpp",
+)
+# build artifact lives OUTSIDE the source tree (read-only installs,
+# no risk of committing a platform binary); override with LOGDB_SO_DIR
+_SO = os.path.join(
+    os.environ.get(
+        "LOGDB_SO_DIR",
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "cometbft_tpu"
+        ),
+    ),
+    "liblogdb.so",
+)
+
+_lib = None
+_build_lock = threading.Lock()
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:  # pragma: no cover
+            return _lib
+        if (
+            not os.path.exists(_SO)
+            or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        ):
+            os.makedirs(os.path.dirname(_SO), exist_ok=True)
+            subprocess.run(
+                [
+                    "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                    _SRC, "-o", _SO,
+                ],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_SO)
+        lib.logdb_open.restype = ctypes.c_void_p
+        lib.logdb_open.argtypes = [ctypes.c_char_p]
+        lib.logdb_get.restype = ctypes.c_int
+        lib.logdb_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.logdb_put.restype = ctypes.c_int
+        lib.logdb_put.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.logdb_del.restype = ctypes.c_int
+        lib.logdb_del.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.logdb_batch.restype = ctypes.c_int
+        lib.logdb_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.logdb_iter_new.restype = ctypes.c_void_p
+        lib.logdb_iter_new.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.logdb_iter_next.restype = ctypes.c_int
+        lib.logdb_iter_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.logdb_iter_free.argtypes = [ctypes.c_void_p]
+        lib.logdb_compact.restype = ctypes.c_int64
+        lib.logdb_compact.argtypes = [ctypes.c_void_p]
+        lib.logdb_count.restype = ctypes.c_uint64
+        lib.logdb_count.argtypes = [ctypes.c_void_p]
+        lib.logdb_dead_bytes.restype = ctypes.c_uint64
+        lib.logdb_dead_bytes.argtypes = [ctypes.c_void_p]
+        lib.logdb_flush.argtypes = [ctypes.c_void_p]
+        lib.logdb_close.argtypes = [ctypes.c_void_p]
+        lib.logdb_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+# compact automatically once this much of the log is dead weight
+AUTO_COMPACT_DEAD_BYTES = 64 * 1024 * 1024
+
+
+class LogDB(KV):
+    """KV interface over the native engine (thread-safe: the engine
+    holds its own mutex; handles are guarded against double close)."""
+
+    def __init__(self, path: str):
+        lib = _load()
+        self._lib = lib
+        self._h = lib.logdb_open(path.encode())
+        if not self._h:
+            raise OSError(
+                f"logdb_open failed for {path} (locked by another "
+                "process, unreadable, or unwritable)"
+            )
+        self._closed = False
+        self._compacting = threading.Lock()
+
+    def _handle(self):
+        # every native call goes through here: a handle used after
+        # close() would dereference freed memory in C++ (segfault, not
+        # a Python exception)
+        if self._closed:
+            raise OSError("logdb handle is closed")
+        return self._h
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        outl = ctypes.c_uint32()
+        rc = self._lib.logdb_get(
+            self._handle(), bytes(key), len(key), ctypes.byref(out),
+            ctypes.byref(outl),
+        )
+        if rc == 1:
+            return None
+        if rc != 0:
+            raise OSError("logdb_get failed")
+        try:
+            return ctypes.string_at(out, outl.value)
+        finally:
+            self._lib.logdb_free(out)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        if self._lib.logdb_put(
+            self._handle(), bytes(key), len(key), bytes(value), len(value)
+        ) != 0:
+            raise OSError("logdb_put failed")
+
+    def delete(self, key: bytes) -> None:
+        if self._lib.logdb_del(self._handle(), bytes(key), len(key)) != 0:
+            raise OSError("logdb_del failed")
+
+    def write_batch(self, sets, deletes=()) -> None:
+        parts = []
+        sets = list(sets)
+        deletes = list(deletes)
+        parts.append(len(sets).to_bytes(4, "little"))
+        for k, v in sets:
+            k, v = bytes(k), bytes(v)
+            parts.append(len(k).to_bytes(4, "little"))
+            parts.append(len(v).to_bytes(4, "little"))
+            parts.append(k)
+            parts.append(v)
+        parts.append(len(deletes).to_bytes(4, "little"))
+        for k in deletes:
+            k = bytes(k)
+            parts.append(len(k).to_bytes(4, "little"))
+            parts.append(k)
+        buf = b"".join(parts)
+        if self._lib.logdb_batch(self._handle(), buf, len(buf)) != 0:
+            raise OSError("logdb_batch failed")
+        if (
+            self._lib.logdb_dead_bytes(self._h) > AUTO_COMPACT_DEAD_BYTES
+            and self._compacting.acquire(blocking=False)
+        ):
+            # off the commit path: the caller's batch has already
+            # committed; the rewrite happens on a background thread
+            # (native mutex still serializes concurrent ops with it)
+            def _bg():
+                try:
+                    if not self._closed:
+                        self.compact()
+                except OSError:
+                    pass
+                finally:
+                    self._compacting.release()
+
+            threading.Thread(
+                target=_bg, daemon=True, name="logdb-compact"
+            ).start()
+
+    def iter_prefix(self, prefix: bytes) -> Iterator[Tuple[bytes, bytes]]:
+        it = self._lib.logdb_iter_new(
+            self._handle(), bytes(prefix), len(prefix)
+        )
+        if not it:
+            raise OSError("logdb_iter_new failed")
+        try:
+            k = ctypes.POINTER(ctypes.c_uint8)()
+            v = ctypes.POINTER(ctypes.c_uint8)()
+            kl = ctypes.c_uint32()
+            vl = ctypes.c_uint32()
+            while (
+                self._lib.logdb_iter_next(
+                    it, ctypes.byref(k), ctypes.byref(kl),
+                    ctypes.byref(v), ctypes.byref(vl),
+                )
+                == 0
+            ):
+                yield (
+                    ctypes.string_at(k, kl.value),
+                    ctypes.string_at(v, vl.value),
+                )
+        finally:
+            self._lib.logdb_iter_free(it)
+
+    def compact(self) -> int:
+        freed = self._lib.logdb_compact(self._handle())
+        if freed < 0:
+            raise OSError("logdb_compact failed")
+        return int(freed)
+
+    def count(self) -> int:
+        return int(self._lib.logdb_count(self._handle()))
+
+    def flush(self) -> None:
+        self._lib.logdb_flush(self._handle())
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._lib.logdb_close(self._h)

@@ -1,0 +1,473 @@
+// logdb: append-only log-structured KV engine with an ordered in-memory
+// index. The native storage backend for cometbft_tpu (the role
+// goleveldb/pebble — both native LSM engines — play for the reference's
+// cometbft-db seam).
+//
+// Design:
+//   * One data file: a sequence of CRC-framed records
+//       [crc32(4) | klen(4) | vlen(4, 0xFFFFFFFF = tombstone) | key | value]
+//     appended on every set/delete. A batch is ONE record with the
+//     sentinel klen 0xFFFFFFFE framing its whole serialized payload, so
+//     replay applies a batch entirely or not at all — a torn tail fails
+//     the single CRC and truncates (the crash-atomicity the reference
+//     gets from its LSM engines' WAL).
+//   * The file is flock()ed exclusively on open: a second process gets
+//     a clean failure instead of silently corrupting offsets.
+//   * Index: std::map<key, (offset, vlen)> rebuilt by replaying the log
+//     on open; ordered, so prefix iteration is a lower_bound walk.
+//   * Compaction rewrites live records to <path>.compact and renames it
+//     into place (crash-safe: rename is atomic).
+//
+// Exposed as a C ABI for the Python ctypes binding
+// (cometbft_tpu/utils/logdb.py). No exceptions across the boundary.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/file.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+uint32_t crc_table[256];
+struct CrcInit {
+  CrcInit() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int j = 0; j < 8; j++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      crc_table[i] = c;
+    }
+  }
+} crc_init;
+
+uint32_t crc32(const uint8_t* p, size_t n, uint32_t seed = 0) {
+  uint32_t c = seed ^ 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++)
+    c = crc_table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+constexpr uint32_t kTombstone = 0xFFFFFFFFu;
+constexpr uint32_t kBatchMark = 0xFFFFFFFEu;
+
+struct Entry {
+  uint64_t offset;  // file offset of the VALUE bytes
+  uint32_t vlen;
+};
+
+struct DB {
+  std::mutex mu;
+  std::string path;
+  int fd = -1;
+  uint64_t end = 0;  // append position
+  std::map<std::string, Entry> index;
+  uint64_t dead = 0;  // bytes of overwritten/tombstoned records
+
+  int replay();
+  int append_record(const std::string& k, const uint8_t* v, uint32_t vl,
+                    bool flush);
+  void index_op(const std::string& key, uint64_t voff, uint32_t vlen);
+};
+
+int write_all(int fd, const uint8_t* p, size_t n) {
+  while (n) {
+    ssize_t w = ::write(fd, p, n);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return 0;
+}
+
+void DB::index_op(const std::string& key, uint64_t voff, uint32_t vlen) {
+  auto it = index.find(key);
+  if (it != index.end())
+    dead += 12 + key.size() + (it->second.vlen ? it->second.vlen : 0);
+  if (vlen == kTombstone) {
+    if (it != index.end()) index.erase(it);
+    dead += 12 + key.size();
+  } else {
+    index[key] = Entry{voff, vlen};
+  }
+}
+
+int DB::replay() {
+  struct stat st;
+  if (fstat(fd, &st) != 0) return -1;
+  uint64_t size = static_cast<uint64_t>(st.st_size);
+  const uint8_t* buf = nullptr;
+  void* mapped = nullptr;
+  if (size) {
+    // mmap instead of a full-file heap buffer: O(page cache) replay
+    // and no bad_alloc escaping the C ABI on multi-GB logs
+    mapped = mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0);
+    if (mapped == MAP_FAILED) return -1;
+    buf = static_cast<const uint8_t*>(mapped);
+  }
+  uint64_t pos = 0;
+  while (pos + 12 <= size) {
+    uint32_t crc, klen, vlen;
+    memcpy(&crc, &buf[pos], 4);
+    memcpy(&klen, &buf[pos + 4], 4);
+    memcpy(&vlen, &buf[pos + 8], 4);
+    if (klen == kBatchMark) {
+      // one whole batch framed by a single CRC: vlen = payload length
+      if (vlen > (512u << 20) || pos + 12 + vlen > size) break;
+      if (crc32(&buf[pos + 4], 8 + vlen) != crc) break;
+      const uint8_t* p = &buf[pos + 12];
+      uint64_t off = pos + 12, bp = 0;
+      bool ok = true;
+      uint32_t nsets, ndels;
+      auto rd32 = [&](uint32_t* v) {
+        if (bp + 4 > vlen) return false;
+        memcpy(v, p + bp, 4);
+        bp += 4;
+        return true;
+      };
+      std::vector<std::tuple<std::string, uint64_t, uint32_t>> ops;
+      if (!rd32(&nsets)) break;
+      for (uint32_t i = 0; ok && i < nsets; i++) {
+        uint32_t kl, vl;
+        if (!rd32(&kl) || !rd32(&vl) ||
+            bp + kl + static_cast<uint64_t>(vl) > vlen) { ok = false; break; }
+        ops.emplace_back(std::string(reinterpret_cast<const char*>(p + bp), kl),
+                         off + bp + kl, vl);
+        bp += kl + static_cast<uint64_t>(vl);
+      }
+      if (ok && rd32(&ndels)) {
+        for (uint32_t i = 0; ok && i < ndels; i++) {
+          uint32_t kl;
+          if (!rd32(&kl) || bp + kl > vlen) { ok = false; break; }
+          ops.emplace_back(std::string(reinterpret_cast<const char*>(p + bp), kl),
+                           0, kTombstone);
+          bp += kl;
+        }
+      } else {
+        ok = false;
+      }
+      if (!ok) break;  // malformed payload inside a valid CRC: stop
+      for (auto& [key, voff, vl] : ops) index_op(key, voff, vl);
+      pos += 12 + vlen;
+      continue;
+    }
+    uint64_t body = static_cast<uint64_t>(klen) +
+                    (vlen == kTombstone ? 0 : vlen);
+    if (klen > (64u << 20) || (vlen != kTombstone && vlen > (256u << 20)) ||
+        pos + 12 + body > size)
+      break;  // torn/garbage tail
+    uint32_t got = crc32(&buf[pos + 4], 8 + body);
+    if (got != crc) break;  // torn write: truncate here
+    std::string key(reinterpret_cast<const char*>(&buf[pos + 12]), klen);
+    index_op(key, pos + 12 + klen, vlen);
+    pos += 12 + body;
+  }
+  if (mapped) munmap(mapped, size);
+  // truncate any torn tail so future appends start from a clean point
+  if (pos != size) {
+    if (ftruncate(fd, static_cast<off_t>(pos)) != 0) return -1;
+  }
+  end = pos;
+  return 0;
+}
+
+int DB::append_record(const std::string& k, const uint8_t* v, uint32_t vl,
+                      bool flush) {
+  bool tomb = (v == nullptr);
+  uint32_t klen = static_cast<uint32_t>(k.size());
+  uint32_t vlen = tomb ? kTombstone : vl;
+  uint64_t body = klen + (tomb ? 0 : vl);
+  std::vector<uint8_t> rec(12 + body);
+  memcpy(&rec[4], &klen, 4);
+  memcpy(&rec[8], &vlen, 4);
+  memcpy(&rec[12], k.data(), klen);
+  if (!tomb && vl) memcpy(&rec[12 + klen], v, vl);
+  uint32_t crc = crc32(&rec[4], 8 + body);
+  memcpy(&rec[0], &crc, 4);
+  if (write_all(fd, rec.data(), rec.size()) != 0) return -1;
+  auto it = index.find(k);
+  if (it != index.end())
+    dead += 12 + klen + (it->second.vlen ? it->second.vlen : 0);
+  if (tomb) {
+    if (it != index.end()) index.erase(it);
+    dead += 12 + klen;
+  } else {
+    index[k] = Entry{end + 12 + klen, vl};
+  }
+  end += rec.size();
+  if (flush) {
+    // data integrity relies on record CRCs; fdatasync on every write
+    // would serialize the commit path, so flush batches only
+#ifdef __APPLE__
+    fsync(fd);
+#else
+    fdatasync(fd);
+#endif
+  }
+  return 0;
+}
+
+struct Iter {
+  std::vector<std::pair<std::string, std::string>> items;  // snapshot
+  size_t pos = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* logdb_open(const char* path) {
+  DB* db = new DB();
+  db->path = path;
+  db->fd = ::open(path, O_RDWR | O_CREAT | O_APPEND, 0644);
+  if (db->fd < 0) {
+    delete db;
+    return nullptr;
+  }
+  if (flock(db->fd, LOCK_EX | LOCK_NB) != 0) {
+    // another process owns this log; silent double-writers would
+    // desync offsets undetectably (reads are not CRC-verified)
+    ::close(db->fd);
+    delete db;
+    return nullptr;
+  }
+  if (db->replay() != 0) {
+    ::close(db->fd);
+    delete db;
+    return nullptr;
+  }
+  return db;
+}
+
+// 0 = found (out malloc'd), 1 = missing, -1 = io error
+int logdb_get(void* h, const uint8_t* k, uint32_t kl, uint8_t** out,
+              uint32_t* outl) {
+  DB* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  auto it = db->index.find(std::string(reinterpret_cast<const char*>(k), kl));
+  if (it == db->index.end()) return 1;
+  uint32_t vl = it->second.vlen;
+  uint8_t* buf = static_cast<uint8_t*>(malloc(vl ? vl : 1));
+  if (vl) {
+    ssize_t r = pread(db->fd, buf, vl, static_cast<off_t>(it->second.offset));
+    if (r < 0 || static_cast<uint32_t>(r) != vl) {
+      free(buf);
+      return -1;
+    }
+  }
+  *out = buf;
+  *outl = vl;
+  return 0;
+}
+
+int logdb_put(void* h, const uint8_t* k, uint32_t kl, const uint8_t* v,
+              uint32_t vl) {
+  DB* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  return db->append_record(
+      std::string(reinterpret_cast<const char*>(k), kl), v ? v : (const uint8_t*)"", vl,
+      false);
+}
+
+int logdb_del(void* h, const uint8_t* k, uint32_t kl) {
+  DB* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  return db->append_record(
+      std::string(reinterpret_cast<const char*>(k), kl), nullptr, 0, false);
+}
+
+// batch buffer: [nsets(4)] then per set [klen(4) vlen(4) key value],
+// [ndels(4)] then per del [klen(4) key]. Appended as ONE CRC-framed
+// record (sentinel klen kBatchMark) so a crash applies all or nothing.
+int logdb_batch(void* h, const uint8_t* buf, uint64_t len) {
+  DB* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  if (len > (512u << 20)) return -2;
+  // validate + collect index ops relative to the payload start
+  uint64_t pos = 0;
+  auto rd32 = [&](uint32_t* v) -> bool {
+    if (pos + 4 > len) return false;
+    memcpy(v, buf + pos, 4);
+    pos += 4;
+    return true;
+  };
+  std::vector<std::tuple<std::string, uint64_t, uint32_t>> ops;
+  uint32_t nsets;
+  if (!rd32(&nsets)) return -2;
+  for (uint32_t i = 0; i < nsets; i++) {
+    uint32_t kl, vl;
+    if (!rd32(&kl) || !rd32(&vl)) return -2;
+    if (pos + kl + static_cast<uint64_t>(vl) > len) return -2;
+    ops.emplace_back(std::string(reinterpret_cast<const char*>(buf + pos), kl),
+                     pos + kl, vl);
+    pos += kl + static_cast<uint64_t>(vl);
+  }
+  uint32_t ndels;
+  if (!rd32(&ndels)) return -2;
+  for (uint32_t i = 0; i < ndels; i++) {
+    uint32_t kl;
+    if (!rd32(&kl)) return -2;
+    if (pos + kl > len) return -2;
+    ops.emplace_back(std::string(reinterpret_cast<const char*>(buf + pos), kl),
+                     0, kTombstone);
+    pos += kl;
+  }
+  // frame: [crc | kBatchMark | len | payload]
+  std::vector<uint8_t> hdr(12);
+  uint32_t plen = static_cast<uint32_t>(len);
+  memcpy(&hdr[4], &kBatchMark, 4);
+  memcpy(&hdr[8], &plen, 4);
+  uint32_t crc = crc32(&hdr[4], 8);
+  crc = crc32(buf, len, crc) ;
+  memcpy(&hdr[0], &crc, 4);
+  if (write_all(db->fd, hdr.data(), hdr.size()) != 0) return -1;
+  if (write_all(db->fd, buf, len) != 0) return -1;
+  uint64_t payload_base = db->end + 12;
+  for (auto& [key, rel, vl] : ops)
+    db->index_op(key, vl == kTombstone ? 0 : payload_base + rel, vl);
+  db->end += 12 + len;
+#ifdef __APPLE__
+  fsync(db->fd);
+#else
+  fdatasync(db->fd);
+#endif
+  return 0;
+}
+
+void* logdb_iter_new(void* h, const uint8_t* prefix, uint32_t pl) {
+  DB* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  std::string pre(reinterpret_cast<const char*>(prefix), pl);
+  Iter* it = new Iter();
+  for (auto mi = db->index.lower_bound(pre); mi != db->index.end(); ++mi) {
+    if (mi->first.compare(0, pre.size(), pre) != 0) break;
+    std::string val;
+    val.resize(mi->second.vlen);
+    if (mi->second.vlen) {
+      ssize_t r = pread(db->fd, &val[0], mi->second.vlen,
+                        static_cast<off_t>(mi->second.offset));
+      if (r < 0 || static_cast<uint32_t>(r) != mi->second.vlen) {
+        delete it;
+        return nullptr;
+      }
+    }
+    it->items.emplace_back(mi->first, std::move(val));
+  }
+  return it;
+}
+
+int logdb_iter_next(void* hi, const uint8_t** k, uint32_t* kl,
+                    const uint8_t** v, uint32_t* vl) {
+  Iter* it = static_cast<Iter*>(hi);
+  if (it->pos >= it->items.size()) return 1;
+  auto& kv = it->items[it->pos++];
+  *k = reinterpret_cast<const uint8_t*>(kv.first.data());
+  *kl = static_cast<uint32_t>(kv.first.size());
+  *v = reinterpret_cast<const uint8_t*>(kv.second.data());
+  *vl = static_cast<uint32_t>(kv.second.size());
+  return 0;
+}
+
+void logdb_iter_free(void* hi) { delete static_cast<Iter*>(hi); }
+
+// rewrite live records; atomic rename. Returns reclaimed bytes or <0.
+int64_t logdb_compact(void* h) {
+  DB* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  std::string tmp = db->path + ".compact";
+  int nfd = ::open(tmp.c_str(), O_RDWR | O_CREAT | O_TRUNC | O_APPEND, 0644);
+  if (nfd < 0) return -1;
+  uint64_t old_end = db->end;
+  std::map<std::string, Entry> nindex;
+  uint64_t nend = 0;
+  for (auto& [key, e] : db->index) {
+    std::vector<uint8_t> rec(12 + key.size() + e.vlen);
+    uint32_t klen = static_cast<uint32_t>(key.size());
+    memcpy(&rec[4], &klen, 4);
+    memcpy(&rec[8], &e.vlen, 4);
+    memcpy(&rec[12], key.data(), klen);
+    if (e.vlen) {
+      ssize_t r = pread(db->fd, &rec[12 + klen], e.vlen,
+                        static_cast<off_t>(e.offset));
+      if (r < 0 || static_cast<uint32_t>(r) != e.vlen) {
+        ::close(nfd);
+        unlink(tmp.c_str());
+        return -1;
+      }
+    }
+    uint32_t crc = crc32(&rec[4], rec.size() - 4);
+    memcpy(&rec[0], &crc, 4);
+    if (write_all(nfd, rec.data(), rec.size()) != 0) {
+      ::close(nfd);
+      unlink(tmp.c_str());
+      return -1;
+    }
+    nindex[key] = Entry{nend + 12 + klen, e.vlen};
+    nend += rec.size();
+  }
+  fsync(nfd);
+  if (rename(tmp.c_str(), db->path.c_str()) != 0) {
+    ::close(nfd);
+    unlink(tmp.c_str());
+    return -1;
+  }
+  ::close(db->fd);
+  db->fd = nfd;
+  db->index = std::move(nindex);
+  db->end = nend;
+  db->dead = 0;
+  return static_cast<int64_t>(old_end - nend);
+}
+
+uint64_t logdb_count(void* h) {
+  DB* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  return db->index.size();
+}
+
+uint64_t logdb_dead_bytes(void* h) {
+  DB* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  return db->dead;
+}
+
+void logdb_flush(void* h) {
+  DB* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+#ifdef __APPLE__
+  fsync(db->fd);
+#else
+  fdatasync(db->fd);
+#endif
+}
+
+void logdb_close(void* h) {
+  DB* db = static_cast<DB*>(h);
+  {
+    std::lock_guard<std::mutex> g(db->mu);
+#ifdef __APPLE__
+    fsync(db->fd);
+#else
+    fdatasync(db->fd);
+#endif
+    ::close(db->fd);
+  }
+  delete db;
+}
+
+void logdb_free(void* p) { free(p); }
+
+}  // extern "C"
