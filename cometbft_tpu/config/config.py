@@ -46,6 +46,9 @@ class RPCConfig:
     max_open_connections: int = 900
     max_subscription_clients: int = 100
     timeout_broadcast_tx_commit_s: float = 10.0
+    # expose the unsafe route set (reference --rpc.unsafe: dial_seeds,
+    # dial_peers, unsafe_flush_mempool); never enable on public nodes
+    unsafe: bool = False
 
 
 @dataclass

@@ -18,6 +18,8 @@ TOML schema:
     pause_at = 0              # height to SIGSTOP for pause_s seconds
     pause_s = 3.0
     restart_delay_s = 2.0
+    disconnect_at = 0         # height to drop all peers, reconnect after
+    disconnect_s = 3.0        # how long to stay disconnected
 """
 
 from __future__ import annotations
@@ -29,10 +31,11 @@ from typing import Dict, List, Optional
 
 @dataclass
 class Perturbation:
-    kind: str  # "kill" | "pause"
+    kind: str  # "kill" | "pause" | "disconnect"
     height: int
     pause_s: float = 3.0
     restart_delay_s: float = 2.0
+    disconnect_s: float = 3.0
 
 
 @dataclass
@@ -95,6 +98,14 @@ class Manifest:
                         "pause",
                         int(nd["pause_at"]),
                         pause_s=float(nd.get("pause_s", 3.0)),
+                    )
+                )
+            if nd.get("disconnect_at"):
+                spec.perturbations.append(
+                    Perturbation(
+                        "disconnect",
+                        int(nd["disconnect_at"]),
+                        disconnect_s=float(nd.get("disconnect_s", 3.0)),
                     )
                 )
             m.nodes[name] = spec

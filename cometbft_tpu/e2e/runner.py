@@ -90,6 +90,7 @@ class Runner:
             cfg.base.moniker = name
             cfg.p2p.laddr = f"tcp://127.0.0.1:{rn.p2p_port}"
             cfg.rpc.laddr = f"tcp://127.0.0.1:{rn.rpc_port}"
+            cfg.rpc.unsafe = True  # perturbations use the unsafe routes
             cfg.p2p.persistent_peers = ",".join(
                 p for p in peers.split(",")
                 if not p.startswith(rn.node_id)
@@ -132,6 +133,14 @@ class Runner:
             start_new_session=True,
         )
         rn.started = True
+
+    def _peer_addrs(self, rn: RunnerNode) -> list:
+        """Other nodes' id@host:port addresses (reconnect targets)."""
+        return [
+            f"{other.node_id}@127.0.0.1:{other.p2p_port}"
+            for name, other in self.nodes.items()
+            if other is not rn and other.started
+        ]
 
     def _rpc(self, rn: RunnerNode, path: str, timeout: float = 3.0):
         with urllib.request.urlopen(
@@ -314,6 +323,29 @@ class Runner:
                 await asyncio.sleep(pert.pause_s)
                 print(f"[perturb] SIGCONT {rn.spec.name}", flush=True)
                 rn.proc.send_signal(signal.SIGCONT)
+            elif pert.kind == "disconnect":
+                # drop all peers via the unsafe RPC (reference does
+                # this at the docker network layer); reconnect by
+                # dialing the net's persistent peers again
+                print(f"[perturb] disconnect {rn.spec.name}", flush=True)
+                try:
+                    await asyncio.to_thread(
+                        self._rpc, rn, "unsafe_disconnect_peers"
+                    )
+                except Exception as e:
+                    print(f"[perturb] disconnect failed: {e}", flush=True)
+                    continue
+                await asyncio.sleep(pert.disconnect_s)
+                peers = ",".join(
+                    f'"{p}"' for p in self._peer_addrs(rn)
+                )
+                print(f"[perturb] reconnect {rn.spec.name}", flush=True)
+                try:
+                    await asyncio.to_thread(
+                        self._rpc, rn, f"dial_peers?peers=[{peers}]"
+                    )
+                except Exception as e:
+                    print(f"[perturb] reconnect failed: {e}", flush=True)
 
     # --- assertions ---------------------------------------------------
 

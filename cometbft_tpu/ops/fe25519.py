@@ -234,8 +234,8 @@ def mul(a, b):
 
 
 def square(a):
-    """Field square via the symmetric convolution (inputs carried)."""
-    return _reduce_41(_conv_sqr(a))
+    """Field square (A/B: general conv)."""
+    return _reduce_41(_conv_mul(a, a))
 
 
 def mul_scalar(a, k: int):

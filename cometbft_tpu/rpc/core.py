@@ -578,6 +578,70 @@ def block_search(env, query="", page=1, per_page=30, order_by="asc"):
 
 # --- route table --------------------------------------------------------
 
+# --- unsafe routes (reference rpc/core/routes.go AddUnsafeRoutes:
+# dial_seeds, dial_peers, unsafe_flush_mempool; registered only when
+# config.rpc.unsafe) ------------------------------------------------------
+
+
+def _addr_list(v) -> list:
+    """Accept a JSON array (POST) or the URI forms '["a","b"]' /
+    'a,b' (GET params arrive as plain strings)."""
+    if v is None:
+        return []
+    if isinstance(v, str):
+        s = v.strip()
+        if s.startswith("["):
+            import json as _json
+
+            return [str(x) for x in _json.loads(s)]
+        return [a.strip() for a in s.split(",") if a.strip()]
+    return [str(x) for x in v]
+
+
+def dial_seeds(env, seeds=None) -> Dict[str, Any]:
+    if not env.switch:
+        raise RPCError(-32603, "p2p switch not available")
+    addrs = _addr_list(seeds)
+    env.switch.dial_peers_async(addrs, persistent=False)
+    return {"log": f"dialing seeds: {addrs}"}
+
+
+def dial_peers(env, peers=None, persistent=None) -> Dict[str, Any]:
+    if not env.switch:
+        raise RPCError(-32603, "p2p switch not available")
+    addrs = _addr_list(peers)
+    env.switch.dial_peers_async(
+        addrs, persistent=str(persistent).lower() in ("true", "1")
+    )
+    return {"log": f"dialing peers: {addrs}"}
+
+
+def unsafe_flush_mempool(env) -> Dict[str, Any]:
+    env.mempool.flush()
+    return {}
+
+
+def unsafe_disconnect_peers(env) -> Dict[str, Any]:
+    """Drop every peer connection (e2e 'disconnect' perturbation; the
+    reference does this at the docker network layer)."""
+    import asyncio as _a
+
+    sw = env.switch
+    if not sw:
+        raise RPCError(-32603, "p2p switch not available")
+    peers = list(sw.peers.values())
+    for p in peers:
+        _a.ensure_future(sw._remove_peer(p, None))
+    return {"log": f"disconnected {len(peers)} peers"}
+
+
+UNSAFE_ROUTES = {
+    "dial_seeds": dial_seeds,
+    "dial_peers": dial_peers,
+    "unsafe_flush_mempool": unsafe_flush_mempool,
+    "unsafe_disconnect_peers": unsafe_disconnect_peers,
+}
+
 ROUTES = {
     "health": health,
     "status": status,

@@ -102,6 +102,10 @@ class RPCServer:
         h, p = srv_sockets[0].getsockname()[:2]
         self.listen_addr = f"{h}:{p}"
 
+    def _unsafe_enabled(self) -> bool:
+        cfg = getattr(self.env, "config", None)
+        return bool(cfg and getattr(cfg.rpc, "unsafe", False))
+
     async def stop(self) -> None:
         if self._runner:
             await self._runner.cleanup()
@@ -110,6 +114,8 @@ class RPCServer:
 
     async def _call(self, method: str, params: Dict[str, Any]):
         fn = core.ROUTES.get(method)
+        if fn is None and self._unsafe_enabled():
+            fn = core.UNSAFE_ROUTES.get(method)
         if fn is None:
             raise core.RPCError(-32601, f"method {method!r} not found")
         res = fn(self.env, **params)

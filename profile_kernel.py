@@ -94,40 +94,49 @@ def main():
     q = rand_pt()
 
     def chain_double(x, y, z, t):
-        p = (x, y, z, t)
+        p = tuple(fe.unstack(c) for c in (x, y, z, t))
         for _ in range(16):
             p = curve.double(p)
-        return p
+        return fe.stack(p[0])
 
     timeit("double x16", chain_double, *q, inner=16)
 
-    cq = tuple(rand_fe() for _ in range(4))
+    cq_arr = tuple(rand_fe() for _ in range(4))
 
     def chain_add(x, y, z, t):
-        p = (x, y, z, t)
+        p = tuple(fe.unstack(c) for c in (x, y, z, t))
+        cq = tuple(fe.unstack(c) for c in cq_arr)
         for _ in range(16):
             p = curve.add_cached(p, cq)
-        return p
+        return fe.stack(p[0])
 
     timeit("add_cached x16", chain_add, *q, inner=16)
 
     def chain_mul(a, b):
-        x = a
+        x, y = fe.unstack(a), fe.unstack(b)
         for _ in range(16):
-            x = fe.mul(x, b)
-        return x
+            x = fe.mul(x, y)
+        return fe.stack(x)
 
     timeit("fe.mul x16", chain_mul, rand_fe(), rand_fe(), inner=16)
 
+    def chain_sqr(a, b):
+        x = fe.unstack(a)
+        for _ in range(16):
+            x = fe.square(x)
+        return fe.stack(x)
+
+    timeit("fe.square x16", chain_sqr, rand_fe(), rand_fe(), inner=16)
+
     # table build: 15 adds + to_cached
     def table_build(x, y, z, t):
-        A = (x, y, z, t)
+        A = tuple(fe.unstack(c) for c in (x, y, z, t))
         ext = curve.identity(x.shape[1:])
         outs = [curve.to_cached(ext)]
         for _ in range(15):
             ext = curve.add(ext, A)
             outs.append(curve.to_cached(ext))
-        return outs[-1]
+        return fe.stack(outs[-1][0])
 
     timeit("A-table build (15 adds)", table_build, *q)
 
@@ -175,7 +184,7 @@ def main():
 
     def do_dec(p):
         A, ok = curve.decompress(p)
-        return A[0]
+        return fe.stack(A[0])
 
     comp = jax.jit(do_dec).lower(pk).compile()
     out = np.asarray(comp(pk))

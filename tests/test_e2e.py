@@ -18,7 +18,11 @@ MANIFEST = {
         "val0": {"mode": "validator"},
         "val1": {"mode": "validator", "kill_at": 5},
         "val2": {"mode": "validator", "pause_at": 4, "pause_s": 2.0},
-        "val3": {"mode": "validator"},
+        "val3": {
+            "mode": "validator",
+            "disconnect_at": 6,
+            "disconnect_s": 2.0,
+        },
         "full0": {
             "mode": "full",
             "start_at": 6,

@@ -128,3 +128,33 @@ def test_misc_routes():
         await node.stop()
 
     run(main())
+
+
+def test_unsafe_routes_gated_and_functional():
+    """dial_peers/unsafe_flush_mempool exist only with rpc.unsafe
+    (reference --rpc.unsafe AddUnsafeRoutes)."""
+
+    async def main():
+        node, cli = await _single_node()
+        # default: unsafe routes hidden
+        with pytest.raises(RPCClientError, match="not found"):
+            await cli.call("unsafe_flush_mempool")
+        # flip the gate (config object is live)
+        node.config.rpc.unsafe = True
+        await cli.call("broadcast_tx_sync", tx="0x" + b"u=1".hex())
+        n0 = int(
+            (await cli.call("num_unconfirmed_txs")).get("total", "0")
+        )
+        await cli.call("unsafe_flush_mempool")
+        n1 = int(
+            (await cli.call("num_unconfirmed_txs")).get("total", "0")
+        )
+        assert n1 == 0 <= n0
+        res = await cli.call("unsafe_disconnect_peers")
+        assert "disconnected" in res["log"]
+        res = await cli.call("dial_peers", peers=[])
+        assert "dialing" in res["log"]
+        await cli.close()
+        await node.stop()
+
+    run(main())
