@@ -243,14 +243,18 @@ class GRPCClient:
     def close(self) -> None:
         self._chan.close()
 
-    def _call(self, kind: int, req=None):
-        raw = self._callable(codec.encode_request(kind, req))
+    @staticmethod
+    def _decode(kind: int, raw: bytes):
         got, resp = codec.decode_response(raw)
         if got != kind:
             raise RuntimeError(
                 f"abci response kind {got} != request {kind}"
             )
         return resp
+
+    def _call(self, kind: int, req=None):
+        raw = self._callable(codec.encode_request(kind, req))
+        return self._decode(kind, raw)
 
     def echo(self, msg: str) -> str:
         return self._call(codec.ECHO, msg)
@@ -286,11 +290,29 @@ class GRPCClient:
         return self._call(codec.CHECK_TX, req)
 
     def check_tx_async(self, req) -> Future:
+        """Pipelined CheckTx: the grpc future API keeps the caller (the
+        node's event loop) off the round-trip, matching SocketClient's
+        async semantics."""
         fut: Future = Future()
         try:
-            fut.set_result(self.check_tx(req))
-        except Exception as e:
-            fut.set_exception(e)
+            rpc = self._callable.future(
+                codec.encode_request(codec.CHECK_TX, req)
+            )
+        except Exception:
+            # channel impls without the future API degrade to blocking
+            try:
+                fut.set_result(self.check_tx(req))
+            except Exception as e:
+                fut.set_exception(e)
+            return fut
+
+        def _done(f):
+            try:
+                fut.set_result(self._decode(codec.CHECK_TX, f.result()))
+            except Exception as e:
+                fut.set_exception(e)
+
+        rpc.add_done_callback(_done)
         return fut
 
     def insert_tx(self, tx: bytes) -> bool:

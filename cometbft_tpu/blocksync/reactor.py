@@ -23,7 +23,10 @@ from typing import Callable, Optional
 from .. import types as T
 from ..types.validation import verify_commits_coalesced
 from ..utils import codec
+from ..utils.log import get_logger
 from .pool import BlockPool
+
+_log = get_logger("blocksync")
 
 VERIFY_WINDOW = 32
 SWITCH_TO_CONSENSUS_INTERVAL_S = 1.0
@@ -85,6 +88,11 @@ class BlockSyncReactor:
                     self.local_blocks_chain is not None
                     and self.local_blocks_chain(self.state)
                 ):
+                    _log.info(
+                        "caught up, leaving blocksync",
+                        height=self.state.last_block_height,
+                        applied=self.blocks_applied,
+                    )
                     if self.on_caught_up:
                         self.on_caught_up(self.state)
                     return
@@ -158,6 +166,12 @@ class BlockSyncReactor:
                 # the expected BlockID) OR a corrupt h+1.LastCommit ->
                 # ban BOTH senders and refetch, like the reference's
                 # handleValidationFailure (blocksync/reactor.go:749).
+                _log.error(
+                    "commit verification failed, refetching",
+                    height=h,
+                    peer=str(peer)[:12],
+                    err=repr(errors[i]),
+                )
                 self.pool.redo_request(h, peer)
                 if window[i + 1][2] != peer:
                     self.pool.redo_request(h + 1, window[i + 1][2])

@@ -99,6 +99,14 @@ def cmd_start(args) -> int:
     home = _home(args)
     p = _paths(home)
     cfg = _load_config(home)
+    # config-selectable level, e.g. "info" or "consensus:debug,*:info"
+    # (reference libs/log + config log_level)
+    try:
+        from ..utils.log import set_level
+
+        set_level(cfg.base.log_level)
+    except ValueError:
+        print(f"invalid log_level {cfg.base.log_level!r}; using info")
     with open(p["genesis"]) as f:
         gen = GenesisDoc.from_json(f.read())
     if cfg.base.priv_validator_laddr:

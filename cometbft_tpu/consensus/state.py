@@ -27,8 +27,11 @@ from ..state.state_types import State
 from ..types import events as ev
 from ..utils import codec
 from ..utils.fail import fail_point
+from ..utils.log import Lazy, get_logger
 from . import wal as walmod
 from .types import HeightVoteSet, RoundState, Step
+
+_log = get_logger("consensus")
 
 
 @dataclass
@@ -185,7 +188,13 @@ class ConsensusState:
                     self._handle_msg(kind, payload, peer_id)
             except asyncio.CancelledError:
                 raise
-            except Exception:
+            except Exception as e:
+                _log.error(
+                    "receive routine error",
+                    height=self.rs.height,
+                    kind=item[0] if item else "?",
+                    err=repr(e),
+                )
                 traceback.print_exc()
 
     def _handle_msg(self, kind: str, payload, peer_id: str) -> None:
@@ -289,6 +298,13 @@ class ConsensusState:
             self.state, bid, block
         )
         fail_point("cs-after-apply")  # :1837
+        _log.info(
+            "finalized block",
+            height=height,
+            hash=Lazy(lambda: block.hash()[:8].hex()),
+            txs=len(block.data.txs),
+            app_hash=Lazy(lambda: new_state.app_hash[:8].hex()),
+        )
         self.decided_heights += 1
         if self.on_decided:
             try:
@@ -390,6 +406,12 @@ class ConsensusState:
             replaying = list(
                 walmod.WAL.iter_messages(path)
             )
+        if replaying:
+            _log.info(
+                "replaying WAL messages for current height",
+                height=self.rs.height,
+                count=len(replaying),
+            )
         for m in replaying:
             try:
                 self._replay_one(m)
@@ -439,6 +461,7 @@ class ConsensusState:
             vals = rs.validators.copy()
             vals.increment_proposer_priority(round_ - rs.round)
             rs.validators = vals
+        _log.debug("entering new round", height=height, round=round_)
         rs.round = round_
         rs.step = Step.NEW_ROUND
         if round_ > 0:
@@ -461,6 +484,7 @@ class ConsensusState:
             rs.round == round_ and rs.step >= Step.PROPOSE
         ):
             return
+        _log.debug("entering propose step", height=height, round=round_)
         rs.step = Step.PROPOSE
         self._new_step()
         self._schedule_timeout(
@@ -513,6 +537,13 @@ class ConsensusState:
                 traceback.print_exc()
                 return
         bid = T.BlockID(block.hash(), parts.header)
+        _log.info(
+            "proposing block",
+            height=height,
+            round=round_,
+            hash=Lazy(lambda: block.hash()[:8].hex()),
+            txs=len(block.data.txs),
+        )
         prop = T.Proposal(
             height=height,
             round=round_,
@@ -614,6 +645,7 @@ class ConsensusState:
             rs.round == round_ and rs.step >= Step.PREVOTE
         ):
             return
+        _log.debug("entering prevote step", height=height, round=round_)
         rs.step = Step.PREVOTE
         self._new_step()
         self._do_prevote(height, round_)
@@ -671,6 +703,7 @@ class ConsensusState:
             rs.round == round_ and rs.step >= Step.PRECOMMIT
         ):
             return
+        _log.debug("entering precommit step", height=height, round=round_)
         rs.step = Step.PRECOMMIT
         self._new_step()
         prevotes = rs.votes.prevotes(round_)
@@ -736,6 +769,9 @@ class ConsensusState:
         rs = self.rs
         if rs.height != height or rs.step >= Step.COMMIT:
             return
+        _log.debug(
+            "entering commit step", height=height, round=commit_round
+        )
         rs.step = Step.COMMIT
         rs.commit_round = commit_round
         rs.commit_time_ns = time.time_ns()
@@ -941,12 +977,26 @@ class ConsensusState:
                         rs.validators.total_voting_power(),
                         time.time_ns(),
                     )
+                    _log.info(
+                        "found conflicting vote, adding evidence",
+                        height=vote.height,
+                        round=vote.round,
+                        validator=vote.validator_address.hex()[:12],
+                    )
                     try:
                         self.evpool.add_evidence(evd)
                     except Exception:
                         pass
             return
-        except Exception:
+        except Exception as e:
+            _log.error(
+                "failed to add vote",
+                height=vote.height,
+                round=vote.round,
+                type=vote.type_,
+                peer=peer_id,
+                err=repr(e),
+            )
             return
         self.event_bus.publish_type(ev.EVENT_VOTE, vote)
         if peer_id != "":

@@ -1,9 +1,18 @@
-"""Consensus WAL: fsync'd append-only log of every consensus input.
+"""Consensus WAL: fsync'd, size-capped rotating log of consensus inputs.
 
-Parity with reference consensus/wal.go: CRC32 + length framing (:295),
-EndHeightMessage markers (:41), WriteSync fsync barrier (:202),
-SearchForEndHeight (:232), and corruption-tolerant replay (decode stops
-at the first bad record, reference repair path consensus/state.go:2677).
+Parity with reference consensus/wal.go + libs/autofile/group.go: CRC32
++ length framing (wal.go:295), EndHeightMessage markers (:41),
+WriteSync fsync barrier (:202), SearchForEndHeight (:232, cross-file),
+corruption-tolerant replay, and **file rotation** — the head file
+rotates once it exceeds ``head_size_limit`` (group.go:65 headSizeLimit,
+RotateFile :265) and the oldest rotated files are deleted when the
+group exceeds ``total_size_limit`` (group.go checkTotalSizeLimit), so a
+node at height 10k does not carry an unbounded WAL.
+
+Layout: the head is ``<path>``; rotated files are ``<path>.000``,
+``<path>.001``, ... (monotonically increasing). Readers iterate the
+group in index order then the head; records never span files (rotation
+happens between records).
 
 Record: [crc32(payload) u32 BE][len u32 BE][payload]; payload is a
 proto-encoded TimedWALMessage.
@@ -12,15 +21,24 @@ proto-encoded TimedWALMessage.
 from __future__ import annotations
 
 import os
+import re
 import struct
 import time
 import zlib
 from dataclasses import dataclass
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, List, Optional
 
-from ..utils import codec, proto
+from ..utils import proto
+from ..utils.fail import fail_point
+from ..utils.log import get_logger
+
+_log = get_logger("wal")
 
 MAX_MSG_SIZE = 2 * 1024 * 1024
+
+# reference autofile defaults: 10 MB head, 1 GB group total
+DEFAULT_HEAD_SIZE_LIMIT = 10 * 1024 * 1024
+DEFAULT_TOTAL_SIZE_LIMIT = 1024 * 1024 * 1024
 
 # message kinds
 MSG_EVENT = 1        # internal state-machine event (round step string)
@@ -66,11 +84,43 @@ class WALMessage:
         )
 
 
+_ROT_RE = re.compile(r"\.(\d{3,})$")
+
+
+def _group_files(path: str) -> List[str]:
+    """All files of the group in read order: rotated (by index) + head."""
+    d = os.path.dirname(path) or "."
+    base = os.path.basename(path)
+    rotated = []
+    try:
+        names = os.listdir(d)
+    except FileNotFoundError:
+        names = []
+    for name in names:
+        if not name.startswith(base + "."):
+            continue
+        m = _ROT_RE.search(name[len(base):])
+        if m:
+            rotated.append((int(m.group(1)), os.path.join(d, name)))
+    out = [p for _, p in sorted(rotated)]
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
 class WAL:
-    def __init__(self, path: str):
+    def __init__(
+        self,
+        path: str,
+        head_size_limit: int = DEFAULT_HEAD_SIZE_LIMIT,
+        total_size_limit: int = DEFAULT_TOTAL_SIZE_LIMIT,
+    ):
         self.path = path
+        self.head_size_limit = head_size_limit
+        self.total_size_limit = total_size_limit
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f = open(path, "ab")
+        self._head_size = self._f.tell()
 
     def write(self, msg: WALMessage) -> None:
         if not msg.time_ns:
@@ -82,6 +132,9 @@ class WAL:
             ">II", zlib.crc32(payload) & 0xFFFFFFFF, len(payload)
         )
         self._f.write(rec + payload)
+        self._head_size += 8 + len(payload)
+        if self._head_size >= self.head_size_limit:
+            self._rotate()
 
     def write_sync(self, msg: WALMessage) -> None:
         """The fsync barrier (own votes/proposals + end-height markers
@@ -103,36 +156,116 @@ class WAL:
             pass
         self._f.close()
 
+    # --- rotation -----------------------------------------------------
+
+    def _next_index(self) -> int:
+        top = -1
+        for p in _group_files(self.path):
+            m = _ROT_RE.search(p)
+            if m:
+                top = max(top, int(m.group(1)))
+        return top + 1
+
+    def _rotate(self) -> None:
+        """Head -> <path>.<index>; fresh head. Records never span files.
+
+        Crash-safety: the head is flushed+fsync'd before the rename, the
+        rename is atomic, and a crash at any point leaves a readable
+        group (a missing head is recreated on reopen). Matches
+        libs/autofile/group.go:265 RotateFile.
+        """
+        self.flush_sync()
+        self._f.close()
+        idx = self._next_index()
+        fail_point("wal-rotate-before-rename")
+        os.replace(self.path, f"{self.path}.{idx:03d}")
+        fail_point("wal-rotate-after-rename")
+        self._f = open(self.path, "ab")
+        self._head_size = 0
+        _log.debug("rotated WAL head", path=self.path, index=idx)
+        self._enforce_total_limit()
+
+    def _enforce_total_limit(self) -> None:
+        """Delete oldest rotated files while the group exceeds the total
+        cap (group.go checkTotalSizeLimit — the head never deletes)."""
+        files = _group_files(self.path)
+        sizes = {p: os.path.getsize(p) for p in files if os.path.exists(p)}
+        total = sum(sizes.values())
+        for p in files:
+            if total <= self.total_size_limit or p == self.path:
+                break
+            try:
+                os.remove(p)
+                total -= sizes.get(p, 0)
+                _log.info(
+                    "WAL group over size cap, removed oldest file",
+                    file=p,
+                )
+            except OSError:
+                break
+
     # --- reading ------------------------------------------------------
 
     @staticmethod
-    def iter_messages(path: str) -> Iterator[WALMessage]:
-        """Yields messages until EOF or the first corrupt record."""
+    def _iter_file(path: str, stats: Optional[dict] = None):
+        """Yield valid records; on stop, ``stats`` (if given) gets
+        ``valid_bytes`` (length of the valid record prefix) and
+        ``size`` (file size) — a single pass answers both "what are the
+        records" and "is there trailing garbage"."""
         if not os.path.exists(path):
+            if stats is not None:
+                stats["valid_bytes"] = stats["size"] = 0
             return
+        pos = 0
         with open(path, "rb") as f:
-            while True:
-                hdr = f.read(8)
-                if len(hdr) < 8:
-                    return
-                crc, ln = struct.unpack(">II", hdr)
-                if ln > MAX_MSG_SIZE:
-                    return
-                payload = f.read(ln)
-                if len(payload) < ln:
-                    return
-                if zlib.crc32(payload) & 0xFFFFFFFF != crc:
-                    return
-                try:
-                    yield WALMessage.decode(payload)
-                except Exception:
-                    return
+            try:
+                while True:
+                    hdr = f.read(8)
+                    if len(hdr) < 8:
+                        return
+                    crc, ln = struct.unpack(">II", hdr)
+                    if ln > MAX_MSG_SIZE:
+                        return
+                    payload = f.read(ln)
+                    if len(payload) < ln:
+                        return
+                    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                        return
+                    try:
+                        msg = WALMessage.decode(payload)
+                    except Exception:
+                        return
+                    yield msg
+                    pos += 8 + ln
+            finally:
+                if stats is not None:
+                    stats["valid_bytes"] = pos
+                    try:
+                        stats["size"] = os.path.getsize(path)
+                    except OSError:
+                        stats["size"] = pos
+
+    @classmethod
+    def iter_messages(cls, path: str) -> Iterator[WALMessage]:
+        """Yields messages across the whole group (rotated files in
+        index order, then the head). A corrupt record inside any file
+        stops iteration entirely — everything after it is suspect, the
+        same stop-at-first-bad-record semantic as the reference."""
+        for p in _group_files(path):
+            stats: dict = {}
+            yield from cls._iter_file(p, stats)
+            if p != path and stats.get("size", 0) > stats.get(
+                "valid_bytes", 0
+            ):
+                # a rotated (sealed) file that ends mid-record was cut
+                # by corruption, not by an in-progress write: stop
+                return
 
     @classmethod
     def search_for_end_height(
         cls, path: str, height: int
     ) -> Optional[int]:
-        """Message index right after ENDHEIGHT(height), or None."""
+        """Global message index right after ENDHEIGHT(height), or None."""
         for i, msg in enumerate(cls.iter_messages(path)):
             if msg.kind == MSG_END_HEIGHT and msg.height == height:
                 return i + 1
@@ -149,13 +282,40 @@ class WAL:
 
     @classmethod
     def truncate_corrupt_tail(cls, path: str) -> int:
-        """Repair: rewrite the WAL keeping only valid records; returns
-        number of valid messages (reference WAL repair)."""
-        msgs = list(cls.iter_messages(path))
-        tmp = path + ".repair"
-        w = WAL(tmp)
-        for m in msgs:
-            w.write(m)
-        w.close()
-        os.replace(tmp, path)
-        return len(msgs)
+        """Repair: keep only the valid record prefix of the group.
+
+        The file containing the first corrupt record is rewritten to its
+        valid prefix and every later file is deleted; earlier files are
+        untouched (no multi-GB rewrite). Returns the total number of
+        valid messages in the group (reference WAL repair,
+        consensus/state.go:2677).
+        """
+        files = _group_files(path)
+        total = 0
+        for fi, p in enumerate(files):
+            stats: dict = {}
+            msgs = list(cls._iter_file(p, stats))
+            total += len(msgs)
+            if stats.get("size", 0) > stats.get("valid_bytes", 0):
+                tmp = p + ".repair"
+                if os.path.exists(tmp):
+                    # stale temp from a crashed earlier repair: a fresh
+                    # repair must not append after its partial contents
+                    os.remove(tmp)
+                w = WAL(tmp, head_size_limit=1 << 62)
+                for m in msgs:
+                    w.write(m)
+                w.close()
+                os.replace(tmp, p)
+                for later in files[fi + 1 :]:
+                    if later != p:
+                        try:
+                            os.remove(later)
+                        except OSError:
+                            pass
+                # a deleted head must be recreated so the group stays
+                # writable / iterable from <path>
+                if p != path:
+                    open(path, "ab").close()
+                break
+        return total

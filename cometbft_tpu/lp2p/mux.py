@@ -18,6 +18,10 @@ import asyncio
 import struct
 from typing import Callable, Dict, Optional
 
+from ..utils.log import get_logger
+
+_log = get_logger("lp2p.mux")
+
 SYN, DATA, FIN, RST, PING, PONG = range(6)
 _HDR = struct.Struct(">IBI")
 
@@ -79,6 +83,10 @@ class MuxStream:
             self.reset = True
             self.mux._try_send_frame(self.stream_id, RST, b"")
             self.mux._drop_stream(self.stream_id)
+            try:  # wake a reader blocked on an (empty) queue
+                self.recv_q.put_nowait(None)
+            except asyncio.QueueFull:
+                pass
 
 
 class Muxer:
@@ -99,11 +107,17 @@ class Muxer:
         send_rate: int = 0,
         recv_rate: int = 0,
         stream_queue: int = DEFAULT_STREAM_QUEUE,
+        overflow_reset: Optional[Callable[[str], bool]] = None,
     ):
         self.sconn = sconn
         self.streams: Dict[int, MuxStream] = {}
         self.on_stream = on_stream
         self.on_error = on_error
+        # predicate by protocol id: True -> reset the stream on inbound
+        # queue overflow (request/response channels, where a dropped
+        # reply stalls the requester until timeout); False -> count the
+        # drop (gossip channels re-send)
+        self.overflow_reset = overflow_reset or (lambda _proto: False)
         self.max_streams = max_streams
         self.stream_queue = stream_queue
         self._initiator = initiator
@@ -286,11 +300,35 @@ class Muxer:
             try:
                 st.recv_q.put_nowait(payload)
             except asyncio.QueueFull:
-                # receiver is not draining: drop this message, matching
-                # the send side's try_send drop semantics. Gossip
-                # protocols re-send; killing the stream would silently
-                # disable the channel for the connection's lifetime.
                 st.dropped += 1
+                if self.overflow_reset(st.protocol):
+                    # request/response channel: a silently dropped
+                    # reply leaves the requester stalled until its
+                    # timeout, and a stream-level RST would leave the
+                    # remote's outbound stream dead for the rest of the
+                    # connection — kill the CONNECTION so the error
+                    # surfaces and the switch's reconnect logic
+                    # restores a clean channel set (the native stack's
+                    # MConnection does the same on queue overflow)
+                    _log.error(
+                        "inbound queue overflow on request/response "
+                        "channel, dropping connection",
+                        protocol=st.protocol,
+                        stream=sid,
+                    )
+                    self._die(
+                        MuxError(
+                            f"inbound overflow on {st.protocol}"
+                        )
+                    )
+                elif st.dropped == 1:
+                    # gossip channels re-send: drop, but surface the
+                    # first occurrence per stream
+                    _log.info(
+                        "inbound queue overflow, dropping message",
+                        protocol=st.protocol,
+                        stream=sid,
+                    )
         elif flag in (FIN, RST):
             st = self.streams.pop(sid, None)
             if st is not None:

@@ -43,6 +43,18 @@ def protocol_channel(protocol: str) -> Optional[int]:
         return None
 
 
+# Channels whose messages are request/response pairs (blocksync block
+# responses 0x40, statesync snapshot 0x60 / chunk 0x61 responses): a
+# reply dropped on inbound-queue overflow would stall the requester
+# until its timeout, so overflow resets the stream instead (the
+# reference applies backpressure; gossip channels keep drop semantics).
+REQRESP_CHANNELS = frozenset({0x40, 0x60, 0x61})
+
+
+def _overflow_reset(protocol: str) -> bool:
+    return protocol_channel(protocol) in REQRESP_CHANNELS
+
+
 class Lp2pPeer:
     """Peer over a Muxer: one outbound stream per registered channel
     (opened at start), inbound streams dispatched by protocol id.
@@ -88,6 +100,7 @@ class Lp2pPeer:
             stream_queue=stream_queue or DEFAULT_STREAM_QUEUE,
             send_rate=send_rate,
             recv_rate=recv_rate,
+            overflow_reset=_overflow_reset,
         )
 
     # --- identity -----------------------------------------------------

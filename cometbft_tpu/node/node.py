@@ -23,7 +23,10 @@ from ..evidence.reactor import EvidenceReactor
 from ..mempool.reactor import MempoolReactor
 from ..p2p import MemoryTransport, NodeInfo, NodeKey, Switch, TCPTransport
 from ..types.genesis import GenesisDoc
+from ..utils.log import get_logger
 from .inprocess import NodeParts, build_node
+
+_log = get_logger("node")
 
 
 def _strip_proto(addr: str) -> str:
@@ -208,9 +211,10 @@ class Node:
             finally:
                 provider.close()
             self.parts.state = state
-            print(
-                f"statesync complete at height {state.last_block_height}; "
-                "switching to blocksync"
+            _log.info(
+                "statesync complete, switching to blocksync",
+                height=state.last_block_height,
+                adaptive=self._adaptive,
             )
             if self._adaptive:
                 # adaptive: consensus runs DURING blocksync and is the
@@ -226,13 +230,16 @@ class Node:
             # linger half-alive
             self.statesync_error = e
             traceback.print_exc()
-            print(f"statesync failed, stopping node: {e}")
+            _log.error("statesync failed, stopping node", err=repr(e))
             asyncio.ensure_future(self.stop())
 
     def _on_caught_up(self, state) -> None:
         asyncio.ensure_future(self._switch_to_consensus(state))
 
     async def _switch_to_consensus(self, state) -> None:
+        _log.info(
+            "switching to consensus", height=state.last_block_height
+        )
         if self._cs_started:
             self.consensus_reactor.switch_to_consensus()
             return
@@ -253,6 +260,13 @@ class Node:
     async def start(self) -> None:
         await self.transport.listen(_strip_proto(self.config.p2p.laddr))
         await self.switch.start()
+        _log.info(
+            "node started",
+            node_id=self.node_info.node_id[:12],
+            laddr=self.listen_addr,
+            chain=self.genesis.chain_id,
+            height=self.parts.block_store.height(),
+        )
         if self.config.rpc.laddr:
             from ..rpc import Environment, RPCServer
 

@@ -167,11 +167,40 @@ def _pad_n(n: int) -> int:
     return p
 
 
+# Mesh-aware dispatch: when more than one local device is visible the
+# batch is lane-sharded over all of them (data parallelism over
+# signature lanes — the framework's ICI scaling axis, SURVEY.md §2.2).
+# Keyed by device count; jitted shard_map programs are cached here.
+_SHARDED_FNS: dict = {}
+
+# Introspection for tests/dryrun: how the last verify_batch dispatched.
+LAST_DISPATCH: dict = {}
+
+
+def _sharded_fn():
+    """(n_devices, fn): lane-sharded verify over all local devices, or
+    (1, None) when single-device / uninitializable backend."""
+    try:
+        n = len(jax.devices())
+    except Exception:  # pragma: no cover - backend init failure
+        return 1, None
+    if n <= 1:
+        return 1, None
+    if n not in _SHARDED_FNS:
+        from ..parallel.mesh import make_mesh
+        from ..parallel.sharded_verify import make_sharded_core
+
+        _SHARDED_FNS[n] = make_sharded_core(make_mesh(n))
+    return n, _SHARDED_FNS[n]
+
+
 def verify_batch(items) -> np.ndarray:
     """Host API: items = list of (msg: bytes, pubkey: 32B, sig: 64B).
 
     Returns np.ndarray of bool verdicts, one per item. Builds padded
-    device arrays (batch-last layout), dispatches one XLA program.
+    device arrays (batch-last layout), dispatches one XLA program —
+    lane-sharded over every local device when a multi-chip mesh is
+    available (same shard_map program the driver dryrun validates).
     """
     n = len(items)
     if n == 0:
@@ -179,6 +208,9 @@ def verify_batch(items) -> np.ndarray:
     max_len = max(len(m) for m, _, _ in items)
     cap = bucket_cap(max_len)
     np_ = _pad_n(n)
+    n_dev, sharded = _sharded_fn()
+    if sharded is not None and np_ % n_dev:
+        np_ += n_dev - (np_ % n_dev)
 
     msgs = np.zeros((cap, np_), np.uint8)
     lens = np.zeros(np_, np.int32)
@@ -195,8 +227,13 @@ def verify_batch(items) -> np.ndarray:
         rs[:, i] = np.frombuffer(sig[:32], np.uint8)
         ss[:, i] = np.frombuffer(sig[32:], np.uint8)
 
+    fn = sharded if sharded is not None else verify_core_jit
+    LAST_DISPATCH.clear()
+    LAST_DISPATCH.update(
+        sharded=sharded is not None, n_devices=n_dev, lanes=np_, cap=cap
+    )
     out = np.array(
-        verify_core_jit(
+        fn(
             jnp.asarray(msgs),
             jnp.asarray(lens),
             jnp.asarray(pks),

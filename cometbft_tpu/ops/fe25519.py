@@ -180,36 +180,6 @@ def _carry_noWrap(c, rounds: int = 3):
     return c
 
 
-def _conv_sqr(a):
-    """Symmetric convolution a*a -> 41-limb tuple: c_k = a_{k/2}^2 +
-    2*sum_{i<k-i} a_i a_{k-i}. ~110 multiplies instead of the general
-    conv's 400 (cross terms shared; doubling is a shift). Same bounds:
-    inputs carried (< 2^13.4), so each product < 2^26.8 and a limb sums
-    < 20 of them — still < 2^31 with the doubling because at most 10
-    DISTINCT products are doubled per limb."""
-    outs = []
-    for k in range(2 * NLIMBS - 1):
-        lo = max(0, k - NLIMBS + 1)
-        hi = min(NLIMBS - 1, k)
-        cross = None
-        i = lo
-        while i < k - i:
-            t = a[i] * a[k - i]
-            cross = t if cross is None else cross + t
-            i += 1
-        s = None
-        if cross is not None:
-            s = cross + cross
-        if k % 2 == 0 and lo <= k // 2 <= hi:
-            sq = a[k // 2] * a[k // 2]
-            s = sq if s is None else s + sq
-        outs.append(s)
-    z = jnp.zeros_like(outs[0])
-    outs.append(z)  # limb 39 headroom
-    outs.append(z)  # limb 40 headroom
-    return tuple(outs)
-
-
 def _reduce_41(c):
     """41-limb convolution output -> carried 20-limb element.
 
@@ -219,7 +189,8 @@ def _reduce_41(c):
     limbs <= MASK + 2^5; the fold term hi*WRAP <= 2^22.6, and two wrap
     rounds bring limbs back under MASK + WRAP + 2^5 — the same
     "carried" contract the convolutions assume (products then stay
-    under 2^31; see _conv_sqr's doubled-cross bound)."""
+    under 2^31: carried limbs < 2^13.2, so each of the <= 20 partial
+    products is < 2^26.4 and their sum < 2^30.8)."""
     c = _carry_noWrap(c, 2)
     lo = c[:NLIMBS]
     hi = c[NLIMBS : 2 * NLIMBS]
@@ -234,7 +205,14 @@ def mul(a, b):
 
 
 def square(a):
-    """Field square (A/B: general conv)."""
+    """Field square via the general convolution.
+
+    MEASURED: the symmetric convolution (fewer multiplies: ~110 vs 400)
+    is ~30% SLOWER end-to-end on v5e (47.9ms vs 36.6ms @8192 lanes for
+    the full verify kernel) — the doubled-cross expression tree
+    schedules worse than the regular output-stationary conv, and the
+    VPU is not multiply-bound here. Keep the general conv.
+    """
     return _reduce_41(_conv_mul(a, a))
 
 

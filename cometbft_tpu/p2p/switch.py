@@ -18,9 +18,12 @@ import random
 import traceback
 from typing import Dict, List, Optional
 
+from ..utils.log import get_logger
 from .node_info import ChannelDescriptor, NodeInfo
 from .peer import Peer
 from .reactor import Reactor
+
+_log = get_logger("p2p")
 
 RECONNECT_BASE_S = 1.0
 RECONNECT_MAX_S = 30.0
@@ -178,6 +181,13 @@ class Switch:
         """Shared tail of peer construction: register, start, announce
         to reactors."""
         self.peers[peer.peer_id] = peer
+        _log.info(
+            "added peer",
+            peer=peer.peer_id[:12],
+            addr=peer.conn_str,
+            outbound=peer.outbound,
+            total=len(self.peers),
+        )
         peer.start()
         for r in self.reactors.values():
             try:
@@ -228,6 +238,12 @@ class Switch:
         try:
             reactor.receive(chan_id, peer, msg)
         except Exception as e:
+            _log.error(
+                "reactor receive failed, stopping peer",
+                channel=f"{chan_id:#x}",
+                peer=peer.peer_id[:12],
+                err=repr(e),
+            )
             traceback.print_exc()
             self.stop_peer_for_error(peer, e)
 
@@ -244,6 +260,12 @@ class Switch:
         if self.peers.get(peer.peer_id) is not peer:
             return
         del self.peers[peer.peer_id]
+        _log.info(
+            "removed peer",
+            peer=peer.peer_id[:12],
+            err=repr(exc) if exc else "",
+            total=len(self.peers),
+        )
         for r in self.reactors.values():
             try:
                 r.remove_peer(peer, exc)
@@ -254,6 +276,7 @@ class Switch:
             self._schedule_reconnect(peer.peer_id)
 
     def ban_peer(self, peer_id: str) -> None:
+        _log.info("banned peer", peer=peer_id[:12])
         self.banned.add(peer_id)
         p = self.peers.get(peer_id)
         if p:

@@ -196,6 +196,10 @@ class LogDB(KV):
             # committed; the rewrite happens on a background thread
             # (native mutex still serializes concurrent ops with it)
             def _bg():
+                # _compacting is held from the acquire above until the
+                # release here; close() blocks on it, so _closed cannot
+                # flip mid-compaction (use-after-free on the native
+                # handle otherwise)
                 try:
                     if not self._closed:
                         self.compact()
@@ -246,6 +250,9 @@ class LogDB(KV):
         self._lib.logdb_flush(self._handle())
 
     def close(self) -> None:
-        if not self._closed:
-            self._closed = True
-            self._lib.logdb_close(self._h)
+        # waits out any in-flight background compaction before freeing
+        # the native handle
+        with self._compacting:
+            if not self._closed:
+                self._closed = True
+                self._lib.logdb_close(self._h)

@@ -141,8 +141,26 @@ def parse(buf: bytes):
 
 
 def get1(msg, field, default=None):
+    """First value of a field, typed by the default: a wire value whose
+    type differs from the default's (varint where bytes are expected,
+    or vice versa) raises ValueError — malformed input must surface as
+    a decode error at the read, not an AttributeError/TypeError deep in
+    a constructor (found by the hypothesis decode fuzz)."""
     vs = msg.get(field)
-    return vs[0] if vs else default
+    if not vs:
+        return default
+    v = vs[0]
+    if isinstance(default, (bytes, bytearray)):
+        if not isinstance(v, (bytes, bytearray)):
+            raise ValueError(
+                f"field {field}: expected bytes, got {type(v).__name__}"
+            )
+    elif isinstance(default, int):
+        if not isinstance(v, int):
+            raise ValueError(
+                f"field {field}: expected varint, got {type(v).__name__}"
+            )
+    return v
 
 
 def parse_timestamp(b: bytes) -> int:

@@ -390,6 +390,13 @@ int64_t logdb_compact(void* h) {
   std::string tmp = db->path + ".compact";
   int nfd = ::open(tmp.c_str(), O_RDWR | O_CREAT | O_TRUNC | O_APPEND, 0644);
   if (nfd < 0) return -1;
+  // the exclusive lock must survive the fd swap below, or a second
+  // process could open the db after compaction and double-write
+  if (flock(nfd, LOCK_EX | LOCK_NB) != 0) {
+    ::close(nfd);
+    unlink(tmp.c_str());
+    return -1;
+  }
   uint64_t old_end = db->end;
   std::map<std::string, Entry> nindex;
   uint64_t nend = 0;
@@ -423,6 +430,15 @@ int64_t logdb_compact(void* h) {
     ::close(nfd);
     unlink(tmp.c_str());
     return -1;
+  }
+  // persist the rename itself before dropping the old fd
+  std::string dir = db->path;
+  size_t slash = dir.find_last_of('/');
+  dir = (slash == std::string::npos) ? "." : dir.substr(0, slash);
+  int dfd = ::open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+  if (dfd >= 0) {
+    fsync(dfd);
+    ::close(dfd);
   }
   ::close(db->fd);
   db->fd = nfd;

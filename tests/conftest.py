@@ -7,7 +7,9 @@ virtual CPU mesh exactly as the driver's dryrun does.  Must run before any
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# overwrite, not setdefault: the ambient environment may pin
+# JAX_PLATFORMS to a hardware plugin (e.g. axon)
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -28,6 +30,10 @@ _batch.set_default_backend("cpu")
 # cached recompiles land in seconds across test runs
 import jax  # noqa: E402
 
+# a sitecustomize hook may have already force-registered a hardware
+# platform via jax.config.update("jax_platforms", ...) — the env var
+# above doesn't win against that; re-pin the config itself
+jax.config.update("jax_platforms", "cpu")
 jax.config.update(
     "jax_compilation_cache_dir",
     os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache"),

@@ -13,6 +13,10 @@ from cometbft_tpu.crypto import ref_ed25519 as ref
 from cometbft_tpu.ops import ed25519 as ed
 from cometbft_tpu.ops import sha512 as dsha
 
+import pytest
+
+pytestmark = pytest.mark.tpu  # compiles the full kernel; see pytest.ini
+
 rng = random.Random(7)
 
 

@@ -11,6 +11,10 @@ import jax.numpy as jnp
 
 from cometbft_tpu.ops import fe25519 as fe
 
+import pytest
+
+pytestmark = pytest.mark.tpu  # compiles the full kernel; see pytest.ini
+
 P = fe.P
 rng = random.Random(1234)
 

@@ -1,0 +1,92 @@
+"""Structured logfmt logger tests (reference libs/log/tm_logger.go)."""
+
+import io
+
+import pytest
+
+from cometbft_tpu.utils import log as L
+
+
+@pytest.fixture(autouse=True)
+def _reset_levels():
+    yield
+    L.set_level("*:info")
+    L.set_writer(__import__("sys").stderr)
+
+
+def _capture():
+    buf = io.StringIO()
+    L.set_writer(buf)
+    return buf
+
+
+def test_logfmt_line_shape():
+    buf = _capture()
+    lg = L.get_logger("consensus")
+    lg.info("entering new round", height=5, round=0)
+    line = buf.getvalue().strip()
+    assert "level=info" in line
+    assert "module=consensus" in line
+    assert 'msg="entering new round"' in line
+    assert "height=5" in line and "round=0" in line
+    assert line.startswith("ts=")
+
+
+def test_quoting_and_bytes():
+    buf = _capture()
+    lg = L.get_logger("test")
+    lg.info('msg with "quotes"', h=b"\xde\xad", flag=True, f=0.5)
+    line = buf.getvalue()
+    assert "h=dead" in line
+    assert "flag=true" in line
+    assert "f=0.5" in line
+    assert '\\"quotes\\"' in line
+
+
+def test_lazy_values_not_rendered_below_level():
+    buf = _capture()
+    calls = []
+
+    def expensive():
+        calls.append(1)
+        return "deadbeef"
+
+    lg = L.get_logger("lazymod")
+    lg.debug("hidden", h=L.Lazy(expensive))  # below info: not rendered
+    assert calls == []
+    L.set_level("lazymod:debug")
+    lg.debug("shown", h=L.Lazy(expensive))
+    assert calls == [1]
+    assert "h=deadbeef" in buf.getvalue()
+
+
+def test_module_scoped_levels():
+    buf = _capture()
+    L.set_level("consensus:debug,p2p:error,*:info")
+    L.get_logger("consensus").debug("a")
+    L.get_logger("p2p").info("b")  # suppressed
+    L.get_logger("other").info("c")
+    out = buf.getvalue()
+    assert 'msg=a' in out
+    assert 'msg=b' not in out
+    assert 'msg=c' in out
+
+
+def test_bound_fields():
+    buf = _capture()
+    lg = L.get_logger("peer").with_fields(peer="abc123")
+    lg.info("hello", n=1)
+    assert "peer=abc123" in buf.getvalue()
+
+
+def test_invalid_level_raises():
+    with pytest.raises(ValueError):
+        L.set_level("verbose")
+
+
+def test_lazy_error_never_raises():
+    buf = _capture()
+    L.get_logger("x").info(
+        "ok", v=L.Lazy(lambda: 1 / 0)
+    )
+    assert "lazy error" in buf.getvalue()

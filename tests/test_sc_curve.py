@@ -14,6 +14,10 @@ from cometbft_tpu.ops import curve25519 as curve
 from cometbft_tpu.ops import fe25519 as fe
 from cometbft_tpu.ops import sc25519 as sc
 
+import pytest
+
+pytestmark = pytest.mark.tpu  # compiles the full kernel; see pytest.ini
+
 rng = random.Random(99)
 L, P = sc.L, fe.P
 
