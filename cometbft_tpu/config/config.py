@@ -150,6 +150,10 @@ class InstrumentationConfig:
     # profiling listener (reference pprof_laddr, node/node.go:624):
     # serves /debug/pprof/{stacks,profile,heap} when set
     pprof_laddr: str = ""
+    # stuck-await watchdog (the deadlock-detection analog, reference
+    # libs/sync/deadlock.go): tasks suspended at the same await point
+    # longer than this are reported with their stack; 0 disables
+    watchdog_stall_s: float = 0.0
 
 
 @dataclass

@@ -34,8 +34,11 @@ from ..p2p.reactor import Reactor
 from ..store.block_store import _decode_part, _encode_part
 from ..types import events as ev
 from ..utils import codec, proto
+from ..utils.log import get_logger
 from .state import BlockPartMessage, ProposalMessage, VoteMessage
 from .types import Step
+
+_log = get_logger("consensus.reactor")
 
 STATE_CHANNEL = 0x20
 DATA_CHANNEL = 0x21
@@ -130,6 +133,13 @@ class ConsensusReactor(Reactor):
         # after switch_to_consensus (reference conR.WaitSync)
         self.wait_sync = wait_sync
         self._gossip_tasks: Dict[str, asyncio.Task] = {}
+        # async coalescing queue: a round's vote wave is verified in
+        # one batch dispatch; results land in cs.sig_cache so the
+        # state machine's inline verify is a cache hit
+        # (crypto/coalesce.py; BASELINE.json north-star queue)
+        from ..crypto.coalesce import CoalescingVerifier
+
+        self.vote_verifier = CoalescingVerifier(cache=cs.sig_cache)
 
     def get_channels(self):
         return [
@@ -185,6 +195,52 @@ class ConsensusReactor(Reactor):
             self.switch.broadcast(
                 STATE_CHANNEL, encode_has_vote(*_vote_key(payload.vote))
             )
+
+    def _submit_vote(self, vote: T.Vote, peer_id: str) -> None:
+        """Route an inbound vote through the coalescing verifier when
+        it belongs to the current height's validator set; anything else
+        (catch-up votes, unknown indexes) goes straight to the state
+        machine, whose inline verification handles it (and produces
+        the canonical error for genuinely bad input)."""
+        cs = self.cs
+        rs = cs.rs
+        if vote.height != rs.height or rs.validators is None:
+            cs.enqueue_nowait("vote", VoteMessage(vote), peer_id)
+            return
+        val = (
+            rs.validators.get_by_index(vote.validator_index)
+            if 0 <= vote.validator_index < rs.validators.size()
+            else None
+        )
+        if val is None or val.address != vote.validator_address:
+            cs.enqueue_nowait("vote", VoteMessage(vote), peer_id)
+            return
+        try:
+            fut = self.vote_verifier.submit(
+                val.pub_key, vote.sign_bytes(cs.state.chain_id),
+                vote.signature,
+            )
+        except RuntimeError:  # no running loop (sync test harness)
+            cs.enqueue_nowait("vote", VoteMessage(vote), peer_id)
+            return
+
+        def _done(f: asyncio.Future) -> None:
+            ok = False
+            try:
+                ok = bool(f.result())
+            except Exception:
+                pass
+            if ok:
+                cs.enqueue_nowait("vote", VoteMessage(vote), peer_id)
+            else:
+                _log.error(
+                    "dropping vote with invalid signature",
+                    height=vote.height,
+                    round=vote.round,
+                    peer=peer_id[:12],
+                )
+
+        fut.add_done_callback(_done)
 
     def _on_event(self, e) -> None:
         if e.type_ == ev.EVENT_NEW_ROUND_STEP:
@@ -373,7 +429,7 @@ class ConsensusReactor(Reactor):
             vote = codec.decode_vote(body)
             prs.has_votes.add(_vote_key(vote))
             peer.try_send(STATE_CHANNEL, encode_has_vote(*_vote_key(vote)))
-            self.cs.enqueue_nowait("vote", VoteMessage(vote), peer.peer_id)
+            self._submit_vote(vote, peer.peer_id)
         elif mtype == MSG_COMMIT_BLOCK:
             m = proto.parse(body)
             block = codec.decode_block(proto.get1(m, 1, b""))

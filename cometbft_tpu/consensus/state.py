@@ -82,6 +82,9 @@ class ConsensusState:
         self.evpool = evidence_pool
         self.on_decided = on_decided  # hook: (height, block_id, block)
 
+        # shared with the reactor's async coalescing verifier: votes
+        # pre-verified in batches resolve as cache hits in add_vote
+        self.sig_cache = T.SignatureCache()
         self.rs = RoundState()
         self.state: Optional[State] = None
         self.queue: "asyncio.Queue" = None  # created in start()
@@ -157,7 +160,10 @@ class ConsensusState:
             round=0,
             step=Step.NEW_HEIGHT,
             validators=state.validators.copy(),
-            votes=HeightVoteSet(state.chain_id, height, state.validators),
+            votes=HeightVoteSet(
+                state.chain_id, height, state.validators,
+                sig_cache=self.sig_cache,
+            ),
             last_commit=last_precommits,
             last_validators=state.last_validators.copy()
             if state.last_validators and getattr(state.last_validators, "validators", None)

@@ -25,10 +25,17 @@ class HeightVoteSet:
     """Prevotes + precommits for every round of one height
     (reference consensus/types/height_vote_set.go)."""
 
-    def __init__(self, chain_id: str, height: int, val_set: T.ValidatorSet):
+    def __init__(
+        self,
+        chain_id: str,
+        height: int,
+        val_set: T.ValidatorSet,
+        sig_cache=None,
+    ):
         self.chain_id = chain_id
         self.height = height
         self.val_set = val_set
+        self.sig_cache = sig_cache
         self.round = 0
         self._prevotes: Dict[int, T.VoteSet] = {}
         self._precommits: Dict[int, T.VoteSet] = {}
@@ -38,10 +45,12 @@ class HeightVoteSet:
     def _ensure(self, round_: int) -> None:
         if round_ not in self._prevotes:
             self._prevotes[round_] = T.VoteSet(
-                self.chain_id, self.height, round_, T.PREVOTE, self.val_set
+                self.chain_id, self.height, round_, T.PREVOTE, self.val_set,
+                sig_cache=self.sig_cache,
             )
             self._precommits[round_] = T.VoteSet(
-                self.chain_id, self.height, round_, T.PRECOMMIT, self.val_set
+                self.chain_id, self.height, round_, T.PRECOMMIT, self.val_set,
+                sig_cache=self.sig_cache,
             )
 
     def set_round(self, round_: int) -> None:

@@ -189,9 +189,32 @@ class Secp256k1PubKey(PubKey):
 
     def verify(self, msg: bytes, sig: bytes) -> bool:
         """ECDSA verify; sig = 64 bytes r||s (reference-compatible),
-        message is hashed with SHA-256."""
+        message is hashed with SHA-256. OpenSSL fast path (~100us, the
+        mixed-curve host lane of the batch verifier rides this); the
+        pure-python implementation remains as fallback + oracle."""
         if len(sig) != 64:
             return False
+        try:
+            from cryptography.hazmat.primitives import hashes as _h
+            from cryptography.hazmat.primitives.asymmetric import ec as _ec
+            from cryptography.hazmat.primitives.asymmetric.utils import (
+                encode_dss_signature as _dss,
+            )
+
+            pub = _ec.EllipticCurvePublicKey.from_encoded_point(
+                _ec.SECP256K1(), bytes(self.key_bytes)
+            )
+            der = _dss(
+                int.from_bytes(sig[:32], "big"),
+                int.from_bytes(sig[32:], "big"),
+            )
+            try:
+                pub.verify(der, msg, _ec.ECDSA(_h.SHA256()))
+                return True
+            except Exception:
+                return False
+        except (ImportError, ValueError):
+            pass  # fall through to the pure-python path
         pt = _secp_decompress(self.key_bytes)
         if pt is None:
             return False

@@ -31,7 +31,7 @@ from typing import Dict, List, Optional
 
 @dataclass
 class Perturbation:
-    kind: str  # "kill" | "pause" | "disconnect"
+    kind: str  # "kill" | "pause" | "disconnect" | "evidence"
     height: int
     pause_s: float = 3.0
     restart_delay_s: float = 2.0
@@ -48,6 +48,7 @@ class NodeSpec:
     state_sync: bool = False
     adaptive_sync: bool = False
     mempool: str = "clist"
+    db: str = "sqlite"  # sqlite | logdb (native engine) | memdb
     perturbations: List[Perturbation] = field(default_factory=list)
 
 
@@ -81,6 +82,7 @@ class Manifest:
                 state_sync=bool(nd.get("state_sync", False)),
                 adaptive_sync=bool(nd.get("adaptive_sync", False)),
                 mempool=nd.get("mempool", "clist"),
+                db=nd.get("db", "sqlite"),
             )
             if nd.get("kill_at"):
                 spec.perturbations.append(
@@ -107,6 +109,14 @@ class Manifest:
                         int(nd["disconnect_at"]),
                         disconnect_s=float(nd.get("disconnect_s", 3.0)),
                     )
+                )
+            if nd.get("evidence_at"):
+                # this node's validator key equivocates: crafted
+                # DuplicateVoteEvidence is injected via the
+                # broadcast_evidence RPC (reference
+                # test/e2e/runner/evidence.go:32)
+                spec.perturbations.append(
+                    Perturbation("evidence", int(nd["evidence_at"]))
                 )
             m.nodes[name] = spec
         if not m.nodes:

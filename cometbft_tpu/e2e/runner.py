@@ -98,6 +98,7 @@ class Runner:
             cfg.blocksync.enable = rn.spec.block_sync or rn.spec.state_sync
             cfg.blocksync.adaptive_sync = rn.spec.adaptive_sync
             cfg.mempool.type_ = rn.spec.mempool
+            cfg.base.db_backend = rn.spec.db
             cfg.consensus.timeout_commit_s = 0.2
             if rn.spec.state_sync:
                 cfg.statesync.enable = True
@@ -256,7 +257,30 @@ class Runner:
             for t in pert_tasks:
                 t.cancel()
         self._check_agreement()
+        if any(
+            p.kind == "evidence"
+            for rn in self.nodes.values()
+            for p in rn.spec.perturbations
+        ):
+            self._check_evidence_committed()
         return not self.failures
+
+    def _check_evidence_committed(self) -> None:
+        """An injected equivocation must end up inside a committed
+        block (reference e2e evidence assertion)."""
+        if not getattr(self, "_evidence_injected", False):
+            self.failures.append("evidence perturbation never injected")
+            return
+        rn = next(o for o in self.nodes.values() if o.started)
+        top = self._height(rn)
+        for h in range(1, top + 1):
+            try:
+                blk = self._rpc(rn, f"block?height={h}")
+            except Exception:
+                continue
+            if blk["block"]["evidence"]["evidence"]:
+                return
+        self.failures.append("no committed block contains evidence")
 
     def _fill_trust(self, rn: RunnerNode) -> None:
         """Late statesync nodes need a live trust root."""
@@ -346,6 +370,61 @@ class Runner:
                     )
                 except Exception as e:
                     print(f"[perturb] reconnect failed: {e}", flush=True)
+            elif pert.kind == "evidence":
+                # this node's validator key equivocates: craft
+                # DuplicateVoteEvidence and submit it through another
+                # node's broadcast_evidence RPC (reference
+                # test/e2e/runner/evidence.go:32)
+                print(f"[perturb] evidence from {rn.spec.name}", flush=True)
+                try:
+                    await asyncio.to_thread(self._inject_evidence, rn)
+                    self._evidence_injected = True
+                except Exception as e:
+                    print(f"[perturb] evidence failed: {e}", flush=True)
+
+    def _inject_evidence(self, rn: RunnerNode) -> None:
+        import time as _time
+
+        from ..evidence.types import DuplicateVoteEvidence
+        from ..privval.file_pv import FilePV
+        from .. import types as T
+
+        pv = FilePV.load(
+            os.path.join(rn.home, "config", "priv_validator_key.json"),
+            os.path.join(rn.home, "data", "priv_validator_state.json"),
+        )
+        # equivocate at a recent committed height so receiving pools
+        # can resolve the validator set
+        target = next(
+            o for o in self.nodes.values() if o is not rn and o.started
+        )
+        h = self._height(target)
+        if h < 1:
+            raise RuntimeError("no committed height yet")
+        votes = []
+        now = _time.time_ns()
+        for tag in (b"\xaa", b"\xbb"):
+            v = T.Vote(
+                type_=T.PREVOTE,
+                height=h,
+                round=0,
+                block_id=T.BlockID(tag * 32, T.PartSetHeader(1, tag * 32)),
+                timestamp_ns=now,
+                validator_address=pv.pub_key().address(),
+                validator_index=0,  # receiving pool resolves by address
+                signature=b"",
+            )
+            v.signature = pv.priv_key.sign(v.sign_bytes(self.m.chain_id))
+            votes.append(v)
+        ev = DuplicateVoteEvidence.from_votes(
+            votes[0], votes[1], rn.spec.power, 0, now
+        )
+        self._rpc_post(
+            target,
+            "broadcast_evidence",
+            {"evidence": "0x" + ev.encode().hex()},
+            5.0,
+        )
 
     # --- assertions ---------------------------------------------------
 

@@ -107,17 +107,18 @@ class Muxer:
         send_rate: int = 0,
         recv_rate: int = 0,
         stream_queue: int = DEFAULT_STREAM_QUEUE,
-        overflow_reset: Optional[Callable[[str], bool]] = None,
+        overflow_fatal: Optional[Callable[[str], bool]] = None,
     ):
         self.sconn = sconn
         self.streams: Dict[int, MuxStream] = {}
         self.on_stream = on_stream
         self.on_error = on_error
-        # predicate by protocol id: True -> reset the stream on inbound
-        # queue overflow (request/response channels, where a dropped
-        # reply stalls the requester until timeout); False -> count the
-        # drop (gossip channels re-send)
-        self.overflow_reset = overflow_reset or (lambda _proto: False)
+        # predicate by protocol id: True -> inbound queue overflow is
+        # fatal to the CONNECTION (request/response channels, where a
+        # dropped reply stalls the requester until timeout and a
+        # stream-level reset would leave the remote's outbound stream
+        # dead); False -> count the drop (gossip channels re-send)
+        self.overflow_fatal = overflow_fatal or (lambda _proto: False)
         self.max_streams = max_streams
         self.stream_queue = stream_queue
         self._initiator = initiator
@@ -301,7 +302,7 @@ class Muxer:
                 st.recv_q.put_nowait(payload)
             except asyncio.QueueFull:
                 st.dropped += 1
-                if self.overflow_reset(st.protocol):
+                if self.overflow_fatal(st.protocol):
                     # request/response channel: a silently dropped
                     # reply leaves the requester stalled until its
                     # timeout, and a stream-level RST would leave the

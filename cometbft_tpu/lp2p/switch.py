@@ -46,12 +46,14 @@ def protocol_channel(protocol: str) -> Optional[int]:
 # Channels whose messages are request/response pairs (blocksync block
 # responses 0x40, statesync snapshot 0x60 / chunk 0x61 responses): a
 # reply dropped on inbound-queue overflow would stall the requester
-# until its timeout, so overflow resets the stream instead (the
-# reference applies backpressure; gossip channels keep drop semantics).
+# until its timeout, so overflow is FATAL TO THE CONNECTION — the peer
+# drops and (if persistent) reconnects with a clean channel set. A
+# stream-level reset would leave the remote's outbound stream dead for
+# the connection's lifetime; gossip channels keep drop semantics.
 REQRESP_CHANNELS = frozenset({0x40, 0x60, 0x61})
 
 
-def _overflow_reset(protocol: str) -> bool:
+def _overflow_fatal(protocol: str) -> bool:
     return protocol_channel(protocol) in REQRESP_CHANNELS
 
 
@@ -100,7 +102,7 @@ class Lp2pPeer:
             stream_queue=stream_queue or DEFAULT_STREAM_QUEUE,
             send_rate=send_rate,
             recv_rate=recv_rate,
-            overflow_reset=_overflow_reset,
+            overflow_fatal=_overflow_fatal,
         )
 
     # --- identity -----------------------------------------------------

@@ -33,6 +33,9 @@ class KVStoreApplication(abci.Application):
         self.snapshots: Dict[int, bytes] = {}
         self._restore_buf: List[bytes] = []
         self._restore_target = None
+        # (block_height, type, validator_address, power, evidence_height)
+        # tuples — the app-side slashing ledger
+        self.misbehavior_seen: List[tuple] = []
 
     def _load_persisted(self) -> None:
         import os
@@ -168,6 +171,19 @@ class KVStoreApplication(abci.Application):
     def finalize_block(self, req):
         self.staged = {}
         self.val_updates = []
+        # app-side slashing record (reference e2e app): every
+        # Misbehavior delivered by consensus is retained so the
+        # offender's power is attributable/slashable from app state
+        for mb in req.misbehavior:
+            self.misbehavior_seen.append(
+                (
+                    req.height,
+                    mb.type_,
+                    bytes(mb.validator_address),
+                    mb.validator_power,
+                    mb.height,
+                )
+            )
         results = [self._exec_tx(tx) for tx in req.txs]
         # stage, compute prospective hash
         pending = dict(self.state)

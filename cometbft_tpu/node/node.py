@@ -163,6 +163,7 @@ class Node:
         self.metrics = None
         self.metrics_server = None
         self.debug_server = None
+        self.watchdog = None
 
     # --- phase switching ----------------------------------------------
 
@@ -291,6 +292,16 @@ class Node:
                 self.config.instrumentation.pprof_laddr
             )
             await self.debug_server.start()
+        if self.config.instrumentation.watchdog_stall_s > 0:
+            from ..utils.debug import StuckTaskWatchdog
+
+            self.watchdog = StuckTaskWatchdog(
+                interval_s=min(
+                    5.0, self.config.instrumentation.watchdog_stall_s / 2
+                ),
+                stall_s=self.config.instrumentation.watchdog_stall_s,
+            )
+            self.watchdog.start()
         # consensus starts now unless a sync phase must complete first
         if self.config.statesync.enable:
             self._statesync_task = asyncio.create_task(
@@ -310,6 +321,8 @@ class Node:
             )
 
     async def stop(self) -> None:
+        if getattr(self, "watchdog", None) is not None:
+            self.watchdog.stop()
         if self._statesync_task is not None:
             self._statesync_task.cancel()
         if self.metrics_server is not None:

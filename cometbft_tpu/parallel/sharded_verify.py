@@ -69,6 +69,13 @@ def make_sharded_verifier(mesh):
     """Build the jitted multi-chip verify step for a mesh.
 
     Input arrays are lane-sharded on their last axis; scalars replicated.
+
+    The on-device tally is int32: callers must keep total voting power
+    under 2^31 (the returned wrapper enforces this host-side before
+    dispatch). The production path (types/validation.py) recomputes the
+    authoritative tally host-side in arbitrary precision either way;
+    this fast-path verdict exists for callers that want the quorum
+    decision without a host round-trip per job.
     """
     spec_lanes = P(None, DATA_AXIS)   # (bytes/limbs, N)
     spec_vec = P(DATA_AXIS)           # (N,)
@@ -88,4 +95,17 @@ def make_sharded_verifier(mesh):
         out_specs=(P(), P(), spec_vec),
         check_rep=False,
     )
-    return jax.jit(fn)
+    jitted = jax.jit(fn)
+
+    def step(msgs, lens, pks, rs, ss, powers, threshold):
+        import numpy as _np
+
+        total = int(_np.asarray(powers, dtype=_np.int64).sum())
+        if total >= 2**31:
+            raise ValueError(
+                "total voting power overflows the int32 device tally; "
+                "use the host tally path (types/validation.py)"
+            )
+        return jitted(msgs, lens, pks, rs, ss, powers, threshold)
+
+    return step

@@ -85,7 +85,16 @@ def block_json(b: T.Block) -> Dict[str, Any]:
     return {
         "header": header_json(b.header),
         "data": {"txs": [b64(tx) for tx in b.data.txs]},
-        "evidence": {"evidence": []},
+        "evidence": {
+            "evidence": [
+                {
+                    "type": type(e).__name__,
+                    "height": str(e.height()),
+                    "bytes": b64(e.encode()),
+                }
+                for e in (b.evidence or [])
+            ]
+        },
         "last_commit": commit_json(b.last_commit),
     }
 

@@ -44,6 +44,7 @@ class VoteSet:
         type_: int,
         val_set: ValidatorSet,
         verify_signatures: bool = True,
+        sig_cache=None,
     ):
         assert is_vote_type_valid(type_)
         self.chain_id = chain_id
@@ -52,6 +53,10 @@ class VoteSet:
         self.type_ = type_
         self.val_set = val_set
         self.verify = verify_signatures
+        # shared SignatureCache: signatures pre-verified by the async
+        # coalescing queue (crypto/coalesce.py) resolve as cache hits
+        # here, keeping the single-writer add_vote path off the crypto
+        self.sig_cache = sig_cache
         self.votes: List[Optional[Vote]] = [None] * val_set.size()
         self.sum = 0
         self.maj23: Optional[BlockID] = None
@@ -88,11 +93,11 @@ class VoteSet:
             if existing.block_id.key() == vote.block_id.key():
                 return False  # duplicate
             # conflicting vote: verify before raising as evidence
-            if self.verify and not vote.verify(self.chain_id, val.pub_key):
+            if self.verify and not self._verify_vote(vote, val):
                 raise ValueError("invalid signature on conflicting vote")
             raise ErrVoteConflictingVotes(existing, vote)
 
-        if self.verify and not vote.verify(self.chain_id, val.pub_key):
+        if self.verify and not self._verify_vote(vote, val):
             raise ValueError("invalid vote signature")
 
         self.votes[idx] = vote
@@ -107,6 +112,26 @@ class VoteSet:
         ):
             self.maj23 = vote.block_id
         return True
+
+    def _verify_vote(self, vote: Vote, val) -> bool:
+        """Single-vote verify, fronted by the shared SignatureCache.
+
+        The address-vs-index check happened in add_vote, and the cache
+        key binds (sign_bytes, sig, pubkey), so a hit is exactly as
+        strong as re-running the curve math (reference
+        types/signature_cache.go used at types/validation.go:82-91).
+        """
+        if self.sig_cache is not None:
+            sb = vote.sign_bytes(self.chain_id)
+            if self.sig_cache.contains(
+                sb, vote.signature, val.pub_key.key_bytes
+            ):
+                return True
+            ok = vote.verify(self.chain_id, val.pub_key)
+            if ok:
+                self.sig_cache.add(sb, vote.signature, val.pub_key.key_bytes)
+            return ok
+        return vote.verify(self.chain_id, val.pub_key)
 
     def get_vote(self, idx: int) -> Optional[Vote]:
         return self.votes[idx]

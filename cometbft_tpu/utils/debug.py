@@ -23,6 +23,128 @@ import zipfile
 from typing import Optional
 
 
+class StuckTaskWatchdog:
+    """Deadlock-detection analog for the asyncio single-writer design
+    (the reference swaps in go-deadlock mutexes under the `deadlock`
+    build tag, libs/sync/deadlock.go; a coroutine runtime's equivalent
+    hazard is an await that never resumes).
+
+    Samples all asyncio tasks every ``interval_s``; a task observed
+    suspended at the SAME await point (same frame, same instruction)
+    for more than ``stall_s`` is reported once with its stack via the
+    structured logger. Also watches event-loop responsiveness: if the
+    sampling task itself fires late by more than ``stall_s`` the loop
+    was blocked (sync work on the loop thread) and that is reported.
+    """
+
+    def __init__(self, interval_s: float = 5.0, stall_s: float = 30.0):
+        self.interval_s = interval_s
+        self.stall_s = stall_s
+        self._seen = {}  # id(task) -> (marker, first_seen, reported)
+        self._task: Optional[asyncio.Task] = None
+        self.stalled: list = []  # (name, stack) tuples, for tests
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    @staticmethod
+    def _marker(task: "asyncio.Task"):
+        """Identity of the task's current suspension point.
+
+        The frame position alone cannot distinguish "stuck forever"
+        from "re-suspends at the same line each iteration" (a polling
+        loop), so the marker includes the identity of the innermost
+        awaited object: a live loop creates a fresh Future per await,
+        a stuck task keeps waiting on the same one.
+        """
+        import weakref
+
+        coro = task.get_coro()
+        fr = getattr(coro, "cr_frame", None)
+        if fr is None:
+            return None
+        obj = coro
+        wr = None
+        for _ in range(16):
+            try:
+                # a weakref (not a bare id), so a recycled allocation
+                # at the same address cannot masquerade as the same
+                # await; keep the DEEPEST weakrefable object (e.g. the
+                # inner sleep coroutine — FutureIter isn't weakrefable)
+                wr = weakref.ref(obj)
+            except TypeError:
+                pass
+            nxt = getattr(obj, "cr_await", None)
+            if nxt is None:
+                nxt = getattr(obj, "gi_yieldfrom", None)
+            if nxt is None:
+                break
+            obj = nxt
+        if wr is None:
+            return None
+        return (id(fr), fr.f_lasti, wr)
+
+    def _sample(self) -> None:
+        from .log import get_logger
+
+        log = get_logger("watchdog")
+        now = time.monotonic()
+        alive = set()
+        me = asyncio.current_task()
+        for task in asyncio.all_tasks():
+            if task is me or task.done():
+                continue
+            key = id(task)
+            alive.add(key)
+            marker = self._marker(task)
+            if marker is None:  # unknown suspension point: never report
+                self._seen.pop(key, None)
+                continue
+            prev = self._seen.get(key)
+            if prev is None or prev[0] != marker:
+                self._seen[key] = (marker, now, False)
+                continue
+            marker0, first, reported = prev
+            if not reported and now - first > self.stall_s:
+                stack = io.StringIO()
+                task.print_stack(file=stack)
+                name = task.get_name()
+                self.stalled.append((name, stack.getvalue()))
+                log.error(
+                    "task stuck at the same await point",
+                    task=name,
+                    stalled_s=round(now - first, 1),
+                    stack=stack.getvalue()[:2000],
+                )
+                self._seen[key] = (marker0, first, True)
+        for key in list(self._seen):
+            if key not in alive:
+                del self._seen[key]
+
+    async def _run(self) -> None:
+        from .log import get_logger
+
+        log = get_logger("watchdog")
+        while True:
+            t0 = time.monotonic()
+            await asyncio.sleep(self.interval_s)
+            late = time.monotonic() - t0 - self.interval_s
+            if late > self.stall_s:
+                log.error(
+                    "event loop blocked (sync work on loop thread)",
+                    blocked_s=round(late, 1),
+                )
+            try:
+                self._sample()
+            except Exception:  # the watchdog must never kill the node
+                pass
+
+
 def all_stacks() -> str:
     """Every thread's stack + every asyncio task (the goroutine-dump
     equivalent)."""

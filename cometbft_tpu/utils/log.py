@@ -132,9 +132,10 @@ class Logger:
         if not self._enabled(level):
             return
         buf = io.StringIO()
+        now = time.time()  # single read: second + millis stay coherent
         buf.write(
-            f"ts={time.strftime('%Y-%m-%dT%H:%M:%S')}"
-            f".{int(time.time() * 1000) % 1000:03d}Z"
+            f"ts={time.strftime('%Y-%m-%dT%H:%M:%S', time.gmtime(now))}"
+            f".{int(now * 1000) % 1000:03d}Z"
             f" level={_LEVEL_NAMES[level]} module={self.module}"
             f" msg={_quote(msg)}"
         )

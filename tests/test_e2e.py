@@ -15,7 +15,7 @@ MANIFEST = {
     "target_height": 12,
     "load_tx_rate": 4,
     "node": {
-        "val0": {"mode": "validator"},
+        "val0": {"mode": "validator", "evidence_at": 4},
         "val1": {"mode": "validator", "kill_at": 5},
         "val2": {"mode": "validator", "pause_at": 4, "pause_s": 2.0},
         "val3": {
@@ -62,5 +62,6 @@ def test_manifest_validation():
             {"node": {"a": {"mode": "full"}}}
         )
     m = Manifest.from_dict(MANIFEST)
+    assert m.nodes["val0"].perturbations[0].kind == "evidence"
     assert m.nodes["val1"].perturbations[0].kind == "kill"
     assert m.nodes["val2"].perturbations[0].kind == "pause"

@@ -1,0 +1,95 @@
+"""Production multi-chip verify path (VERDICT r1 missing #1).
+
+On the virtual 8-device CPU mesh (conftest), the PRODUCTION seam —
+crypto/batch.TpuBatchVerifier -> ops/ed25519.verify_batch — must
+lane-shard over all local devices via shard_map and return verdicts
+identical to the single-device/host path. The driver's
+dryrun_multichip exercises the same code path.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from cometbft_tpu import types as T
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.crypto import ref_ed25519 as ref
+from cometbft_tpu.crypto.keys import Ed25519PubKey
+from cometbft_tpu.ops import ed25519 as ed
+
+pytestmark = pytest.mark.tpu  # compiles the full kernel; see pytest.ini
+
+
+@pytest.fixture(autouse=True)
+def _tpu_backend():
+    old_min = crypto_batch._MIN_TPU_BATCH
+    crypto_batch.set_default_backend("tpu")
+    crypto_batch.set_min_tpu_batch(1)
+    yield
+    crypto_batch.set_min_tpu_batch(old_min)
+    crypto_batch.set_default_backend("cpu")
+
+
+def test_verify_batch_shards_over_all_devices():
+    rng = np.random.default_rng(3)
+    items = []
+    bad = {2, 9}
+    for i in range(24):
+        sk = rng.bytes(32)
+        pk = ref.public_from_seed(sk)
+        m = bytes(rng.bytes(23))
+        sig = ref.sign(sk, m)
+        if i in bad:
+            sig = sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]
+        items.append((m, pk, sig))
+    got = ed.verify_batch(items)
+    assert ed.LAST_DISPATCH["sharded"] is True
+    assert ed.LAST_DISPATCH["n_devices"] == len(jax.devices())
+    assert ed.LAST_DISPATCH["lanes"] % len(jax.devices()) == 0
+    want = [i not in bad for i in range(24)]
+    assert list(got) == want
+
+
+def test_verify_commits_coalesced_sharded_matches_host():
+    """Same commits, sharded TPU path vs host path: identical verdicts
+    (including the bad-signature job)."""
+    from cometbft_tpu.node.inprocess import make_genesis
+    from cometbft_tpu.utils.chaingen import make_chain
+
+    gen, pvs = make_genesis(6, chain_id="shard")
+    parts = make_chain(gen, pvs, 4)
+    store = parts.block_store
+    vs = gen.validator_set()
+    jobs = []
+    for h in range(1, 4):
+        jobs.append(
+            (
+                vs,
+                store.load_block_meta(h).block_id,
+                h,
+                store.load_seen_commit(h),
+            )
+        )
+    # corrupt one signature in an extra copy of the last job's commit
+    import copy
+
+    bad_commit = copy.deepcopy(store.load_seen_commit(3))
+    s = bytearray(bad_commit.signatures[0].signature)
+    s[0] ^= 1
+    bad_commit.signatures[0].signature = bytes(s)
+    jobs.append(
+        (vs, store.load_block_meta(3).block_id, 3, bad_commit)
+    )
+
+    tpu_errors = T.verify_commits_coalesced(gen.chain_id, jobs)
+    assert ed.LAST_DISPATCH["sharded"] is True
+
+    crypto_batch.set_default_backend("cpu")
+    host_errors = T.verify_commits_coalesced(gen.chain_id, jobs)
+
+    assert [e is None for e in tpu_errors] == [
+        e is None for e in host_errors
+    ]
+    assert tpu_errors[:3] == [None, None, None]
+    assert tpu_errors[3] is not None

@@ -1,0 +1,64 @@
+"""Stuck-await watchdog (the deadlock-detection analog; reference
+libs/sync/deadlock.go swapped in by the `deadlock` build tag)."""
+
+import asyncio
+import io
+
+import pytest
+
+from cometbft_tpu.utils import log as L
+from cometbft_tpu.utils.debug import StuckTaskWatchdog
+
+
+def run(coro, timeout=30):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def test_reports_stuck_task_once():
+    async def main():
+        buf = io.StringIO()
+        L.set_writer(buf)
+        try:
+            wd = StuckTaskWatchdog(interval_s=0.05, stall_s=0.2)
+            wd.start()
+
+            forever = asyncio.Event()
+
+            async def stuck():
+                await forever.wait()  # never set
+
+            t = asyncio.get_running_loop().create_task(
+                stuck(), name="stuck-task"
+            )
+            await asyncio.sleep(1.0)
+            wd.stop()
+            names = [n for n, _ in wd.stalled]
+            assert "stuck-task" in names
+            # reported once, not on every sample
+            assert names.count("stuck-task") == 1
+            out = buf.getvalue()
+            assert "task stuck at the same await point" in out
+            assert "stuck-task" in out
+            forever.set()
+            await t
+        finally:
+            L.set_writer(__import__("sys").stderr)
+
+    run(main())
+
+
+def test_active_tasks_not_reported():
+    async def main():
+        wd = StuckTaskWatchdog(interval_s=0.05, stall_s=0.2)
+        wd.start()
+
+        async def busy():
+            for _ in range(100):
+                await asyncio.sleep(0.01)
+
+        t = asyncio.get_running_loop().create_task(busy(), name="busy")
+        await t
+        wd.stop()
+        assert all(n != "busy" for n, _ in wd.stalled)
+
+    run(main())
