@@ -100,6 +100,25 @@ class CommitInfo:
 
 
 @dataclass
+class ExtendedVoteInfo:
+    """VoteInfo plus the validator's vote extension (reference
+    abci/types.proto ExtendedVoteInfo — PrepareProposal's
+    local_last_commit when ABCI vote extensions are enabled)."""
+
+    validator_address: bytes = b""
+    power: int = 0
+    block_id_flag: int = 0
+    vote_extension: bytes = b""
+    extension_signature: bytes = b""
+
+
+@dataclass
+class ExtendedCommitInfo:
+    round: int = 0
+    votes: List[ExtendedVoteInfo] = field(default_factory=list)
+
+
+@dataclass
 class Misbehavior:
     """Evidence of validator misbehavior handed to the app for slashing
     (reference abci/types.proto Misbehavior)."""

@@ -532,12 +532,40 @@ class ConsensusState:
                     last_commit = rs.last_commit.make_commit()
                 if last_commit is None:
                     return  # cannot propose without last commit
+            extended_commit = None
+            if (
+                height > self.state.initial_height
+                and self.state.consensus_params.vote_extensions_enabled(
+                    height - 1
+                )
+            ):
+                raw = self.block_store.load_extended_commit(height - 1)
+                try:
+                    extended_commit = (
+                        codec.decode_extended_commit(raw) if raw else None
+                    )
+                except Exception:
+                    traceback.print_exc()
+                    extended_commit = None
+                if extended_commit is None:
+                    # extensions were promised to the app; proposing a
+                    # plain CommitInfo instead would silently violate
+                    # the ABCI contract (reference panics here). Skip
+                    # this proposal — another proposer that holds the
+                    # extended commit takes the next round.
+                    _log.error(
+                        "no extended commit for previous height; "
+                        "refusing to propose",
+                        height=height,
+                    )
+                    return
             try:
                 block, parts = self.block_exec.create_proposal_block(
                     height,
                     self.state,
                     last_commit,
                     self.privval.pub_key().address(),
+                    extended_commit=extended_commit,
                 )
             except Exception:
                 traceback.print_exc()
@@ -818,6 +846,19 @@ class ConsensusState:
         block, parts = rs.proposal_block, rs.proposal_block_parts
         bid = T.BlockID(block.hash(), parts.header)
         seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
+        if self.state.consensus_params.vote_extensions_enabled(height):
+            # persist the extension payloads alongside the block so
+            # the proposer can feed them to the NEXT height's
+            # PrepareProposal (reference SaveBlockWithExtendedCommit)
+            try:
+                ec = rs.votes.precommits(
+                    rs.commit_round
+                ).make_extended_commit()
+                self.block_store.save_extended_commit(
+                    height, codec.encode_extended_commit(ec)
+                )
+            except Exception:
+                traceback.print_exc()
         # persist + WAL end-height barrier (reference :1775-1801) +
         # apply + advance (commit already verified by consensus itself)
         self._apply_committed_block(
@@ -865,6 +906,28 @@ class ConsensusState:
             and not bid.is_nil()
             and self.state.consensus_params.vote_extensions_enabled(rs.height)
         )
+        if want_ext:
+            # the APP authors the extension content (reference
+            # consensus/state.go ExtendVote -> ABCI boundary). A
+            # failure must NOT degrade to signing an empty extension:
+            # peers' VerifyVoteExtension would reject the whole
+            # precommit, silently equivalent to not voting — retry
+            # instead (the app may be restarting).
+            try:
+                vote.extension = self.block_exec.extend_vote(
+                    bid.hash, rs.height, rs.round, vote.timestamp_ns
+                )
+            except Exception:
+                _log.error(
+                    "ExtendVote failed; retrying vote",
+                    height=rs.height,
+                    round=rs.round,
+                )
+                traceback.print_exc()
+                self._schedule_sign_retry(
+                    type_, block_hash, psh, rs.height, rs.round
+                )
+                return
         if getattr(self.privval, "REMOTE_BLOCKING", False) and self.queue:
             # remote signer: a socket round trip must not block the
             # event loop — sign in a worker thread and feed the signed
@@ -905,6 +968,56 @@ class ConsensusState:
             )
             return
         self._commit_own_vote(vote)
+
+    def _check_vote_extension(self, vote: T.Vote) -> None:
+        """Peer-vote extension rules (reference consensus/state.go
+        addVote -> VerifyVoteExtension boundary):
+
+        - extensions disabled, or a prevote, or a nil precommit: any
+          extension data is rejected (byzantine padding would otherwise
+          be stored, gossiped, and fed to the app against the ABCI
+          contract);
+        - extensions enabled + non-nil precommit: the extension
+          signature must verify and the app must accept — checked only
+          for NEW votes (duplicates short-circuit before the ed25519 +
+          ABCI round trip).
+        """
+        rs = self.rs
+        enabled = self.state.consensus_params.vote_extensions_enabled(
+            vote.height
+        )
+        is_ext_precommit = (
+            enabled
+            and vote.type_ == T.PRECOMMIT
+            and not vote.block_id.is_nil()
+        )
+        if not is_ext_precommit:
+            if vote.extension or vote.extension_signature:
+                raise ValueError(
+                    "unexpected vote extension data (disabled height, "
+                    "prevote, or nil precommit)"
+                )
+            return
+        # duplicate? the vote set dedups cheaply; don't pay the
+        # signature + app round trip again for re-gossiped votes
+        existing = rs.votes.precommits(vote.round).get_vote(
+            vote.validator_index
+        ) if 0 <= vote.validator_index < rs.validators.size() else None
+        if (
+            existing is not None
+            and existing.block_id.key() == vote.block_id.key()
+        ):
+            return
+        val = rs.validators.get_by_index(vote.validator_index)
+        if val is None or not vote.extension_signature:
+            raise ValueError("missing vote extension signature")
+        if not val.pub_key.verify(
+            vote.extension_sign_bytes(self.state.chain_id),
+            vote.extension_signature,
+        ):
+            raise ValueError("invalid vote extension signature")
+        if not self.block_exec.verify_vote_extension(vote):
+            raise ValueError("app rejected vote extension")
 
     def _commit_own_vote(self, vote: T.Vote) -> None:
         self._wal_write_msg("vote", VoteMessage(vote), "")
@@ -967,6 +1080,8 @@ class ConsensusState:
                 return
             if vote.height != rs.height:
                 return
+            if peer_id != "":
+                self._check_vote_extension(vote)
             added = rs.votes.add_vote(vote)
             if not added:
                 return

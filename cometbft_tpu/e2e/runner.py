@@ -374,13 +374,21 @@ class Runner:
                 # this node's validator key equivocates: craft
                 # DuplicateVoteEvidence and submit it through another
                 # node's broadcast_evidence RPC (reference
-                # test/e2e/runner/evidence.go:32)
+                # test/e2e/runner/evidence.go:32). Retried: on a loaded
+                # host an RPC can time out transiently.
                 print(f"[perturb] evidence from {rn.spec.name}", flush=True)
-                try:
-                    await asyncio.to_thread(self._inject_evidence, rn)
-                    self._evidence_injected = True
-                except Exception as e:
-                    print(f"[perturb] evidence failed: {e}", flush=True)
+                for attempt in range(5):
+                    try:
+                        await asyncio.to_thread(self._inject_evidence, rn)
+                        self._evidence_injected = True
+                        break
+                    except Exception as e:
+                        print(
+                            f"[perturb] evidence attempt {attempt} "
+                            f"failed: {e}",
+                            flush=True,
+                        )
+                        await asyncio.sleep(2.0)
 
     def _inject_evidence(self, rn: RunnerNode) -> None:
         import time as _time

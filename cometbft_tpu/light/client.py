@@ -111,14 +111,24 @@ class Client:
         return self.verify_header(latest, now_ns or time.time_ns())
 
     def verify_header(self, target: LightBlock, now_ns: int) -> LightBlock:
+        existing = self.store.get(target.height)
+        if existing is not None:
+            if existing.hash() == target.hash():
+                return existing
+            raise LightClientError(
+                "conflicting header for already-trusted height"
+            )
         trusted = self.store.latest_before(target.height)
         if trusted is None:
-            raise LightClientError("no trusted state below target")
-        if target.height <= trusted.height:
-            existing = self.store.get(target.height)
-            if existing is not None and existing.hash() == target.hash():
-                return existing
-            raise LightClientError("cannot verify backwards (use backwards)")
+            # target below every trusted header: hash-chain walk down
+            # from the lowest trusted block (reference light/client.go
+            # backwards verification)
+            lowest = self.store.lowest()
+            if lowest is None:
+                raise LightClientError("no trusted state")
+            self._verify_backwards(lowest, target)
+            self._cross_check(target)
+            return target
         if self.mode == SEQUENTIAL:
             self._verify_sequential(trusted, target, now_ns)
         else:
@@ -198,6 +208,39 @@ class Client:
                         "bisection cannot make progress"
                     )
                 pivots.append(self.primary.light_block(pivot_h))
+
+    def _verify_backwards(
+        self, trusted: LightBlock, target: LightBlock
+    ) -> None:
+        """Verify a header BELOW the trust root by walking the header
+        hash chain down one height at a time: header(h).last_block_id
+        must equal hash(header(h-1)) (reference light/client.go
+        backwards: no signature checks needed — the chain of hashes is
+        anchored at the already-trusted block).
+        """
+        cur = trusted
+        while cur.height > target.height:
+            want = cur.header.last_block_id
+            if want is None or not want.hash:
+                raise LightClientError(
+                    f"header {cur.height} has no last_block_id"
+                )
+            lower_h = cur.height - 1
+            lower = (
+                target
+                if lower_h == target.height
+                else self.primary.light_block(lower_h)
+            )
+            if lower.height != lower_h:
+                raise LightClientError("provider returned wrong height")
+            if lower.hash() != want.hash:
+                raise LightClientError(
+                    f"header hash chain broken at {lower_h}"
+                )
+            lower.validate_basic(self.chain_id)
+            self.hops += 1
+            cur = lower
+        self.store.save(target)
 
     def _next_vals(self, lb: LightBlock) -> T.ValidatorSet:
         """The valset signing height h+1 (trusted next-vals). For

@@ -36,6 +36,7 @@ class KVStoreApplication(abci.Application):
         # (block_height, type, validator_address, power, evidence_height)
         # tuples — the app-side slashing ledger
         self.misbehavior_seen: List[tuple] = []
+        self.extensions_verified = 0  # accepted VerifyVoteExtension calls
 
     def _load_persisted(self) -> None:
         import os
@@ -140,6 +141,23 @@ class KVStoreApplication(abci.Application):
                     status=abci.PROCESS_PROPOSAL_REJECT
                 )
         return abci.ResponseProcessProposal()
+
+    # --- vote extensions (reference test/e2e/app shape) ---------------
+
+    def extend_vote(self, req):
+        """Deterministic extension content bound to (height, hash)."""
+        return abci.ResponseExtendVote(
+            vote_extension=b"ext|%d|" % req.height + req.hash[:8]
+        )
+
+    def verify_vote_extension(self, req):
+        ok = req.vote_extension.startswith(b"ext|%d|" % req.height)
+        self.extensions_verified += 1 if ok else 0
+        return abci.ResponseVerifyVoteExtension(
+            status=abci.VERIFY_VOTE_EXT_ACCEPT
+            if ok
+            else abci.VERIFY_VOTE_EXT_REJECT
+        )
 
     def _exec_tx(self, tx: bytes) -> abci.ExecTxResult:
         if not self._valid_tx(tx):

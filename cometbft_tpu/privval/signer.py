@@ -158,9 +158,22 @@ class SignerClient:
         signed = codec.decode_vote(body)
         vote.signature = signed.signature
         vote.timestamp_ns = signed.timestamp_ns
+        # the server extension-signs in the same round trip whenever
+        # the request vote carries an extension
+        vote.extension_signature = signed.extension_signature
 
     def sign_vote_extension(self, chain_id: str, vote: Vote) -> None:
-        pass  # extensions unsupported over the wire yet (like tmkms)
+        """Extension signatures ride the SIGN_VOTE round trip (the
+        server signs both when the vote carries an extension); a
+        second trip only happens if the extension was attached after
+        the vote was signed."""
+        if not vote.extension or vote.extension_signature:
+            return
+        self.sign_vote(chain_id, vote)
+        if not vote.extension_signature:
+            raise RemoteSignerError(
+                "signer did not produce an extension signature"
+            )
 
     def sign_proposal(self, chain_id: str, prop: Proposal) -> None:
         payload = (
@@ -232,6 +245,9 @@ class SignerServer:
             if mtype == MSG_SIGN_VOTE_REQUEST:
                 vote = codec.decode_vote(rest)
                 self.pv.sign_vote(chain_id, vote)  # double-sign guard HERE
+                if vote.extension:
+                    # ABCI vote extensions: sign in the same round trip
+                    self.pv.sign_vote_extension(chain_id, vote)
                 await _send(
                     sconn,
                     MSG_SIGNED_VOTE_RESPONSE,

@@ -101,6 +101,31 @@ def build_last_commit_info(lc, last_vals) -> Optional[abci.CommitInfo]:
     return abci.CommitInfo(round=lc.round, votes=votes)
 
 
+def build_extended_commit_info(ec, last_vals):
+    """ExtendedCommitInfo for PrepareProposal when vote extensions are
+    enabled (reference state/execution.go buildExtendedCommitInfo)."""
+    if ec is None or last_vals is None:
+        return None
+    votes = []
+    for i, v in enumerate(last_vals.validators):
+        flag = abci.BLOCK_ID_FLAG_ABSENT
+        ext = ext_sig = b""
+        if i < len(ec.extended_signatures):
+            s = ec.extended_signatures[i]
+            flag = s.block_id_flag
+            ext, ext_sig = s.extension, s.extension_signature
+        votes.append(
+            abci.ExtendedVoteInfo(
+                validator_address=v.address,
+                power=v.voting_power,
+                block_id_flag=flag,
+                vote_extension=ext,
+                extension_signature=ext_sig,
+            )
+        )
+    return abci.ExtendedCommitInfo(round=ec.round, votes=votes)
+
+
 def evidence_to_misbehavior(evidence) -> List[abci.Misbehavior]:
     """ABCI Misbehavior records from block evidence (reference
     types/evidence.go ABCI() — duplicate votes map 1:1, a light-client
@@ -165,6 +190,37 @@ class BlockExecutor:
 
     # --- proposal creation (reference :114) ---------------------------
 
+    def extend_vote(
+        self, block_hash: bytes, height: int, round_: int, time_ns: int
+    ) -> bytes:
+        """App-provided vote extension for our own precommit
+        (reference state/execution.go ExtendVote -> ABCI ExtendVote)."""
+        resp = self.proxy.extend_vote(
+            abci.RequestExtendVote(
+                hash=block_hash,
+                height=height,
+                round=round_,
+                time_ns=time_ns,
+            )
+        )
+        return resp.vote_extension or b""
+
+    def verify_vote_extension(self, vote) -> bool:
+        """App acceptance of a peer's vote extension (reference
+        VerifyVoteExtension; rejection rejects the whole precommit)."""
+        try:
+            resp = self.proxy.verify_vote_extension(
+                abci.RequestVerifyVoteExtension(
+                    hash=vote.block_id.hash or b"",
+                    validator_address=vote.validator_address,
+                    height=vote.height,
+                    vote_extension=vote.extension,
+                )
+            )
+        except Exception:
+            return False
+        return resp.status == abci.VERIFY_VOTE_EXT_ACCEPT
+
     def create_proposal_block(
         self,
         height: int,
@@ -172,6 +228,7 @@ class BlockExecutor:
         last_commit: Optional[T.Commit],
         proposer_addr: bytes,
         time_ns: Optional[int] = None,
+        extended_commit: Optional[T.ExtendedCommit] = None,
     ) -> Tuple[T.Block, T.PartSet]:
         max_bytes = state.consensus_params.block.max_bytes
         max_gas = state.consensus_params.block.max_gas
@@ -186,7 +243,14 @@ class BlockExecutor:
             max_bytes - 2048, max_gas
         )
         t = time_ns or time.time_ns()
-        lci = build_last_commit_info(last_commit, state.last_validators)
+        if extended_commit is not None:
+            # extensions enabled at height-1: the app sees the
+            # extension payloads (reference buildExtendedCommitInfo)
+            lci = build_extended_commit_info(
+                extended_commit, state.last_validators
+            )
+        else:
+            lci = build_last_commit_info(last_commit, state.last_validators)
         req = abci.RequestPrepareProposal(
             max_tx_bytes=max_bytes - 2048,
             txs=txs,

@@ -46,6 +46,57 @@ class ConsensusParams:
     def hash(self) -> bytes:
         return merkle.hash_from_byte_slices([self.encode()])
 
+    def to_dict(self) -> dict:
+        """Genesis-JSON form (reference types/params.go in genesis)."""
+        return {
+            "block": {
+                "max_bytes": self.block.max_bytes,
+                "max_gas": self.block.max_gas,
+            },
+            "evidence": {
+                "max_age_num_blocks": self.evidence.max_age_num_blocks,
+                "max_age_duration_ns": self.evidence.max_age_duration_ns,
+                "max_bytes": self.evidence.max_bytes,
+            },
+            "validator": {
+                "pub_key_types": list(self.validator.pub_key_types)
+            },
+            "abci": {
+                "vote_extensions_enable_height": (
+                    self.abci.vote_extensions_enable_height
+                )
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ConsensusParams":
+        p = cls()
+        b = d.get("block", {})
+        p.block.max_bytes = int(b.get("max_bytes", p.block.max_bytes))
+        p.block.max_gas = int(b.get("max_gas", p.block.max_gas))
+        e = d.get("evidence", {})
+        p.evidence.max_age_num_blocks = int(
+            e.get("max_age_num_blocks", p.evidence.max_age_num_blocks)
+        )
+        p.evidence.max_age_duration_ns = int(
+            e.get("max_age_duration_ns", p.evidence.max_age_duration_ns)
+        )
+        p.evidence.max_bytes = int(
+            e.get("max_bytes", p.evidence.max_bytes)
+        )
+        v = d.get("validator", {})
+        p.validator.pub_key_types = list(
+            v.get("pub_key_types", p.validator.pub_key_types)
+        )
+        a = d.get("abci", {})
+        p.abci.vote_extensions_enable_height = int(
+            a.get(
+                "vote_extensions_enable_height",
+                p.abci.vote_extensions_enable_height,
+            )
+        )
+        return p
+
     def vote_extensions_enabled(self, height: int) -> bool:
         h = self.abci.vote_extensions_enable_height
         return h > 0 and height >= h

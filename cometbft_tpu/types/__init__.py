@@ -13,6 +13,8 @@ from .block import (  # noqa: F401
     BlockID,
     Commit,
     CommitSig,
+    ExtendedCommit,
+    ExtendedCommitSig,
     Data,
     Header,
     NIL_BLOCK_ID,

@@ -171,6 +171,47 @@ class CommitSig:
                 raise ValueError("invalid signature size")
 
 
+@dataclass(frozen=True)
+class ExtendedCommitSig(CommitSig):
+    """CommitSig carrying the vote extension + its signature
+    (reference types/block.go ExtendedCommitSig — ABCI 2.0 vote
+    extensions)."""
+
+    extension: bytes = b""
+    extension_signature: bytes = b""
+
+    def strip(self) -> CommitSig:
+        return CommitSig(
+            block_id_flag=self.block_id_flag,
+            validator_address=self.validator_address,
+            timestamp_ns=self.timestamp_ns,
+            signature=self.signature,
+        )
+
+
+@dataclass
+class ExtendedCommit:
+    """Commit whose signatures carry vote extensions (reference
+    types/block.go ExtendedCommit); persisted by the block store
+    (store/store.go:481 SaveBlockWithExtendedCommit) and replayed into
+    the next height's PrepareProposal as ExtendedCommitInfo."""
+
+    height: int = 0
+    round: int = 0
+    block_id: BlockID = field(default_factory=BlockID)
+    extended_signatures: List[ExtendedCommitSig] = field(
+        default_factory=list
+    )
+
+    def to_commit(self) -> "Commit":
+        return Commit(
+            height=self.height,
+            round=self.round,
+            block_id=self.block_id,
+            signatures=[s.strip() for s in self.extended_signatures],
+        )
+
+
 @dataclass
 class Commit:
     height: int = 0

@@ -79,6 +79,7 @@ class GenesisDoc:
                 "app_state": self.app_state_bytes.decode()
                 if self.app_state_bytes
                 else "",
+                "consensus_params": self.consensus_params.to_dict(),
             },
             indent=2,
         )
@@ -102,6 +103,9 @@ class GenesisDoc:
             validators=vals,
             app_hash=bytes.fromhex(d.get("app_hash", "")),
             app_state_bytes=d.get("app_state", "").encode(),
+            consensus_params=ConsensusParams.from_dict(
+                d.get("consensus_params", {})
+            ),
         )
 
     def save(self, path: str) -> None:

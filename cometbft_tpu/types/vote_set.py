@@ -17,6 +17,8 @@ from .block import (
     BlockID,
     Commit,
     CommitSig,
+    ExtendedCommit,
+    ExtendedCommitSig,
 )
 from .validator_set import ValidatorSet
 from .vote import PRECOMMIT, Vote, is_vote_type_valid
@@ -199,4 +201,46 @@ class VoteSet:
             round=self.round,
             block_id=self.maj23,
             signatures=sigs,
+        )
+
+    def make_extended_commit(
+        self, require_extensions: bool = True
+    ) -> ExtendedCommit:
+        """Commit + per-vote extensions (reference
+        types/vote_set.go MakeExtendedCommit): the payload the proposer
+        feeds to the NEXT height's PrepareProposal.
+
+        require_extensions (reference EnsureExtension): every
+        COMMIT-flag signature must carry an extension signature —
+        persisting one without it would make 'extension absent' and
+        'extension stripped' indistinguishable downstream."""
+        base = self.make_commit()
+        ext_sigs = []
+        for cs, vote in zip(base.signatures, self.votes):
+            if (
+                require_extensions
+                and cs.block_id_flag == BLOCK_ID_FLAG_COMMIT
+                and not (vote and vote.extension_signature)
+            ):
+                raise ValueError(
+                    "commit vote without extension signature "
+                    f"(validator {cs.validator_address.hex()[:12]})"
+                )
+            ext_sigs.append(
+                ExtendedCommitSig(
+                    block_id_flag=cs.block_id_flag,
+                    validator_address=cs.validator_address,
+                    timestamp_ns=cs.timestamp_ns,
+                    signature=cs.signature,
+                    extension=vote.extension if vote else b"",
+                    extension_signature=(
+                        vote.extension_signature if vote else b""
+                    ),
+                )
+            )
+        return ExtendedCommit(
+            height=base.height,
+            round=base.round,
+            block_id=base.block_id,
+            extended_signatures=ext_sigs,
         )

@@ -161,6 +161,46 @@ def decode_commit(b: bytes) -> Commit:
     )
 
 
+def encode_extended_commit(ec) -> bytes:
+    """ExtendedCommit wire form (reference proto ExtendedCommitInfo
+    storage shape): commit fields + per-sig extension data."""
+    out = proto.field_varint(1, ec.height) + proto.field_varint(2, ec.round)
+    out += proto.field_message(3, ec.block_id.encode())
+    for s in ec.extended_signatures:
+        body = (
+            encode_commit_sig(s)
+            + proto.field_bytes(5, s.extension)
+            + proto.field_bytes(6, s.extension_signature)
+        )
+        out += proto.field_message(4, body)
+    return out
+
+
+def decode_extended_commit(b: bytes):
+    from ..types.block import ExtendedCommit, ExtendedCommitSig
+
+    m = proto.parse(b)
+    sigs = []
+    for x in m.get(4, []):
+        sm = proto.parse(x)
+        sigs.append(
+            ExtendedCommitSig(
+                block_id_flag=proto.get1(sm, 1, 0),
+                validator_address=proto.get1(sm, 2, b""),
+                timestamp_ns=proto.parse_timestamp(proto.get1(sm, 3, b"")),
+                signature=proto.get1(sm, 4, b""),
+                extension=proto.get1(sm, 5, b""),
+                extension_signature=proto.get1(sm, 6, b""),
+            )
+        )
+    return ExtendedCommit(
+        height=proto.get1(m, 1, 0),
+        round=proto.get1(m, 2, 0),
+        block_id=decode_block_id(proto.get1(m, 3, b"")),
+        extended_signatures=sigs,
+    )
+
+
 # --- vote / proposal ----------------------------------------------------
 
 

@@ -1,0 +1,82 @@
+"""Light-client backwards verification (reference light/client.go
+backwards): verify headers BELOW the trust root via the header hash
+chain, no signatures needed."""
+
+import time
+
+import pytest
+
+from cometbft_tpu.light.client import Client, LightClientError, TrustOptions
+from cometbft_tpu.light.provider import StoreBackedProvider
+from cometbft_tpu.node.inprocess import make_genesis
+from cometbft_tpu.utils.chaingen import make_chain
+
+N_VALS = 4
+CHAIN_LEN = 20
+
+
+@pytest.fixture(scope="module")
+def chain():
+    gen, pvs = make_genesis(N_VALS, chain_id="back-chain")
+    node = make_chain(gen, [pv.priv_key for pv in pvs], CHAIN_LEN)
+    return gen, node
+
+
+def _client(gen, node, trust_height):
+    provider = StoreBackedProvider(
+        gen.chain_id, node.block_store, node.state_store
+    )
+    root = provider.light_block(trust_height)
+    return Client(
+        gen.chain_id,
+        TrustOptions(
+            period_ns=3600 * 10**9 * 24,
+            height=trust_height,
+            hash=root.hash(),
+        ),
+        provider,
+    )
+
+
+def test_backwards_walk_to_earlier_height(chain):
+    gen, node = chain
+    client = _client(gen, node, 15)
+    lb = client.verify_light_block_at_height(5)
+    assert lb.height == 5
+    # walked 10 hash-chain hops
+    assert client.hops == 10
+    # now in the store: immediate
+    again = client.verify_light_block_at_height(5)
+    assert again.hash() == lb.hash()
+
+
+def test_backwards_rejects_forged_header(chain):
+    gen, node = chain
+
+    import dataclasses
+
+    class Tamper(StoreBackedProvider):
+        def light_block(self, height):
+            lb = super().light_block(height)
+            if height == 7:
+                # frozen header: rebuild with a different app_hash
+                lb = type(lb)(
+                    dataclasses.replace(
+                        lb.header, app_hash=b"\xff" * 32
+                    ),
+                    lb.commit,
+                    lb.validator_set,
+                )
+            return lb
+
+    provider = Tamper(gen.chain_id, node.block_store, node.state_store)
+    root = provider.light_block(12)
+    client = Client(
+        gen.chain_id,
+        TrustOptions(
+            period_ns=3600 * 10**9, height=12, hash=root.hash()
+        ),
+        provider,
+    )
+    with pytest.raises(LightClientError, match="chain broken"):
+        client.verify_light_block_at_height(5)

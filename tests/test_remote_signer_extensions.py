@@ -1,0 +1,61 @@
+"""Remote signer + ABCI vote extensions: the extension signature rides
+the SIGN_VOTE round trip (a remote-signer validator must not be
+expelled from consensus when extensions are enabled)."""
+
+import asyncio
+import os
+import tempfile
+
+import pytest
+
+from cometbft_tpu import types as T
+from cometbft_tpu.node.inprocess import make_genesis
+from cometbft_tpu.privval.signer import SignerClient, SignerServer
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def test_extension_signed_over_the_wire():
+    async def main():
+        gen, pvs = make_genesis(1, chain_id="rsx-chain")
+        signer_pv = pvs[0]
+        client = SignerClient("127.0.0.1:0")
+        server = SignerServer(signer_pv, client.listen_addr)
+        task = asyncio.create_task(server.serve())
+        await asyncio.sleep(0.2)
+        try:
+            pub = await asyncio.to_thread(client.pub_key)
+            bid = T.BlockID(b"\x11" * 32, T.PartSetHeader(1, b"\x22" * 32))
+            vote = T.Vote(
+                type_=T.PRECOMMIT,
+                height=7,
+                round=0,
+                block_id=bid,
+                timestamp_ns=123,
+                validator_address=pub.address(),
+                validator_index=0,
+                extension=b"ext|7|payload",
+            )
+            await asyncio.to_thread(client.sign_vote, "rsx-chain", vote)
+            # both signatures arrived in ONE round trip
+            assert pub.verify(
+                vote.sign_bytes("rsx-chain"), vote.signature
+            )
+            assert vote.extension_signature
+            assert pub.verify(
+                vote.extension_sign_bytes("rsx-chain"),
+                vote.extension_signature,
+            )
+            # sign_vote_extension after the fact is a cheap no-op
+            before = vote.extension_signature
+            await asyncio.to_thread(
+                client.sign_vote_extension, "rsx-chain", vote
+            )
+            assert vote.extension_signature == before
+        finally:
+            server.stop()
+            task.cancel()
+
+    run(main())
