@@ -179,16 +179,28 @@ class BlockSyncNetReactor(Reactor):
                     bytes([MSG_NO_BLOCK_RESPONSE]) + struct.pack(">q", height),
                 )
                 return
+            payload = proto.field_bytes(1, codec.encode_block(block))
+            # vote extensions: ship the stored extended commit so the
+            # syncing node can later propose with ExtendedCommitInfo
+            # (reference blocksync BlockResponse.ExtCommit,
+            # reactor.go:648)
+            ec = self.block_store.load_extended_commit(height)
+            if ec:
+                payload += proto.field_bytes(2, ec)
             asyncio.ensure_future(
                 peer.send(
                     BLOCKSYNC_CHANNEL,
-                    bytes([MSG_BLOCK_RESPONSE])
-                    + proto.field_bytes(1, codec.encode_block(block)),
+                    bytes([MSG_BLOCK_RESPONSE]) + payload,
                 )
             )
         elif mtype == MSG_BLOCK_RESPONSE:
             m = proto.parse(body)
             block = codec.decode_block(proto.get1(m, 1, b""))
+            ec_bytes = proto.get1(m, 2, b"")
+            if ec_bytes:
+                # carried out-of-band to the verify/apply loop (the
+                # pool's data path is block-shaped)
+                block._ec_bytes = ec_bytes
             cli = self.clients.get(peer.peer_id)
             if cli:
                 cli.deliver(block.height, block)

@@ -184,6 +184,22 @@ class BlockSyncReactor:
             except Exception:
                 self.pool.redo_request(h, peer)
                 break
+            try:
+                ec_bytes = self._check_extended_commit(h, blk, peer)
+            except Exception as e:
+                _log.error(
+                    "extended commit check failed, refetching",
+                    height=h,
+                    err=repr(e),
+                )
+                self.pool.redo_request(h, peer)
+                break
+            # persist the verified EC immediately: every later branch
+            # (incl. "consensus ingested it concurrently") must leave
+            # this node able to SERVE the EC, or a future joiner stalls
+            # on "peer omitted extended commit"
+            if ec_bytes and not self.block_store.load_extended_commit(h):
+                self.block_store.save_extended_commit(h, ec_bytes)
             parts = T.PartSet.from_data(codec.encode_block(blk))
             if self.ingestor is not None:
                 # fork: adaptive sync — pipeline the verified block
@@ -217,3 +233,63 @@ class BlockSyncReactor:
             self.blocks_applied += 1
             applied += 1
         return applied
+
+    def _check_extended_commit(self, h, blk, peer):
+        """When vote extensions are enabled at height h the peer MUST
+        supply a valid extended commit with the block (reference
+        blocksync/reactor.go:648): commit sigs verify against the
+        valset, extension signatures verify per lane, and the payload
+        binds to this block. Returns the raw bytes to persist (or None
+        when extensions are disabled)."""
+        enabled = self.state.consensus_params.vote_extensions_enabled(h)
+        ec_bytes = getattr(blk, "_ec_bytes", None)
+        if not enabled:
+            return None  # ignore unsolicited payloads
+        if not ec_bytes:
+            raise ValueError(
+                "peer omitted extended commit at extension-enabled "
+                f"height {h}"
+            )
+        from ..types.canonical import vote_extension_sign_bytes
+        from ..crypto import batch as crypto_batch
+
+        ec = codec.decode_extended_commit(ec_bytes)
+        if ec.height != h or ec.block_id.hash != blk.hash():
+            raise ValueError("extended commit does not bind to block")
+        # full commit verification (all signatures + quorum)
+        T.verify_commit(
+            self.state.chain_id,
+            self.state.validators,
+            ec.block_id,
+            h,
+            ec.to_commit(),
+            cache=self.sig_cache,
+        )
+        verifier = crypto_batch.create_batch_verifier()
+        for i, s in enumerate(ec.extended_signatures):
+            if not s.for_block():
+                # reference ExtendedCommitSig.ValidateBasic: extension
+                # data is forbidden off COMMIT lanes — unverifiable
+                # attacker bytes must never be persisted / reach the app
+                if s.extension or s.extension_signature:
+                    raise ValueError(
+                        f"sig {i}: extension data on non-commit lane"
+                    )
+                continue
+            if not s.extension_signature:
+                raise ValueError(
+                    f"commit sig {i} missing extension signature"
+                )
+            val = self.state.validators.get_by_index(i)
+            verifier.add(
+                val.pub_key,
+                vote_extension_sign_bytes(
+                    self.state.chain_id, h, ec.round, s.extension
+                ),
+                s.extension_signature,
+            )
+        if len(verifier):
+            all_ok, _ = verifier.verify()
+            if not all_ok:
+                raise ValueError("invalid extension signature")
+        return ec_bytes

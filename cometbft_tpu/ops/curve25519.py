@@ -68,8 +68,14 @@ def add(p, q):
     return (fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H))
 
 
-def double(p):
-    """Doubling (dbl-2008-hwcd, a = -1); valid for all points."""
+def double(p, need_t: bool = True):
+    """Doubling (dbl-2008-hwcd, a = -1); valid for all points.
+
+    need_t=False skips the T output (one field multiply): T is only
+    consumed by the extended ADD formulas, so any double whose result
+    feeds another double (or the final identity test) can drop it —
+    in the windowed ladder that is 3 of every 4 doubles.
+    """
     X1, Y1, Z1, _ = p
     A = fe.square(X1)
     B = fe.square(Y1)
@@ -79,7 +85,12 @@ def double(p):
     E = fe.sub(H, fe.square(fe.add(X1, Y1)))
     G = fe.sub(A, B)
     F = fe.add(C, G)
-    return (fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H))
+    return (
+        fe.mul(E, F),
+        fe.mul(G, H),
+        fe.mul(F, G),
+        fe.mul(E, H) if need_t else None,
+    )
 
 
 def negate(p):
@@ -131,7 +142,10 @@ def decompress(b):
 
 
 def mul_by_cofactor(p):
-    return double(double(double(p)))
+    """[8]P; the result only feeds is_identity, so no double needs T."""
+    return double(
+        double(double(p, need_t=False), need_t=False), need_t=False
+    )
 
 
 # --- cached-point forms (windowed ladder) ------------------------------
@@ -168,8 +182,10 @@ def add_cached(p, c):
     return (fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H))
 
 
-def add_affine_cached(p, c):
-    """extended p + cached-affine c (Z2 == 1) -> extended (7M)."""
+def add_affine_cached(p, c, need_t: bool = True):
+    """extended p + cached-affine c (Z2 == 1) -> extended (7M; 6M when
+    the T output is unused — e.g. the window-final add whose result
+    only feeds the next window's doubles)."""
     X1, Y1, Z1, T1 = p
     ypx, ymx, t2d = c
     A = fe.mul(fe.sub(Y1, X1), ymx)
@@ -180,7 +196,37 @@ def add_affine_cached(p, c):
     F = fe.sub(Dv, C)
     G = fe.add(Dv, C)
     H = fe.add(B, A)
-    return (fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H))
+    return (
+        fe.mul(E, F),
+        fe.mul(G, H),
+        fe.mul(F, G),
+        fe.mul(E, H) if need_t else None,
+    )
+
+
+def add_projective(p, q):
+    """Projective twisted-Edwards addition (add-2008-bbjlp, a = -1):
+    needs NO T input on either operand, so it can consume the ladder's
+    T-less output for the final R subtraction. Complete for ed25519
+    (d non-square). Returns (X, Y, Z, None). ~10M + 1S."""
+    X1, Y1, Z1 = p[0], p[1], p[2]
+    X2, Y2, Z2 = q[0], q[1], q[2]
+    A = fe.mul(Z1, Z2)
+    B = fe.square(A)
+    C = fe.mul(X1, X2)
+    Dv = fe.mul(Y1, Y2)
+    E = fe.mul(fe.mul(fe.const(_D), C), Dv)
+    F = fe.sub(B, E)
+    G = fe.add(B, E)
+    X3 = fe.mul(
+        fe.mul(A, F),
+        fe.sub(
+            fe.mul(fe.add(X1, Y1), fe.add(X2, Y2)), fe.add(C, Dv)
+        ),
+    )
+    Y3 = fe.mul(fe.mul(A, G), fe.add(Dv, C))  # D - a*C, a = -1
+    Z3 = fe.mul(F, G)
+    return (X3, Y3, Z3, None)
 
 
 def base_window_table():

@@ -1,19 +1,20 @@
 """Multi-chip commit verification: shard_map over signature lanes.
 
-One XLA program = the framework's full "step" for commit verification:
+Two composable sharded programs:
 
-  1. each device runs the ed25519 verify kernel on its shard of the
-     signature lanes (ops/ed25519, pure VPU work, no communication);
-  2. each device computes a partial voting-power tally of its valid
-     lanes (masked weighted sum);
-  3. a single ``psum`` over the mesh axis reduces the tally on ICI;
-  4. every device returns the quorum verdict (tally vs threshold) and
-     the gathered per-lane verdict mask.
+  1. ``make_sharded_core`` — each device runs the ed25519 precomp
+     verify kernel on its shard of the signature lanes (ops/ed25519,
+     pure VPU work, no communication). This is what the production
+     ``verify_batch`` seam dispatches on multi-device hosts.
+  2. ``make_quorum_reducer`` — weighted voting-power tally of the
+     verdict lanes, reduced with a single ``psum`` over ICI, plus the
+     quorum compare.
 
-This mirrors the semantic of the reference's VerifyCommit
-(types/validation.go:30: sum voting power of valid signatures for the
-block, compare against 2/3 of total) — but the signature work is spread
-over chips instead of one Go routine's batch.
+Together they mirror the reference's VerifyCommit semantics
+(types/validation.go:30: sum voting power of valid signatures, compare
+against 2/3 of total) — but the signature work is spread over chips
+instead of one Go routine's batch, and the kernel graph compiles once
+independently of the (cheap) communication step.
 """
 
 from __future__ import annotations
@@ -29,23 +30,11 @@ from ..ops import ed25519 as ed
 from .mesh import DATA_AXIS
 
 
-def _local_step(msgs, lens, pks, rs, ss, powers, threshold):
-    """Per-device: verify local lanes, tally weighted power, psum."""
-    ok = ed._verify_core(msgs, lens, pks, rs, ss)
-    # int32 on-device tally: the authoritative (arbitrary-precision)
-    # tally is recomputed host-side in types/validation.py; this value
-    # drives the fast-path quorum verdict for realistic powers.
-    local_tally = jnp.sum(jnp.where(ok, powers, 0), dtype=jnp.int32)
-    tally = jax.lax.psum(local_tally, DATA_AXIS)
-    ok_all = jax.lax.all_gather(ok, DATA_AXIS, tiled=True)
-    return tally > threshold, tally, ok_all
-
-
 def make_sharded_core(mesh):
-    """Lane-sharded ``_verify_core``: per-device ZIP-215 verdicts, no
-    cross-device communication (the tally/quorum reduction lives in
-    ``make_sharded_verifier``; the host path in types/validation.py does
-    its own arbitrary-precision tally).
+    """Lane-sharded ``_verify_core_precomp``: per-device ZIP-215
+    verdicts, no cross-device communication (the tally/quorum
+    reduction lives in ``make_quorum_reducer``; the host path in
+    types/validation.py does its own arbitrary-precision tally).
 
     This is the PRODUCTION seam: ``ops/ed25519.verify_batch`` (behind
     crypto/batch.TpuBatchVerifier — the reference's injectable
@@ -53,51 +42,60 @@ def make_sharded_core(mesh):
     whenever more than one local device is visible, so every
     VerifyCommit* caller scales over the mesh transparently.
     """
-    spec_lanes = P(None, DATA_AXIS)   # (bytes, N)
-    spec_vec = P(DATA_AXIS)           # (N,)
+    spec_lanes = P(None, DATA_AXIS)     # (bytes, N)
+    spec_limbs = P(None, None, DATA_AXIS)  # (4, 20, N)
+    spec_vec = P(DATA_AXIS)             # (N,)
     fn = shard_map(
-        ed._verify_core,
+        ed._verify_core_precomp,
         mesh=mesh,
-        in_specs=(spec_lanes, spec_vec, spec_lanes, spec_lanes, spec_lanes),
+        in_specs=(
+            spec_lanes,  # msgs
+            spec_vec,    # lens
+            spec_limbs,  # precomputed A
+            spec_lanes,  # pks
+            spec_lanes,  # rs
+            spec_lanes,  # ss
+        ),
         out_specs=spec_vec,
         check_rep=False,
     )
     return jax.jit(fn)
 
 
-def make_sharded_verifier(mesh):
-    """Build the jitted multi-chip verify step for a mesh.
+def make_quorum_reducer(mesh):
+    """Tiny sharded step: weighted tally of verdict lanes + one psum
+    over ICI + quorum compare. Composes with make_sharded_core so the
+    expensive kernel graph compiles ONCE; the communication pattern
+    (the part a multi-chip dryrun must prove) compiles in seconds.
 
-    Input arrays are lane-sharded on their last axis; scalars replicated.
-
-    The on-device tally is int32: callers must keep total voting power
-    under 2^31 (the returned wrapper enforces this host-side before
-    dispatch). The production path (types/validation.py) recomputes the
-    authoritative tally host-side in arbitrary precision either way;
-    this fast-path verdict exists for callers that want the quorum
-    decision without a host round-trip per job.
+    The on-device tally is int32: the returned wrapper enforces total
+    voting power < 2^31 host-side before dispatch. The production path
+    (types/validation.py) recomputes the authoritative tally host-side
+    in arbitrary precision either way; this fast-path verdict exists
+    for callers that want the quorum decision without a host round
+    trip per job (reference VerifyCommit semantics,
+    types/validation.go:30).
     """
-    spec_lanes = P(None, DATA_AXIS)   # (bytes/limbs, N)
-    spec_vec = P(DATA_AXIS)           # (N,)
+    spec_vec = P(DATA_AXIS)
+
+    def local(ok, powers, threshold):
+        local_tally = jnp.sum(
+            jnp.where(ok, powers, 0), dtype=jnp.int32
+        )
+        tally = jax.lax.psum(local_tally, DATA_AXIS)
+        ok_all = jax.lax.all_gather(ok, DATA_AXIS, tiled=True)
+        return tally > threshold, tally, ok_all
 
     fn = shard_map(
-        _local_step,
+        local,
         mesh=mesh,
-        in_specs=(
-            spec_lanes,  # msgs (cap, N)
-            spec_vec,    # lens
-            spec_lanes,  # pks
-            spec_lanes,  # rs
-            spec_lanes,  # ss
-            spec_vec,    # powers
-            P(),         # threshold
-        ),
+        in_specs=(spec_vec, spec_vec, P()),
         out_specs=(P(), P(), spec_vec),
         check_rep=False,
     )
     jitted = jax.jit(fn)
 
-    def step(msgs, lens, pks, rs, ss, powers, threshold):
+    def step(ok, powers, threshold):
         import numpy as _np
 
         total = int(_np.asarray(powers, dtype=_np.int64).sum())
@@ -106,6 +104,6 @@ def make_sharded_verifier(mesh):
                 "total voting power overflows the int32 device tally; "
                 "use the host tally path (types/validation.py)"
             )
-        return jitted(msgs, lens, pks, rs, ss, powers, threshold)
+        return jitted(ok, powers, threshold)
 
     return step

@@ -36,6 +36,8 @@ MSG_SIGN_PROPOSAL_REQUEST = 0x05
 MSG_SIGNED_PROPOSAL_RESPONSE = 0x06
 MSG_PING_REQUEST = 0x07
 MSG_PING_RESPONSE = 0x08
+MSG_SIGN_VOTE_EXT_REQUEST = 0x09
+MSG_SIGNED_VOTE_EXT_RESPONSE = 0x0A
 MSG_ERROR_RESPONSE = 0x7F
 
 
@@ -163,13 +165,22 @@ class SignerClient:
         vote.extension_signature = signed.extension_signature
 
     def sign_vote_extension(self, chain_id: str, vote: Vote) -> None:
-        """Extension signatures ride the SIGN_VOTE round trip (the
-        server signs both when the vote carries an extension); a
-        second trip only happens if the extension was attached after
-        the vote was signed."""
-        if not vote.extension or vote.extension_signature:
+        """Dedicated round trip: the server extension-signs even an
+        EMPTY extension (matching FilePV — peers at extension-enabled
+        heights require the signature regardless of payload). The
+        fast path: a non-empty extension was already co-signed during
+        SIGN_VOTE."""
+        if vote.extension_signature:
             return
-        self.sign_vote(chain_id, vote)
+        payload = (
+            struct.pack(">H", len(chain_id))
+            + chain_id.encode()
+            + codec.encode_vote(vote)
+        )
+        rtype, body = self._call(MSG_SIGN_VOTE_EXT_REQUEST, payload)
+        if rtype != MSG_SIGNED_VOTE_EXT_RESPONSE:
+            raise RemoteSignerError("bad sign-vote-extension response")
+        vote.extension_signature = body
         if not vote.extension_signature:
             raise RemoteSignerError(
                 "signer did not produce an extension signature"
@@ -238,6 +249,16 @@ class SignerServer:
             )
         elif mtype == MSG_PING_REQUEST:
             await _send(sconn, MSG_PING_RESPONSE)
+        elif mtype == MSG_SIGN_VOTE_EXT_REQUEST:
+            (ln,) = struct.unpack(">H", body[:2])
+            chain_id = body[2 : 2 + ln].decode()
+            vote = codec.decode_vote(body[2 + ln:])
+            self.pv.sign_vote_extension(chain_id, vote)
+            await _send(
+                sconn,
+                MSG_SIGNED_VOTE_EXT_RESPONSE,
+                vote.extension_signature,
+            )
         elif mtype in (MSG_SIGN_VOTE_REQUEST, MSG_SIGN_PROPOSAL_REQUEST):
             (ln,) = struct.unpack(">H", body[:2])
             chain_id = body[2 : 2 + ln].decode()

@@ -113,7 +113,10 @@ def build_extended_commit_info(ec, last_vals):
         if i < len(ec.extended_signatures):
             s = ec.extended_signatures[i]
             flag = s.block_id_flag
-            ext, ext_sig = s.extension, s.extension_signature
+            if flag == abci.BLOCK_ID_FLAG_COMMIT:
+                # extension payloads only ride COMMIT lanes (ABCI
+                # contract; defensive against a non-conforming EC)
+                ext, ext_sig = s.extension, s.extension_signature
         votes.append(
             abci.ExtendedVoteInfo(
                 validator_address=v.address,

@@ -217,24 +217,32 @@ class VoteSet:
         base = self.make_commit()
         ext_sigs = []
         for cs, vote in zip(base.signatures, self.votes):
+            is_commit = cs.block_id_flag == BLOCK_ID_FLAG_COMMIT
             if (
                 require_extensions
-                and cs.block_id_flag == BLOCK_ID_FLAG_COMMIT
+                and is_commit
                 and not (vote and vote.extension_signature)
             ):
                 raise ValueError(
                     "commit vote without extension signature "
                     f"(validator {cs.validator_address.hex()[:12]})"
                 )
+            # extension data only rides COMMIT-flag lanes (reference
+            # ExtendedCommitSig.ValidateBasic): a vote for another
+            # block is downgraded to NIL and must not leak its payload
             ext_sigs.append(
                 ExtendedCommitSig(
                     block_id_flag=cs.block_id_flag,
                     validator_address=cs.validator_address,
                     timestamp_ns=cs.timestamp_ns,
                     signature=cs.signature,
-                    extension=vote.extension if vote else b"",
+                    extension=(
+                        vote.extension if (vote and is_commit) else b""
+                    ),
                     extension_signature=(
-                        vote.extension_signature if vote else b""
+                        vote.extension_signature
+                        if (vote and is_commit)
+                        else b""
                     ),
                 )
             )

@@ -54,6 +54,29 @@ def test_extension_signed_over_the_wire():
                 client.sign_vote_extension, "rsx-chain", vote
             )
             assert vote.extension_signature == before
+
+            # EMPTY extensions are signed too (default apps return
+            # vote_extension=b""; peers at enabled heights require the
+            # signature regardless of payload — FilePV parity)
+            vote2 = T.Vote(
+                type_=T.PRECOMMIT,
+                height=8,
+                round=0,
+                block_id=bid,
+                timestamp_ns=124,
+                validator_address=pub.address(),
+                validator_index=0,
+            )
+            await asyncio.to_thread(client.sign_vote, "rsx-chain", vote2)
+            assert not vote2.extension_signature  # no ext in sign_vote
+            await asyncio.to_thread(
+                client.sign_vote_extension, "rsx-chain", vote2
+            )
+            assert vote2.extension_signature
+            assert pub.verify(
+                vote2.extension_sign_bytes("rsx-chain"),
+                vote2.extension_signature,
+            )
         finally:
             server.stop()
             task.cancel()

@@ -94,6 +94,69 @@ def test_net_produces_verified_extensions():
     run(main())
 
 
+def test_blocksync_transfers_extended_commits():
+    """A late blocksync joiner receives + verifies extended commits
+    with the blocks (reference blocksync BlockResponse.ExtCommit), so
+    it could propose with ExtendedCommitInfo immediately."""
+    from cometbft_tpu.config.config import test_config as make_test_cfg
+    from cometbft_tpu.node.node import Node
+
+    async def main():
+        gen, pvs = make_genesis(2, chain_id="ext-sync")
+        gen.consensus_params.abci.vote_extensions_enable_height = 1
+
+        def mk(pv, i, blocksync=False):
+            cfg = make_test_cfg(".")
+            cfg.p2p.laddr = "tcp://127.0.0.1:0"
+            cfg.base.moniker = f"n{i}"
+            cfg.blocksync.enable = blocksync
+            return Node(cfg, gen, privval=pv)
+
+        vals = [mk(pvs[0], 0), mk(pvs[1], 1)]
+        for n in vals:
+            await n.start()
+        await vals[0].dial(vals[1].listen_addr)
+
+        async def wait(pred, timeout, what):
+            dl = asyncio.get_running_loop().time() + timeout
+            while asyncio.get_running_loop().time() < dl:
+                if pred():
+                    return
+                await asyncio.sleep(0.05)
+            raise TimeoutError(what)
+
+        await wait(lambda: all(n.height >= 3 for n in vals), 60, "h3")
+
+        late = mk(None, 9, blocksync=True)
+        await late.start()
+        await late.dial(vals[0].listen_addr)
+        await late.dial(vals[1].listen_addr)
+        await wait(lambda: late.height >= 3, 60, "late sync")
+
+        # blocksync supplies ECs for every height it applied; heights
+        # arriving via the consensus catch-up path (the tip at
+        # switch-over and beyond) have none — a follower needs no EC
+        # until it precommits in live rounds itself
+        assert late.height >= 3
+        with_ec = 0
+        for h in range(1, late.height):
+            raw = late.parts.block_store.load_extended_commit(h)
+            if not raw:
+                continue
+            ec = codec.decode_extended_commit(raw)
+            assert any(
+                s.extension.startswith(b"ext|%d|" % h)
+                for s in ec.extended_signatures
+                if s.for_block()
+            )
+            with_ec += 1
+        assert with_ec >= 2, "no extended commits arrived via blocksync"
+        for n in vals + [late]:
+            await n.stop()
+
+    run(main())
+
+
 def test_bad_extension_signature_rejected():
     async def main():
         gen, pvs = make_genesis(2, chain_id="ext-rej")
