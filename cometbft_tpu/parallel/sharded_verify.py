@@ -82,9 +82,8 @@ def make_quorum_reducer(mesh):
         local_tally = jnp.sum(
             jnp.where(ok, powers, 0), dtype=jnp.int32
         )
-        tally = jax.lax.psum(local_tally, DATA_AXIS)
-        ok_all = jax.lax.all_gather(ok, DATA_AXIS, tiled=True)
-        return tally > threshold, tally, ok_all
+        tally = jax.lax.psum(local_tally, DATA_AXIS)  # rides ICI
+        return tally > threshold, tally, ok
 
     fn = shard_map(
         local,
