@@ -242,6 +242,14 @@ def _expand_pubkey(pk: bytes):
 # kernel compile happens at tiny per-device shapes
 PAD_MIN = 128
 
+# Width cutoff between the two kernels (measured on v5e, uncontended):
+# - small batches: the ~254-deep decompression chain dominates, so the
+#   precomp kernel (host-expanded A, only R pays the sqrt chain) wins;
+# - large batches: depth amortizes across lanes and the precomp path's
+#   stacked (4,20,N) A input costs MORE than it saves (slice reads
+#   defeat the tuple-of-limbs fusion: 550ms vs 363ms @131072 lanes).
+PRECOMP_MAX_LANES = 4096
+
 
 def _pad_n(n: int) -> int:
     """Pad batch to limit recompilation: powers of two >= PAD_MIN."""
@@ -261,22 +269,25 @@ _SHARDED_FNS: dict = {}
 LAST_DISPATCH: dict = {}
 
 
-def _sharded_fn():
-    """(n_devices, fn): lane-sharded precomp verify over all local
-    devices, or (1, None) when single-device / uninitializable
-    backend."""
+def _sharded_fn(precomp: bool):
+    """(n_devices, fn): lane-sharded verify (precomp or plain kernel)
+    over all local devices, or (1, None) when single-device /
+    uninitializable backend."""
     try:
         n = len(jax.devices())
     except Exception:  # pragma: no cover - backend init failure
         return 1, None
     if n <= 1:
         return 1, None
-    if n not in _SHARDED_FNS:
+    key = (n, precomp)
+    if key not in _SHARDED_FNS:
         from ..parallel.mesh import make_mesh
         from ..parallel.sharded_verify import make_sharded_core
 
-        _SHARDED_FNS[n] = make_sharded_core(make_mesh(n))
-    return n, _SHARDED_FNS[n]
+        _SHARDED_FNS[key] = make_sharded_core(
+            make_mesh(n), precomp=precomp
+        )
+    return n, _SHARDED_FNS[key]
 
 
 def verify_batch(items) -> np.ndarray:
@@ -298,46 +309,58 @@ def verify_batch(items) -> np.ndarray:
     max_len = max(len(m) for m, _, _ in items)
     cap = bucket_cap(max_len)
     np_ = _pad_n(n)
-    n_dev, sharded = _sharded_fn()
-    if sharded is not None and np_ % n_dev:
+    n_dev, probe = _sharded_fn(True)
+    if probe is not None and np_ % n_dev:
         np_ += n_dev - (np_ % n_dev)
+
+    # kernel choice by PER-DEVICE lane width (see PRECOMP_MAX_LANES):
+    # precomp (host-expanded A) below the cutoff — the depth-bound
+    # decompression dominates there — plain above it, where depth
+    # amortizes and the stacked A input costs more than it saves
+    use_precomp = (np_ // n_dev) <= PRECOMP_MAX_LANES
+    sharded = None
+    if probe is not None:
+        _, sharded = _sharded_fn(use_precomp)
 
     msgs = np.zeros((cap, np_), np.uint8)
     lens = np.zeros(np_, np.int32)
     pks = np.zeros((32, np_), np.uint8)
     rs = np.zeros((32, np_), np.uint8)
     ss = np.zeros((32, np_), np.uint8)
-    a_arr = np.zeros((4, fe.NLIMBS, np_), np.int32)
+    a_arr = (
+        np.zeros((4, fe.NLIMBS, np_), np.int32) if use_precomp else None
+    )
     bad = np.zeros(np_, bool)
     for i, (m, pk, sig) in enumerate(items):
         if len(pk) != 32 or len(sig) != 64:
             bad[i] = True
             continue
-        A = _expand_pubkey(bytes(pk))
-        if A is None:  # pubkey fails ZIP-215 decompression
-            bad[i] = True
-            continue
-        a_arr[:, :, i] = A
+        if use_precomp:
+            A = _expand_pubkey(bytes(pk))
+            if A is None:  # pubkey fails ZIP-215 decompression
+                bad[i] = True
+                continue
+            a_arr[:, :, i] = A
         msgs[: len(m), i] = np.frombuffer(m, np.uint8)
         lens[i] = len(m)
         pks[:, i] = np.frombuffer(pk, np.uint8)
         rs[:, i] = np.frombuffer(sig[:32], np.uint8)
         ss[:, i] = np.frombuffer(sig[32:], np.uint8)
 
-    fn = sharded if sharded is not None else verify_core_precomp_jit
     LAST_DISPATCH.clear()
     LAST_DISPATCH.update(
-        sharded=sharded is not None, n_devices=n_dev, lanes=np_, cap=cap
+        sharded=sharded is not None,
+        n_devices=n_dev,
+        lanes=np_,
+        cap=cap,
+        precomp=use_precomp,
     )
-    out = np.array(
-        fn(
-            jnp.asarray(msgs),
-            jnp.asarray(lens),
-            jnp.asarray(a_arr),
-            jnp.asarray(pks),
-            jnp.asarray(rs),
-            jnp.asarray(ss),
-        )
-    )[:n]
+    if use_precomp:
+        fn = sharded if sharded is not None else verify_core_precomp_jit
+        arrays = (msgs, lens, a_arr, pks, rs, ss)
+    else:
+        fn = sharded if sharded is not None else verify_core_jit
+        arrays = (msgs, lens, pks, rs, ss)
+    out = np.array(fn(*(jnp.asarray(a) for a in arrays)))[:n]
     out[bad[:n]] = False
     return out

@@ -30,11 +30,14 @@ from ..ops import ed25519 as ed
 from .mesh import DATA_AXIS
 
 
-def make_sharded_core(mesh):
-    """Lane-sharded ``_verify_core_precomp``: per-device ZIP-215
-    verdicts, no cross-device communication (the tally/quorum
-    reduction lives in ``make_quorum_reducer``; the host path in
-    types/validation.py does its own arbitrary-precision tally).
+def make_sharded_core(mesh, precomp: bool = True):
+    """Lane-sharded verify kernel: per-device ZIP-215 verdicts, no
+    cross-device communication (the tally/quorum reduction lives in
+    ``make_quorum_reducer``; the host path in types/validation.py does
+    its own arbitrary-precision tally). ``precomp`` selects the
+    host-expanded-pubkey kernel (small per-device widths) or the plain
+    kernel (bulk widths) — same width rule as single-device dispatch
+    (ops/ed25519.PRECOMP_MAX_LANES).
 
     This is the PRODUCTION seam: ``ops/ed25519.verify_batch`` (behind
     crypto/batch.TpuBatchVerifier — the reference's injectable
@@ -45,17 +48,29 @@ def make_sharded_core(mesh):
     spec_lanes = P(None, DATA_AXIS)     # (bytes, N)
     spec_limbs = P(None, None, DATA_AXIS)  # (4, 20, N)
     spec_vec = P(DATA_AXIS)             # (N,)
-    fn = shard_map(
-        ed._verify_core_precomp,
-        mesh=mesh,
-        in_specs=(
+    if precomp:
+        inner = ed._verify_core_precomp
+        in_specs = (
             spec_lanes,  # msgs
             spec_vec,    # lens
             spec_limbs,  # precomputed A
             spec_lanes,  # pks
             spec_lanes,  # rs
             spec_lanes,  # ss
-        ),
+        )
+    else:
+        inner = ed._verify_core
+        in_specs = (
+            spec_lanes,  # msgs
+            spec_vec,    # lens
+            spec_lanes,  # pks
+            spec_lanes,  # rs
+            spec_lanes,  # ss
+        )
+    fn = shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=in_specs,
         out_specs=spec_vec,
         check_rep=False,
     )
