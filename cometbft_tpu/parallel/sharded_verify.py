@@ -24,7 +24,22 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+try:  # jax >= 0.8: top-level shard_map, check_rep renamed check_vma
+    from jax import shard_map as _shard_map
+
+    # default mirrors the jax.experimental.shard_map fallback (True) so
+    # call sites behave identically across jax versions
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=True):
+        return _shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_rep,
+        )
+
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
 
 from ..ops import ed25519 as ed
 from .mesh import DATA_AXIS

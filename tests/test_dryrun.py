@@ -1,0 +1,126 @@
+"""Driver-dryrun regression tests (VERDICT r2 next-round #1).
+
+Two rounds of red MULTICHIP signals came from budget mismatches between
+the dryrun's internal kernel-leg budget and the driver's overall
+timeout — nothing in the default test lane ran the dryrun end to end,
+so the regression shipped unseen. These tests close that hole:
+
+  1. the default kernel-leg budget is pinned to fit the driver window;
+  2. the FULL dryrun flow (subprocess, default budget, cold or warm
+     cache) must finish under a hard wall-clock cap;
+  3. the quorum reducer — the collective the dryrun exists to prove —
+     runs directly on the 8-device CPU mesh.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENTRY = os.path.join(REPO, "__graft_entry__.py")
+
+# The driver killed the round-2 dryrun from outside (rc=124) before the
+# 600s kernel-leg budget elapsed; anything near that is too slow. The
+# full dryrun must fit comfortably inside this cap including process
+# startup and the quorum-step compile.
+DRYRUN_WALL_CAP_S = 240
+
+
+def _load_entry_module():
+    spec = importlib.util.spec_from_file_location("graft_entry", ENTRY)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_default_kernel_budget_fits_driver_window():
+    mod = _load_entry_module()
+    assert mod.DEFAULT_KERNEL_BUDGET_S <= 60, (
+        "kernel-leg budget must leave the driver's overall dryrun "
+        "timeout room for startup + quorum compile (MULTICHIP_r02 "
+        "was rc=124 with a 600s budget)"
+    )
+
+
+def test_dryrun_flow_completes_under_wall_cap():
+    """Run the real dryrun exactly as the driver does — fresh process,
+    default budgets — under a hard wall clock. A regression that pushes
+    the dryrun past the driver's window fails HERE, not in the round
+    report."""
+    env = dict(os.environ)
+    env.pop("GRAFT_DRYRUN_KERNEL_BUDGET_S", None)
+    env.pop("GRAFT_DRYRUN_KERNEL", None)  # ambient =inline is unbudgeted
+    try:
+        proc = subprocess.run(
+            [sys.executable, ENTRY, "--dryrun", "8"],
+            env=env,
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=DRYRUN_WALL_CAP_S,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.fail(
+            f"dryrun exceeded the {DRYRUN_WALL_CAP_S}s wall cap — the "
+            "driver would have killed it (MULTICHIP rc=124 regression)"
+        )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "dryrun_multichip OK" in proc.stdout, proc.stdout[-2000:]
+    # Record which mode the kernel leg ran in — a hang in the sharded
+    # kernel leg must not silently ship as "green via fallback".
+    # Until the kernel HLO compiles on CPU inside the budget
+    # (docs/PERF.md), host-verifier-fallback is the EXPECTED mode on
+    # this box; once it does, GRAFT_REQUIRE_KERNEL_LEG=1 makes the
+    # fallback a failure.
+    mode_line = next(
+        l for l in proc.stdout.splitlines() if "kernel_leg=" in l
+    )
+    assert (
+        "sharded-kernel" in mode_line
+        or "host-verifier-fallback" in mode_line
+    ), mode_line
+    if os.environ.get("GRAFT_REQUIRE_KERNEL_LEG"):
+        assert "sharded-kernel" in mode_line, mode_line
+
+
+def test_quorum_reducer_on_8_device_mesh():
+    """The psum collective on the actual 8-device CPU mesh: weighted
+    tally + quorum compare, one invalid lane."""
+    from cometbft_tpu.parallel.mesh import make_mesh
+    from cometbft_tpu.parallel.sharded_verify import make_quorum_reducer
+
+    assert len(jax.devices()) >= 8
+    mesh = make_mesh(8)
+    n = 16
+    ok = np.ones(n, bool)
+    ok[5] = False
+    powers = np.arange(1, n + 1, dtype=np.int32)
+    total = int(powers.sum())
+    reducer = make_quorum_reducer(mesh)
+    quorum, tally, ok_lanes = reducer(
+        jnp.asarray(ok), jnp.asarray(powers), jnp.int32(total * 2 // 3)
+    )
+    want_tally = total - 6
+    assert int(tally) == want_tally
+    assert bool(quorum) == (want_tally * 3 > total * 2)
+    assert list(np.asarray(ok_lanes)) == list(ok)
+
+
+def test_quorum_reducer_rejects_int32_overflow():
+    from cometbft_tpu.parallel.mesh import make_mesh
+    from cometbft_tpu.parallel.sharded_verify import make_quorum_reducer
+
+    mesh = make_mesh(8)
+    reducer = make_quorum_reducer(mesh)
+    powers = np.full(8, 2**28, np.int64)  # sums past 2**31
+    with pytest.raises(ValueError, match="voting power"):
+        reducer(
+            jnp.ones(8, bool), jnp.asarray(powers), jnp.int32(0)
+        )
