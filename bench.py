@@ -190,6 +190,12 @@ def _corpus(n_blocks: int):
     meta_path = os.path.join(home, "meta.json")
 
     if os.path.exists(meta_path):
+        # resume: the persisted genesis is authoritative — regenerating
+        # it (fresh genesis_time_ns) while appending to the existing
+        # store would leave earlier blocks predating the new genesis,
+        # so replay/bisect would verify against a genesis that does not
+        # match the stored chain (ADVICE r2). meta.json is only ever
+        # written on from-scratch creation below.
         with open(meta_path) as f:
             meta = json.load(f)
         privs = [
@@ -197,34 +203,32 @@ def _corpus(n_blocks: int):
             for s in meta["seeds"]
         ]
         gen = GenesisDoc.from_json(meta["genesis"])
-        cfg = test_config(home)
-        cfg.base.db_backend = "sqlite"
-        parts = build_node(gen, None, config=cfg, home=home)
-        if parts.block_store.height() >= n_blocks:
-            return gen, privs, parts
-        parts.close_stores()
-
-    os.makedirs(home, exist_ok=True)
-    rng = np.random.default_rng(7)
-    privs = [Ed25519PrivKey.from_seed(rng.bytes(32)) for _ in range(N_VALS)]
-    vals = [T.Validator(p.pub_key(), 10) for p in privs]
-    gen = GenesisDoc(
-        chain_id="bench-chain",
-        validators=vals,
-        genesis_time_ns=time.time_ns()
-        - (n_blocks + 120) * 1_000_000_000,
-    )
-    with open(meta_path, "w") as f:
-        json.dump(
-            {
-                "seeds": [p.seed.hex() for p in privs],
-                "genesis": gen.to_json(),
-            },
-            f,
+    else:
+        os.makedirs(home, exist_ok=True)
+        rng = np.random.default_rng(7)
+        privs = [
+            Ed25519PrivKey.from_seed(rng.bytes(32)) for _ in range(N_VALS)
+        ]
+        vals = [T.Validator(p.pub_key(), 10) for p in privs]
+        gen = GenesisDoc(
+            chain_id="bench-chain",
+            validators=vals,
+            genesis_time_ns=time.time_ns()
+            - (n_blocks + 120) * 1_000_000_000,
         )
+        with open(meta_path, "w") as f:
+            json.dump(
+                {
+                    "seeds": [p.seed.hex() for p in privs],
+                    "genesis": gen.to_json(),
+                },
+                f,
+            )
     cfg = test_config(home)
     cfg.base.db_backend = "sqlite"
     parts = build_node(gen, None, config=cfg, home=home)
+    if parts.block_store.height() >= n_blocks:
+        return gen, privs, parts
     t0 = time.time()
     done = parts.block_store.height()
     while done < n_blocks:

@@ -30,6 +30,15 @@ _log = get_logger("blocksync")
 
 VERIFY_WINDOW = 32
 SWITCH_TO_CONSENSUS_INTERVAL_S = 1.0
+# Apply a block without its extended commit after this many fetches of
+# the height came back EC-less (liveness: no reachable peer may hold
+# the EC — see _check_extended_commit).
+EC_MISS_TOLERANCE = 2
+
+
+class MissingExtendedCommit(ValueError):
+    """Peer served a block without an EC at an extension-enabled
+    height: possibly an honest gap, never a verification failure."""
 
 
 class BlockSyncReactor:
@@ -55,6 +64,7 @@ class BlockSyncReactor:
         self.window = verify_window
         self.local_blocks_chain = local_blocks_chain
         self.blocks_applied = 0
+        self._ec_misses: dict = {}  # height -> EC-less fetch count
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
 
@@ -186,6 +196,25 @@ class BlockSyncReactor:
                 break
             try:
                 ec_bytes = self._check_extended_commit(h, blk, peer)
+            except MissingExtendedCommit as e:
+                misses = self._ec_misses.get(h, 0) + 1
+                self._ec_misses[h] = misses
+                if misses < EC_MISS_TOLERANCE:
+                    # honest peers can lack the EC: refetch WITHOUT
+                    # banning so another peer gets a chance to serve it
+                    _log.info(
+                        "peer lacks extended commit, refetching",
+                        height=h,
+                        attempt=misses,
+                    )
+                    self.pool.redo_request(h, None)
+                    break
+                _log.info(
+                    "applying block without extended commit",
+                    height=h,
+                    attempts=misses,
+                )
+                ec_bytes = None
             except Exception as e:
                 _log.error(
                     "extended commit check failed, refetching",
@@ -229,67 +258,44 @@ class BlockSyncReactor:
                 self.state = self.block_exec.apply_verified_block(
                     self.state, bid, blk
                 )
+            self._ec_misses.pop(h, None)
             self.pool.pop_request()
             self.blocks_applied += 1
             applied += 1
         return applied
 
     def _check_extended_commit(self, h, blk, peer):
-        """When vote extensions are enabled at height h the peer MUST
+        """When vote extensions are enabled at height h the peer SHOULD
         supply a valid extended commit with the block (reference
         blocksync/reactor.go:648): commit sigs verify against the
         valset, extension signatures verify per lane, and the payload
         binds to this block. Returns the raw bytes to persist (or None
-        when extensions are disabled)."""
+        when extensions are disabled).
+
+        A peer that simply LACKS the EC is distinguished from one that
+        sent an invalid EC: an honest node may legitimately hold a
+        block without its EC (e.g. it tolerated missing ECs itself
+        while syncing before this fix existed, or pruned them), so a
+        missing payload raises MissingExtendedCommit — retried without
+        banning, and tolerated once EC_MISS_TOLERANCE distinct fetches
+        came back bare (otherwise a network where no reachable peer
+        holds the EC for one height would stall blocksync forever)."""
         enabled = self.state.consensus_params.vote_extensions_enabled(h)
         ec_bytes = getattr(blk, "_ec_bytes", None)
         if not enabled:
             return None  # ignore unsolicited payloads
         if not ec_bytes:
-            raise ValueError(
+            raise MissingExtendedCommit(
                 "peer omitted extended commit at extension-enabled "
                 f"height {h}"
             )
-        from ..types.canonical import vote_extension_sign_bytes
-        from ..crypto import batch as crypto_batch
-
         ec = codec.decode_extended_commit(ec_bytes)
-        if ec.height != h or ec.block_id.hash != blk.hash():
-            raise ValueError("extended commit does not bind to block")
-        # full commit verification (all signatures + quorum)
-        T.verify_commit(
+        T.verify_extended_commit(
             self.state.chain_id,
             self.state.validators,
-            ec.block_id,
+            blk.hash(),
             h,
-            ec.to_commit(),
+            ec,
             cache=self.sig_cache,
         )
-        verifier = crypto_batch.create_batch_verifier()
-        for i, s in enumerate(ec.extended_signatures):
-            if not s.for_block():
-                # reference ExtendedCommitSig.ValidateBasic: extension
-                # data is forbidden off COMMIT lanes — unverifiable
-                # attacker bytes must never be persisted / reach the app
-                if s.extension or s.extension_signature:
-                    raise ValueError(
-                        f"sig {i}: extension data on non-commit lane"
-                    )
-                continue
-            if not s.extension_signature:
-                raise ValueError(
-                    f"commit sig {i} missing extension signature"
-                )
-            val = self.state.validators.get_by_index(i)
-            verifier.add(
-                val.pub_key,
-                vote_extension_sign_bytes(
-                    self.state.chain_id, h, ec.round, s.extension
-                ),
-                s.extension_signature,
-            )
-        if len(verifier):
-            all_ok, _ = verifier.verify()
-            if not all_ok:
-                raise ValueError("invalid extension signature")
         return ec_bytes

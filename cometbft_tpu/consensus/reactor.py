@@ -26,7 +26,7 @@ import struct
 import time
 import traceback
 from dataclasses import dataclass, field
-from typing import Dict, Set, Tuple
+from typing import Dict, Optional, Set, Tuple
 
 from .. import types as T
 from ..p2p.node_info import ChannelDescriptor
@@ -62,6 +62,11 @@ MAX_GOSSIP_PARTS_PER_TICK = 8
 class CommitBlockMessage:
     block: T.Block
     commit: T.Commit
+    # raw extended commit when the sender holds one for this height —
+    # catch-up must propagate ECs like every other commit path
+    # (reference SaveBlockWithExtendedCommit), or nodes that caught up
+    # through consensus can never serve the EC to blocksync joiners
+    ec_bytes: Optional[bytes] = None
 
 
 @dataclass
@@ -102,12 +107,17 @@ def encode_vote_msg(v: T.Vote) -> bytes:
     return bytes([MSG_VOTE]) + codec.encode_vote(v)
 
 
-def encode_commit_block(block: T.Block, commit: T.Commit) -> bytes:
-    return (
+def encode_commit_block(
+    block: T.Block, commit: T.Commit, ec_bytes: Optional[bytes] = None
+) -> bytes:
+    out = (
         bytes([MSG_COMMIT_BLOCK])
         + proto.field_bytes(1, codec.encode_block(block))
         + proto.field_bytes(2, codec.encode_commit(commit))
     )
+    if ec_bytes:
+        out += proto.field_bytes(3, ec_bytes)
+    return out
 
 
 def encode_has_vote(height: int, round_: int, type_: int, index: int) -> bytes:
@@ -308,7 +318,13 @@ class ConsensusReactor(Reactor):
                             sent_at[ckey] = now
                             await peer.send(
                                 DATA_CHANNEL,
-                                encode_commit_block(block, commit),
+                                encode_commit_block(
+                                    block,
+                                    commit,
+                                    self.block_store.load_extended_commit(
+                                        prs.height
+                                    ),
+                                ),
                             )
                     continue
                 if prs.height > rs.height:
@@ -434,9 +450,10 @@ class ConsensusReactor(Reactor):
             m = proto.parse(body)
             block = codec.decode_block(proto.get1(m, 1, b""))
             commit = codec.decode_commit(proto.get1(m, 2, b""))
+            ec_bytes = proto.get1(m, 3, b"") or None
             self.cs.enqueue_nowait(
                 "commit_block",
-                CommitBlockMessage(block, commit),
+                CommitBlockMessage(block, commit, ec_bytes),
                 peer.peer_id,
             )
         else:

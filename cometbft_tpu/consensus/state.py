@@ -262,11 +262,44 @@ class ConsensusState:
             return
         try:
             T.verify_commit(
-                self.state.chain_id, rs.validators, bid, block.height, commit
+                self.state.chain_id,
+                rs.validators,
+                bid,
+                block.height,
+                commit,
+                cache=self.sig_cache,
             )
         except Exception:
             return
         self.ingest_verified_block(block, parts, commit)
+        # persist the EC the sender shipped alongside (reference
+        # SaveBlockWithExtendedCommit on every commit path): without
+        # this, a node that caught up here can never serve the EC to a
+        # future blocksync joiner. Invalid/missing EC never rejects the
+        # block — the plain commit already verified.
+        ec_bytes = getattr(payload, "ec_bytes", None)
+        if ec_bytes and self.state.consensus_params.vote_extensions_enabled(
+            block.height
+        ):
+            if not self.block_store.load_extended_commit(block.height):
+                try:
+                    # the EC's embedded commit carries the same
+                    # precommit sigs just verified above: with the
+                    # shared cache the re-check is near-free and only
+                    # the extension lanes cost real verifies
+                    T.verify_extended_commit(
+                        self.state.chain_id,
+                        rs.validators,
+                        bid.hash,
+                        block.height,
+                        codec.decode_extended_commit(ec_bytes),
+                        cache=self.sig_cache,
+                    )
+                    self.block_store.save_extended_commit(
+                        block.height, ec_bytes
+                    )
+                except Exception:
+                    traceback.print_exc()
 
     def ingest_verified_block(self, block, parts, commit):
         """Adaptive-sync ingest (reference consensus/state_ingest.go:231

@@ -27,6 +27,15 @@ from ..utils.log import get_logger
 
 _log = get_logger("coalesce")
 
+
+def _host_verify_one(pk, sign_bytes: bytes, sig: bytes) -> bool:
+    """Per-item host verification (OpenSSL/ref path via PubKey.verify);
+    the dispatch-failure fallback. Never raises."""
+    try:
+        return bool(pk.verify(sign_bytes, sig))
+    except Exception:
+        return False
+
 # window long enough to collect a gossip burst, short enough to add no
 # visible latency to a round (consensus timeouts are 100ms+)
 DEFAULT_WINDOW_S = 0.002
@@ -103,11 +112,27 @@ class CoalescingVerifier:
             # off the event loop: the batch may compile/dispatch to the
             # device or grind host crypto — both release the GIL
             _, oks = await asyncio.to_thread(verifier.verify)
-        except Exception as e:  # backend failure = every lane invalid
+        except Exception as e:
+            # A transient backend/device failure must not discard a
+            # whole wave of valid votes (the reactor already announced
+            # has_vote for them, so they would never be re-gossiped and
+            # round liveness degrades). Resolve each lane by per-item
+            # host verification instead — correctness is identical, the
+            # batch was only ever an optimization.
             _log.error(
-                "batch verify dispatch failed", n=len(items), err=repr(e)
+                "batch verify dispatch failed; falling back to per-item "
+                "host verification",
+                n=len(items),
+                err=repr(e),
             )
-            oks = [False] * len(items)
+
+            def _host_verify_all():
+                return [
+                    _host_verify_one(pk, sb, sig)
+                    for pk, sb, sig, _fut in items
+                ]
+
+            oks = await asyncio.to_thread(_host_verify_all)
         for (pk, sb, sig, fut), ok in zip(items, oks):
             if ok and self.cache is not None:
                 self.cache.add(sb, sig, pk.key_bytes)

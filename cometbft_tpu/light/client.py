@@ -217,6 +217,13 @@ class Client:
         must equal hash(header(h-1)) (reference light/client.go
         backwards: no signature checks needed — the chain of hashes is
         anchored at the already-trusted block).
+
+        Each hop additionally enforces what the reference's
+        VerifyBackwards (light/verifier.go) does beyond the hash link:
+        chain-id match, exact height adjacency, and time monotonicity
+        (untrusted.Time strictly before trusted.Time) — a primary must
+        not be able to serve hash-chained headers with out-of-order
+        times or a foreign chain id.
         """
         cur = trusted
         while cur.height > target.height:
@@ -232,7 +239,19 @@ class Client:
                 else self.primary.light_block(lower_h)
             )
             if lower.height != lower_h:
+                # also exact adjacency: lower_h == cur.height - 1 and
+                # LightBlock.height IS header.height
                 raise LightClientError("provider returned wrong height")
+            if lower.header.chain_id != self.chain_id:
+                raise LightClientError(
+                    f"header at {lower_h} from wrong chain "
+                    f"{lower.header.chain_id!r}"
+                )
+            if lower.header.time_ns >= cur.header.time_ns:
+                raise LightClientError(
+                    f"non-monotonic header time at {lower_h}: "
+                    f"{lower.header.time_ns} >= {cur.header.time_ns}"
+                )
             if lower.hash() != want.hash:
                 raise LightClientError(
                     f"header hash chain broken at {lower_h}"

@@ -36,6 +36,7 @@ from .validation import (  # noqa: F401
     verify_commit,
     verify_commit_light,
     verify_commit_light_trusting,
+    verify_extended_commit,
 )
 from .validator_set import (  # noqa: F401
     Validator,

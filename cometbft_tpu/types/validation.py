@@ -299,3 +299,59 @@ def verify_commit_light_trusting(
         raise ErrNotEnoughVotingPower(
             f"trusted tally {tallied} <= {trust_level} of {total}"
         )
+
+
+def verify_extended_commit(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_hash: bytes,
+    height: int,
+    ec,
+    cache: Optional[SignatureCache] = None,
+) -> None:
+    """Full extended-commit verification, shared by every path that
+    persists an EC received from a peer (blocksync block responses and
+    the consensus catch-up gossip — the analog of the checks guarding
+    reference SaveBlockWithExtendedCommit, blocksync/reactor.go:648):
+
+      * the EC binds to this height + block hash;
+      * the embedded plain commit fully verifies against ``vals``;
+      * non-commit lanes carry no extension data (reference
+        ExtendedCommitSig.ValidateBasic — unverifiable attacker bytes
+        must never be persisted / reach the app);
+      * every commit lane has an extension signature and all of them
+        verify in one batch.
+
+    Raises CommitVerifyError on any failure.
+    """
+    from .canonical import vote_extension_sign_bytes
+
+    if ec.height != height or ec.block_id.hash != block_hash:
+        raise CommitVerifyError("extended commit does not bind to block")
+    verify_commit(
+        chain_id, vals, ec.block_id, height, ec.to_commit(), cache=cache
+    )
+    items = []
+    for i, s in enumerate(ec.extended_signatures):
+        if not s.for_block():
+            if s.extension or s.extension_signature:
+                raise CommitVerifyError(
+                    f"sig {i}: extension data on non-commit lane"
+                )
+            continue
+        if not s.extension_signature:
+            raise CommitVerifyError(
+                f"commit sig {i} missing extension signature"
+            )
+        val = vals.get_by_index(i)
+        items.append(
+            (
+                val.pub_key,
+                vote_extension_sign_bytes(
+                    chain_id, height, ec.round, s.extension
+                ),
+                s.extension_signature,
+            )
+        )
+    if not all(_run_batch(items, cache)):
+        raise CommitVerifyError("invalid extension signature")

@@ -110,7 +110,14 @@ class StorePeerClient:
             import asyncio
 
             await asyncio.sleep(self.delay_s)
-        return self.node.block_store.load_block(height)
+        blk = self.node.block_store.load_block(height)
+        if blk is not None:
+            # mirror the net reactor: ship the stored extended commit
+            # out-of-band (blocksync/net_reactor.py MSG_BLOCK_RESPONSE)
+            ec = self.node.block_store.load_extended_commit(height)
+            if ec:
+                blk._ec_bytes = ec
+        return blk
 
 
 class TamperingPeerClient(StorePeerClient):

@@ -165,3 +165,43 @@ def test_150_validator_vote_wave_two_dispatches():
             await cs.stop()
 
     run(main())
+
+
+def test_dispatch_failure_falls_back_to_host_verification(monkeypatch):
+    """ADVICE r2 (low): a transient backend/device error must not mark
+    a whole wave invalid — the reactor already announced has_vote, so
+    the dropped votes would never be re-gossiped. Per-item host
+    verification resolves the lanes instead."""
+    from cometbft_tpu.crypto import batch as crypto_batch
+
+    class ExplodingVerifier(crypto_batch.BatchVerifier):
+        def __init__(self):
+            self.items = []
+
+        def add(self, pk, msg, sig):
+            self.items.append((pk, msg, sig))
+
+        def __len__(self):
+            return len(self.items)
+
+        def verify(self):
+            raise RuntimeError("device went away")
+
+    monkeypatch.setattr(
+        crypto_batch, "create_batch_verifier", lambda: ExplodingVerifier()
+    )
+
+    async def main():
+        v = CoalescingVerifier(window_s=0.005)
+        privs = [Ed25519PrivKey.generate() for _ in range(6)]
+        futs = []
+        for i, p in enumerate(privs):
+            msg = b"wave|%d" % i
+            sig = p.sign(msg)
+            if i == 3:
+                sig = sig[:-1] + bytes([sig[-1] ^ 1])  # one bad lane
+            futs.append(v.submit(p.pub_key(), msg, sig))
+        got = await asyncio.gather(*futs)
+        assert got == [i != 3 for i in range(6)]
+
+    run(main())

@@ -80,3 +80,67 @@ def test_backwards_rejects_forged_header(chain):
     )
     with pytest.raises(LightClientError, match="chain broken"):
         client.verify_light_block_at_height(5)
+
+
+def test_backwards_rejects_non_monotonic_time(chain):
+    """ADVICE r2 (low): a primary serving hash-chained headers with
+    out-of-order times must be rejected (reference VerifyBackwards
+    checks untrusted.Time < trusted.Time on every hop). The hash chain
+    itself breaks when a header is modified, so the tamper here swaps
+    the WHOLE hop: provider serves a header whose time is pushed
+    forward — the hash-link check would catch the edit, but the time
+    check must fire FIRST (defense in depth; ordering asserted via the
+    error message)."""
+    import dataclasses
+
+    class TimeWarp(StoreBackedProvider):
+        def light_block(self, height):
+            lb = super().light_block(height)
+            if height == 9:
+                lb = type(lb)(
+                    dataclasses.replace(
+                        lb.header,
+                        # jump past the trust root's time
+                        time_ns=lb.header.time_ns + 10**15,
+                    ),
+                    lb.commit,
+                    lb.validator_set,
+                )
+            return lb
+
+    gen, node = chain
+    provider = TimeWarp(gen.chain_id, node.block_store, node.state_store)
+    root = provider.light_block(12)
+    client = Client(
+        gen.chain_id,
+        TrustOptions(period_ns=3600 * 10**9, height=12, hash=root.hash()),
+        provider,
+    )
+    with pytest.raises(LightClientError, match="non-monotonic"):
+        client.verify_light_block_at_height(5)
+
+
+def test_backwards_rejects_wrong_chain_id(chain):
+    gen, node = chain
+    import dataclasses
+
+    class WrongChain(StoreBackedProvider):
+        def light_block(self, height):
+            lb = super().light_block(height)
+            if height == 9:
+                lb = type(lb)(
+                    dataclasses.replace(lb.header, chain_id="evil"),
+                    lb.commit,
+                    lb.validator_set,
+                )
+            return lb
+
+    provider = WrongChain(gen.chain_id, node.block_store, node.state_store)
+    root = provider.light_block(12)
+    client = Client(
+        gen.chain_id,
+        TrustOptions(period_ns=3600 * 10**9, height=12, hash=root.hash()),
+        provider,
+    )
+    with pytest.raises((LightClientError, ValueError), match="chain"):
+        client.verify_light_block_at_height(5)

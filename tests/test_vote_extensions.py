@@ -133,24 +133,22 @@ def test_blocksync_transfers_extended_commits():
         await late.dial(vals[1].listen_addr)
         await wait(lambda: late.height >= 3, 60, "late sync")
 
-        # blocksync supplies ECs for every height it applied; heights
-        # arriving via the consensus catch-up path (the tip at
-        # switch-over and beyond) have none — a follower needs no EC
-        # until it precommits in live rounds itself
+        # EVERY commit path persists the EC (reference
+        # SaveBlockWithExtendedCommit): blocksync saves it with each
+        # applied block, and the consensus catch-up gossip now ships it
+        # in MSG_COMMIT_BLOCK — so the late joiner can itself serve ECs
+        # to future joiners at every height it holds
         assert late.height >= 3
-        with_ec = 0
-        for h in range(1, late.height):
+        snapshot_h = late.height
+        for h in range(1, snapshot_h + 1):
             raw = late.parts.block_store.load_extended_commit(h)
-            if not raw:
-                continue
+            assert raw, f"no extended commit persisted at height {h}"
             ec = codec.decode_extended_commit(raw)
             assert any(
                 s.extension.startswith(b"ext|%d|" % h)
                 for s in ec.extended_signatures
                 if s.for_block()
             )
-            with_ec += 1
-        assert with_ec >= 2, "no extended commits arrived via blocksync"
         for n in vals + [late]:
             await n.stop()
 
@@ -206,5 +204,46 @@ def test_bad_extension_signature_rejected():
             assert rs.votes.precommits(0).get_vote(idx) is None
         finally:
             await cs.stop()
+
+    run(main())
+
+
+def test_blocksync_tolerates_peers_lacking_extended_commits():
+    """ADVICE r2 (medium): an honest peer may hold blocks WITHOUT their
+    extended commits (it pruned them, or tolerated missing ECs while
+    syncing itself). Blocksync must distinguish that from a bad EC:
+    retry without banning, then apply bare once EC_MISS_TOLERANCE
+    fetches came back EC-less — a network where NO reachable peer holds
+    the EC for a height must not stall the joiner forever."""
+    from cometbft_tpu.blocksync.reactor import BlockSyncReactor
+    from cometbft_tpu.utils.chaingen import StorePeerClient, make_chain
+
+    async def main():
+        gen, pvs = make_genesis(3, chain_id="ext-miss")
+        gen.consensus_params.abci.vote_extensions_enable_height = 1
+        # chaingen signs plain commits only: the stores hold NO extended
+        # commits at any height, exactly the stalling scenario
+        src = make_chain(gen, [pv.priv_key for pv in pvs], 10)
+        assert src.block_store.load_extended_commit(3) is None
+
+        fresh = build_node(gen, None)
+        caught = asyncio.Event()
+        reactor = BlockSyncReactor(
+            fresh.state,
+            fresh.block_exec,
+            fresh.block_store,
+            on_caught_up=lambda st: caught.set(),
+        )
+        reactor.pool.set_peer_range(
+            "src", StorePeerClient(src), 1, src.block_store.height()
+        )
+        await reactor.start()
+        await asyncio.wait_for(caught.wait(), 60)
+        await reactor.stop()
+        assert fresh.block_store.height() >= src.block_store.height() - 1
+        # the peer was never banned for lacking ECs
+        assert all(
+            p.banned_until == 0.0 for p in reactor.pool.peers.values()
+        )
 
     run(main())
