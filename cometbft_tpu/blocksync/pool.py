@@ -52,6 +52,10 @@ class BlockPool:
         self.height = start_height  # next height to hand to verify loop
         self.peers: Dict[str, PoolPeer] = {}
         self.blocks: Dict[int, Tuple[object, str]] = {}  # h -> (block, peer)
+        # soft per-height exclusions (e.g. "peer lacks the extended
+        # commit for h"): skipped when alternatives exist, ignored
+        # otherwise — never a liveness risk, unlike a ban
+        self.excluded: Dict[int, set] = {}
         self._tasks: Dict[int, asyncio.Task] = {}
         self._new_block = asyncio.Event()
         self._stopped = False
@@ -86,6 +90,13 @@ class BlockPool:
     def max_peer_height(self) -> int:
         return max((p.height for p in self.peers.values()), default=0)
 
+    def exclude_peer_for_height(self, height: int, peer_id: str) -> None:
+        """Prefer other peers for this one height (no ban)."""
+        self.excluded.setdefault(height, set()).add(peer_id)
+
+    def clear_exclusions(self, height: int) -> None:
+        self.excluded.pop(height, None)
+
     def _pick_peer(self, height: int) -> Optional[PoolPeer]:
         now = time.monotonic()
         candidates = [
@@ -93,6 +104,11 @@ class BlockPool:
         ]
         if not candidates:
             return None
+        excl = self.excluded.get(height)
+        if excl:
+            preferred = [p for p in candidates if p.peer_id not in excl]
+            if preferred:
+                candidates = preferred
         # adaptive sorting: prefer low latency, few pending requests
         candidates.sort(
             key=lambda p: (p.pending, p.latency_ewma, random.random())

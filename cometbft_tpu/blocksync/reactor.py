@@ -201,12 +201,15 @@ class BlockSyncReactor:
                 self._ec_misses[h] = misses
                 if misses < EC_MISS_TOLERANCE:
                     # honest peers can lack the EC: refetch WITHOUT
-                    # banning so another peer gets a chance to serve it
+                    # banning, steering the retry to a DIFFERENT peer
+                    # (soft exclusion — the fastest peer would
+                    # otherwise be re-picked and win the refetch too)
                     _log.info(
                         "peer lacks extended commit, refetching",
                         height=h,
                         attempt=misses,
                     )
+                    self.pool.exclude_peer_for_height(h, peer)
                     self.pool.redo_request(h, None)
                     break
                 _log.info(
@@ -229,7 +232,21 @@ class BlockSyncReactor:
             # on "peer omitted extended commit"
             if ec_bytes and not self.block_store.load_extended_commit(h):
                 self.block_store.save_extended_commit(h, ec_bytes)
-            parts = T.PartSet.from_data(codec.encode_block(blk))
+            # Build parts from the peer's wire bytes (saves a full
+            # re-encode) — but only if they produce the part-set header
+            # the validators actually signed: a peer could serve a
+            # NON-canonical encoding of the same block (permissive
+            # parse) to poison the store. On mismatch fall back to our
+            # canonical encoding, as before the memoization.
+            signed_psh = nxt.last_commit.block_id.part_set_header
+            raw = getattr(blk, "_raw_bytes", None)
+            parts = None
+            if raw is not None:
+                parts = T.PartSet.from_data(raw)
+                if parts.header.hash != signed_psh.hash:
+                    parts = None
+            if parts is None:
+                parts = T.PartSet.from_data(codec.encode_block(blk))
             if self.ingestor is not None:
                 # fork: adaptive sync — pipeline the verified block
                 # straight into the consensus state machine. The
@@ -258,7 +275,9 @@ class BlockSyncReactor:
                 self.state = self.block_exec.apply_verified_block(
                     self.state, bid, blk
                 )
-            self._ec_misses.pop(h, None)
+            if h in self._ec_misses:
+                del self._ec_misses[h]
+                self.pool.clear_exclusions(h)
             self.pool.pop_request()
             self.blocks_applied += 1
             applied += 1

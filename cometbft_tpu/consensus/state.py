@@ -235,7 +235,9 @@ class ConsensusState:
         block, commit = payload.block, payload.commit
         if block.height != rs.height:
             return
-        parts = T.PartSet.from_data(codec.encode_block(block))
+        parts = T.PartSet.from_data(
+            getattr(block, "_raw_bytes", None) or codec.encode_block(block)
+        )
         bid = T.BlockID(block.hash(), parts.header)
         if commit.block_id.hash != bid.hash:
             return

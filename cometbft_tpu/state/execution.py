@@ -420,7 +420,10 @@ class BlockExecutor:
                 pk = pubkey_from_type_bytes(vu.pub_key_type, vu.pub_key_bytes)
                 changes.append(T.Validator(pk, vu.power))
             nvals.update_with_change_set(changes)
-            changed = block.height + 1
+            # updates from block H take effect at H+2 (reference
+            # state/execution.go:713, header.Height + 1 + 1) — also the
+            # height whose S:vi record must be stored FULL
+            changed = block.height + 2
         nvals.increment_proposer_priority(1)
         params = state.consensus_params
         params_changed = state.last_height_consensus_params_changed

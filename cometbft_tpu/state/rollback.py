@@ -47,6 +47,18 @@ def rollback_state(state_store, block_store, remove_block: bool = False):
         state.consensus_params
     )
 
+    # The rolled-back height may have carried the valset/params change
+    # the invalid state points at; clamp the change markers so they
+    # never reference a height ABOVE what the rolled-back state can
+    # re-derive (reference rollback.go:69-76) — an unclamped forward
+    # pointer would corrupt the S:vi record history on the next save.
+    val_changed = min(
+        state.last_height_validators_changed, rollback_height + 1
+    )
+    params_changed = min(
+        state.last_height_consensus_params_changed, rollback_height
+    )
+
     new_state = dataclasses.replace(
         state,
         last_block_height=prev_height,
@@ -59,7 +71,9 @@ def rollback_state(state_store, block_store, remove_block: bool = False):
         validators=vals,
         next_validators=next_vals,
         last_validators=last_vals,
+        last_height_validators_changed=val_changed,
         consensus_params=params,
+        last_height_consensus_params_changed=params_changed,
         app_hash=rolled_block.header.app_hash,
         last_results_hash=rolled_block.header.last_results_hash,
     )

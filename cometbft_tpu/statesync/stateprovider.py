@@ -82,7 +82,10 @@ class LightClientStateProvider:
             validators=cur.validator_set,
             next_validators=nxt.validator_set,
             last_validators=prev.validator_set if prev else None,
-            last_height_validators_changed=0,
+            # earliest height whose valset this bootstrapped node holds
+            # as a FULL record (Store.bootstrap writes h..h+2 full):
+            # later pointer records must reference a stored-full height
+            last_height_validators_changed=cur.height + 2,
             consensus_params=params,
             last_height_consensus_params_changed=0,
             last_results_hash=nxt.header.last_results_hash,

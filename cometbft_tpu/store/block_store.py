@@ -27,6 +27,12 @@ def _hkey(prefix: bytes, h: int) -> bytes:
     return prefix + h.to_bytes(8, "big")
 
 
+def _commit_bytes(commit: Commit) -> bytes:
+    """Wire form, reusing the decode-time memo when present (see
+    codec.decode_commit: decoded objects are immutable by convention)."""
+    return getattr(commit, "_raw_bytes", None) or codec.encode_commit(commit)
+
+
 @dataclass
 class BlockMeta:
     block_id: BlockID
@@ -89,7 +95,7 @@ class BlockStore:
         sets = [
             (_hkey(b"H:", h), meta.encode()),
             (b"BH:" + block.hash(), h.to_bytes(8, "big")),
-            (_hkey(b"SC:", h), codec.encode_commit(seen_commit)),
+            (_hkey(b"SC:", h), _commit_bytes(seen_commit)),
         ]
         for i in range(part_set.header.total):
             part = part_set.get_part(i)
@@ -101,7 +107,7 @@ class BlockStore:
             )
         if block.last_commit is not None:
             sets.append(
-                (_hkey(b"C:", h - 1), codec.encode_commit(block.last_commit))
+                (_hkey(b"C:", h - 1), _commit_bytes(block.last_commit))
             )
         with self._lock:
             if self._base == 0:
@@ -112,7 +118,7 @@ class BlockStore:
             self._height = h
 
     def save_seen_commit(self, height: int, commit: Commit) -> None:
-        self.db.set(_hkey(b"SC:", height), codec.encode_commit(commit))
+        self.db.set(_hkey(b"SC:", height), _commit_bytes(commit))
 
     def save_extended_commit(self, height: int, ec_bytes: bytes) -> None:
         self.db.set(_hkey(b"EC:", height), ec_bytes)

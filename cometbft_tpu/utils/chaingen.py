@@ -132,4 +132,6 @@ class TamperingPeerClient(StorePeerClient):
         if blk is not None and height == self.bad_height:
             blk.data.txs = list(blk.data.txs) + [b"evil=1"]
             blk.data._hash = None
+            if hasattr(blk, "_raw_bytes"):  # immutable-decode convention
+                del blk._raw_bytes
         return blk

@@ -153,12 +153,14 @@ def encode_commit(c: Commit) -> bytes:
 
 def decode_commit(b: bytes) -> Commit:
     m = proto.parse(b)
-    return Commit(
+    c = Commit(
         height=proto.get1(m, 1, 0),
         round=proto.get1(m, 2, 0),
         block_id=decode_block_id(proto.get1(m, 3, b"")),
         signatures=[decode_commit_sig(x) for x in m.get(4, [])],
     )
+    c._raw_bytes = b  # see decode_block: immutable-decode convention
+    return c
 
 
 def encode_extended_commit(ec) -> bytes:
@@ -283,12 +285,22 @@ def decode_block(b: bytes) -> Block:
     datab = proto.get1(m, 2, b"")
     txs = proto.parse(datab).get(1, []) if datab else []
     lc = proto.get1(m, 3)
-    return Block(
+    blk = Block(
         header=decode_header(proto.get1(m, 1, b"")),
         data=Data(txs=txs),
         last_commit=decode_commit(lc) if lc is not None else None,
         evidence=[decode_evidence(e) for e in m.get(4, [])],
     )
+    # Memoized wire form (replay hot path): the block store and the
+    # blocksync apply loop re-serialize every synced block (PartSet
+    # build, SC:/C: records) — carrying the already-canonical bytes
+    # saves two full commit encodes + one block encode per height.
+    # CONVENTION: decoded objects are immutable; any caller that
+    # mutates one must `del obj._raw_bytes` first.
+    blk._raw_bytes = b
+    if blk.last_commit is not None:
+        blk.last_commit._raw_bytes = lc
+    return blk
 
 
 # --- validators ---------------------------------------------------------
