@@ -544,6 +544,15 @@ def cmd_abci_server(args) -> int:
     return 0
 
 
+def cmd_abci_cli(args) -> int:
+    """Client side of the reference abci-cli (abci/cmd/abci-cli):
+    echo/info/check_tx/... one-shots, interactive `console`, and piped
+    `batch` scripts against a running ABCI server."""
+    from .abci_cli import run_abci_cli
+
+    return run_abci_cli(args.address, args.abci_cmd, args.abci_args)
+
+
 def cmd_bootstrap_state(args) -> int:
     """Offline statesync: light-verify state at a height and seed the
     stores so `start` goes straight to blocksync (reference
@@ -740,6 +749,22 @@ def build_parser() -> argparse.ArgumentParser:
         "--persist", action="store_true", help="persist app state to home"
     )
     p.set_defaults(fn=cmd_abci_server)
+
+    p = sub.add_parser(
+        "abci-cli",
+        help="client for a running ABCI app: one-shot, console, batch",
+    )
+    p.add_argument("-a", "--address", default="tcp://127.0.0.1:26658")
+    p.add_argument(
+        "abci_cmd",
+        choices=(
+            "echo", "info", "check_tx", "finalize_block",
+            "prepare_proposal", "process_proposal", "commit", "query",
+            "console", "batch",
+        ),
+    )
+    p.add_argument("abci_args", nargs="*")
+    p.set_defaults(fn=cmd_abci_cli)
 
     p = sub.add_parser("light", help="light client daemon")
     p.add_argument("chain_id")

@@ -5,9 +5,9 @@ Generates deterministic pseudo-random manifests from a seed, covering
 the combination space: topology (single / quad / large), sync modes
 (blocksync, adaptive ingest, statesync late joiners), storage backend
 (sqlite / native logdb), mempool type, tx load, and perturbations
-(kill/restart, pause, disconnect, evidence injection). A seed fully
-determines the manifest, so any failing generated net is reproducible
-from its seed alone.
+(kill/restart, pause, disconnect, evidence injection, upgrade). A seed
+fully determines the manifest, so any failing generated net is
+reproducible from its seed alone.
 """
 
 from __future__ import annotations
@@ -42,6 +42,12 @@ def _perturb(rng: random.Random, spec: NodeSpec, target: int, is_val: bool):
     elif roll < 0.55 and is_val:
         spec.perturbations.append(
             Perturbation("evidence", rng.randint(lo, hi))
+        )
+    elif roll < 0.65:
+        # graceful binary-swap restart (reference testnet.go:62
+        # PerturbationUpgrade)
+        spec.perturbations.append(
+            Perturbation("upgrade", rng.randint(lo, hi))
         )
 
 

@@ -31,11 +31,12 @@ from typing import Dict, List, Optional
 
 @dataclass
 class Perturbation:
-    kind: str  # "kill" | "pause" | "disconnect" | "evidence"
+    kind: str  # "kill" | "pause" | "disconnect" | "evidence" | "upgrade"
     height: int
     pause_s: float = 3.0
     restart_delay_s: float = 2.0
     disconnect_s: float = 3.0
+    upgrade_version: str = "0.2.0-upgrade"
 
 
 @dataclass
@@ -108,6 +109,20 @@ class Manifest:
                         "disconnect",
                         int(nd["disconnect_at"]),
                         disconnect_s=float(nd.get("disconnect_s", 3.0)),
+                    )
+                )
+            if nd.get("upgrade_at"):
+                # graceful stop + relaunch as a NEWER software version
+                # (single-binary analog of the reference's docker-image
+                # swap, testnet.go:62 PerturbationUpgrade +
+                # runner/perturb.go:37)
+                spec.perturbations.append(
+                    Perturbation(
+                        "upgrade",
+                        int(nd["upgrade_at"]),
+                        upgrade_version=nd.get(
+                            "upgrade_version", "0.2.0-upgrade"
+                        ),
                     )
                 )
             if nd.get("evidence_at"):

