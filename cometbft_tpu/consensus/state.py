@@ -235,9 +235,18 @@ class ConsensusState:
         block, commit = payload.block, payload.commit
         if block.height != rs.height:
             return
-        parts = T.PartSet.from_data(
-            getattr(block, "_raw_bytes", None) or codec.encode_block(block)
-        )
+        # reuse peer wire bytes only when they produce the PSH the
+        # commit binds to — a non-canonical encoding of a valid block
+        # must fall back to canonical re-encode, not get dropped
+        # (same guard as blocksync/reactor.py's apply loop)
+        raw = getattr(block, "_raw_bytes", None)
+        parts = None
+        if raw is not None:
+            parts = T.PartSet.from_data(raw)
+            if parts.header.hash != commit.block_id.part_set_header.hash:
+                parts = None
+        if parts is None:
+            parts = T.PartSet.from_data(codec.encode_block(block))
         bid = T.BlockID(block.hash(), parts.header)
         if commit.block_id.hash != bid.hash:
             return

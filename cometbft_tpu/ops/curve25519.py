@@ -229,35 +229,43 @@ def add_projective(p, q):
     return (X3, Y3, Z3, None)
 
 
-def base_window_table():
-    """Host: affine-cached table [d]B for d in 0..15, as a numpy array
-    shaped (16, 3, 20) int32 — shared by every lane of the windowed
-    ladder's fixed-base term."""
+def _aff_add(p1, p2):
+    """Host-side complete Edwards affine addition (python ints)."""
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    den1 = (1 + _D * x1 * x2 * y1 * y2) % P
+    den2 = (1 - _D * x1 * x2 * y1 * y2) % P
+    x3 = (x1 * y2 + x2 * y1) * pow(den1, P - 2, P) % P
+    y3 = (y1 * y2 + x1 * x2) * pow(den2, P - 2, P) % P
+    return (x3, y3)
+
+
+def affine_window_table(pt):
+    """Host: affine-cached table [d]P for d in 0..15 of an affine point
+    ``pt = (x, y)`` (python ints), shaped (16, 3, 20) int32. Entry d=0
+    is the identity in cached form — the device ladder's window adds
+    stay branch-free and complete."""
     import numpy as _np
 
-    def aff_add(p1, p2):
-        if p1 is None:
-            return p2
-        if p2 is None:
-            return p1
-        x1, y1 = p1
-        x2, y2 = p2
-        # complete Edwards affine addition
-        den1 = (1 + _D * x1 * x2 * y1 * y2) % P
-        den2 = (1 - _D * x1 * x2 * y1 * y2) % P
-        x3 = (x1 * y2 + x2 * y1) * pow(den1, P - 2, P) % P
-        y3 = (y1 * y2 + x1 * x2) * pow(den2, P - 2, P) % P
-        return (x3, y3)
-
     out = _np.zeros((16, 3, fe.NLIMBS), _np.int32)
-    pt = None  # identity
+    acc = None  # identity
     for d in range(16):
-        if pt is None:
+        if acc is None:
             x, y = 0, 1
         else:
-            x, y = pt
+            x, y = acc
         out[d, 0] = fe.to_limbs((y + x) % P)
         out[d, 1] = fe.to_limbs((y - x) % P)
         out[d, 2] = fe.to_limbs(2 * _D * x * y % P)
-        pt = aff_add(pt, BASE_AFFINE)
+        acc = _aff_add(acc, pt)
     return out
+
+
+def base_window_table():
+    """Host: affine-cached table [d]B for d in 0..15 — shared by every
+    lane of the windowed ladder's fixed-base term."""
+    return affine_window_table(BASE_AFFINE)

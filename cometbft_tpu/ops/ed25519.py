@@ -52,6 +52,12 @@ def bucket_cap(max_len: int) -> int:
     raise ValueError(f"message too long for verify kernel: {max_len}")
 
 
+# straus-ladder window-loop unroll factor (bench-tunable; see _straus)
+import os as _os
+
+LADDER_UNROLL = int(_os.environ.get("GRAFT_LADDER_UNROLL", "1"))
+
+
 _B_TABLE = None
 
 
@@ -66,12 +72,22 @@ def _straus(ds, dh, A, shape):
     """[s]B + [hneg]A over batch lanes (tuple-of-limbs field elements).
 
     4-bit windowed joint ladder: 64 windows x (4 doublings) — the first
-    group acts on the identity — plus per window one cached add from
-    the per-lane A table (8M) and one affine-cached add from the shared
+    group acts on the identity — plus per window one add from the
+    per-lane A table and one affine-cached add from the shared
     host-precomputed B table (7M). ~27% fewer field multiplies than the
     bitwise ladder (253 x (double + 9M add)), and the window tables'
     d=0 entries are the identity in cached form so the adds stay
     branch-free and complete.
+
+    The A table is built ON DEVICE from the extended point ``A`` (15
+    sequential curve.add's, cached-projective entries). A round-3
+    experiment replaced it with host-precomputed (16, 3, 20, N) tables
+    to shrink the HLO for the XLA CPU backend: the gather lookup form
+    ran ~4x slower on TPU (breaks tuple-of-limbs fusion), the
+    select-forest lookup form compiled for >26 min on the TPU backend,
+    and NEITHER made the CPU-backend compile finish (>60 min on the
+    1-core box in every variant) — so the on-device build stays
+    (docs/PERF.md "CPU-backend compile pathology").
 
     ds / dh: (64, N) int32 window digits, LSB-first."""
     ident = curve.identity(shape)
@@ -93,8 +109,8 @@ def _straus(ds, dh, A, shape):
         j = 63 - i
         d_s = lax.dynamic_index_in_dim(ds, j, 0, keepdims=False)
         d_h = lax.dynamic_index_in_dim(dh, j, 0, keepdims=False)
-        # only the last double's T is consumed (by add_cached); the
-        # window-final add's T is never read (next op is a double)
+        # only the last double's T is consumed (by the A window add);
+        # the window-final add's T is never read (next op is a double)
         q = curve.double(
             curve.double(
                 curve.double(curve.double(q, need_t=False), need_t=False),
@@ -111,6 +127,7 @@ def _straus(ds, dh, A, shape):
             for k in range(4)
         )
         q = curve.add_cached(q, addend_a)
+        # shared B term: scalar-broadcast cases constant-folded by XLA
         addend_b = tuple(
             tuple(
                 lax.select_n(
@@ -128,8 +145,13 @@ def _straus(ds, dh, A, shape):
         )
         return curve.add_affine_cached(q, addend_b, need_t=False)
 
-    # T-less carry: the loop output feeds add_projective (no T input)
-    return lax.fori_loop(0, 64, body, ident[:3] + (None,))
+    # T-less carry: the loop output feeds add_projective (no T input).
+    # Unrolling trades HLO size for scheduling freedom across window
+    # iterations (the kernel is issue-bound, not multiply-bound —
+    # docs/PERF.md); the default is measured on v5e via bench.py.
+    return lax.fori_loop(
+        0, 64, body, ident[:3] + (None,), unroll=LADDER_UNROLL
+    )
 
 
 def _verify_core(msgs, lens, pks, rs, ss):
@@ -290,22 +312,31 @@ def _sharded_fn(precomp: bool):
     return n, _SHARDED_FNS[key]
 
 
-def verify_batch(items) -> np.ndarray:
-    """Host API: items = list of (msg: bytes, pubkey: 32B, sig: 64B).
+class AsyncVerdicts:
+    """Handle for an in-flight verify dispatch (XLA dispatch is async:
+    the program is enqueued and this handle holds the device future).
+    ``result()`` blocks and returns the bool verdicts. Overlapping
+    several dispatches before resolving amortizes the per-dispatch
+    link latency — the production pipelining seam (bench config
+    "pipeline")."""
 
-    Returns np.ndarray of bool verdicts, one per item. Builds padded
-    device arrays (batch-last layout), dispatches one XLA program —
-    lane-sharded over every local device when a multi-chip mesh is
-    available (same shard_map program the driver dryrun validates).
+    def __init__(self, res, bad, n):
+        self._res = res
+        self._bad = bad
+        self._n = n
 
-    Public keys are decompressed ONCE per distinct key on the host
-    (LRU) and fed to the kernel in limb form: validator sets repeat
-    across commits, so the device-side sqrt chain only runs for the R
-    points (the reference's expanded-key LRU, ed25519.go:31).
-    """
+    def result(self) -> np.ndarray:
+        out = np.array(self._res)[: self._n]
+        out[self._bad[: self._n]] = False
+        return out
+
+
+def verify_batch_async(items) -> AsyncVerdicts:
+    """Enqueue one verify dispatch WITHOUT blocking on the verdicts
+    (see AsyncVerdicts). Same prep/dispatch as verify_batch."""
     n = len(items)
     if n == 0:
-        return np.zeros(0, bool)
+        return AsyncVerdicts(np.zeros(0, bool), np.zeros(0, bool), 0)
     max_len = max(len(m) for m, _, _ in items)
     cap = bucket_cap(max_len)
     np_ = _pad_n(n)
@@ -361,6 +392,21 @@ def verify_batch(items) -> np.ndarray:
     else:
         fn = sharded if sharded is not None else verify_core_jit
         arrays = (msgs, lens, pks, rs, ss)
-    out = np.array(fn(*(jnp.asarray(a) for a in arrays)))[:n]
-    out[bad[:n]] = False
-    return out
+    res = fn(*(jnp.asarray(a) for a in arrays))
+    return AsyncVerdicts(res, bad, n)
+
+
+def verify_batch(items) -> np.ndarray:
+    """Host API: items = list of (msg: bytes, pubkey: 32B, sig: 64B).
+
+    Returns np.ndarray of bool verdicts, one per item. Builds padded
+    device arrays (batch-last layout), dispatches one XLA program —
+    lane-sharded over every local device when a multi-chip mesh is
+    available (same shard_map program the driver dryrun validates).
+
+    Public keys are decompressed ONCE per distinct key on the host
+    (LRU) and fed to the kernel in limb form: validator sets repeat
+    across commits, so the device-side sqrt chain only runs for the R
+    points (the reference's expanded-key LRU, ed25519.go:31).
+    """
+    return verify_batch_async(items).result()

@@ -197,9 +197,17 @@ class Store:
             # priorities + proposer from the inline vectors
             pv, pnv, plv = st._prio_vectors
             h = st.last_block_height
-            st.validators = self.load_validators(h + 1)
-            st.next_validators = self.load_validators(h + 2)
-            st.last_validators = self.load_validators(h) if h > 0 else None
+            st.validators = self.load_validators(
+                h + 1, membership_only=bool(pv)
+            )
+            st.next_validators = self.load_validators(
+                h + 2, membership_only=bool(pnv)
+            )
+            st.last_validators = (
+                self.load_validators(h, membership_only=bool(plv))
+                if h > 0
+                else None
+            )
             if st.validators is None or st.next_validators is None:
                 raise ValueError(
                     "state blob references missing validator records "
@@ -328,11 +336,15 @@ class Store:
         sets.append((_h(b"S:params:", h + 1), state.consensus_params.encode()))
         self.db.write_batch(sets)
 
-    def load_validators(self, height: int) -> Optional[ValidatorSet]:
+    def load_validators(
+        self, height: int, membership_only: bool = False
+    ) -> Optional[ValidatorSet]:
         """Valset for ``height``; pointer records reconstruct proposer
         priorities by incrementing from the last stored full set
         (reference state/store.go:545-588 — and the same approximation
-        caveat, see module doc)."""
+        caveat, see module doc). ``membership_only`` skips the priority
+        reconstruction (up to checkpoint-interval increment passes) for
+        callers that overlay exact priorities anyway (load())."""
         b = self.db.get(_h(b"S:vi:", height))
         if b is None:
             # legacy record (pre-pointer-scheme store)
@@ -353,7 +365,8 @@ class Store:
                 f"validators at height {height} point to missing full "
                 f"record at {k0}"
             )
-        vs.increment_proposer_priority(height - k0)
+        if not membership_only:
+            vs.increment_proposer_priority(height - k0)
         return vs
 
     def load_consensus_params(self, height: int) -> Optional[ConsensusParams]:

@@ -98,7 +98,7 @@ def test_verify_commits_coalesced_sharded_matches_host():
     from cometbft_tpu.utils.chaingen import make_chain
 
     gen, pvs = make_genesis(6, chain_id="shard")
-    parts = make_chain(gen, pvs, 4)
+    parts = make_chain(gen, [pv.priv_key for pv in pvs], 4)
     store = parts.block_store
     vs = gen.validator_set()
     jobs = []
