@@ -383,8 +383,14 @@ def bench_replay(gen, parts, n_blocks: int) -> dict:
         return asyncio.run(main())
 
     # TPU path: full corpus, wide windows (128 blocks x 150 sigs per
-    # dispatch)
+    # dispatch). Warm the window-shape compile OUTSIDE the timed run —
+    # steady-state replay throughput is the metric, and the CPU
+    # baseline pays no compile either. The warm-up is a REAL 129-block
+    # replay: the timed path verifies light (stops at >2/3 power, ~101
+    # of 150 sigs), so only an identical replay is guaranteed to hit
+    # the same _pad_n lane bucket as the timed windows.
     crypto_batch.set_default_backend("tpu")
+    replay(min(129, n_blocks), 128)
     tpu_dt = replay(n_blocks, 128)
     # CPU baseline: sequential verify on a 300-block slice, extrapolated
     crypto_batch.set_default_backend("cpu")

@@ -59,12 +59,14 @@ class _Calibration:
 
     _COMPILE_CUTOFF_S = 10.0
     _ALPHA = 0.4
+    EXPLORE_EVERY = 256
 
     def __init__(self) -> None:
         self.host_s = 80e-6     # ~80us/sig OpenSSL (measured r2)
         self.lane_s = 3.5e-6    # bulk kernel ~3.5us/lane (BENCH_r02)
         self.flat_s = 5e-3      # optimistic local-chip dispatch seed
         self.device_samples = 0
+        self._host_streak = 0
         self._lock = threading.Lock()
 
     def observe_host(self, n: int, wall: float) -> None:
@@ -91,6 +93,24 @@ class _Calibration:
     def device_wins(self, n: int) -> bool:
         with self._lock:
             return self.flat_s + n * self.lane_s < n * self.host_s
+
+    def should_explore(self) -> bool:
+        """Recovery path for a poisoned flat_s: a 1-10s recompile or
+        tunnel stall that slips past the compile filter inflates the
+        EWMA, every batch then routes to host, and without device
+        traffic the estimate could never heal. Every EXPLORE_EVERY
+        host-routed eligible batches, one is sent to the device anyway;
+        its (filtered) wall pulls flat_s back toward reality."""
+        with self._lock:
+            self._host_streak += 1
+            if self._host_streak >= self.EXPLORE_EVERY:
+                self._host_streak = 0
+                return True
+            return False
+
+    def note_device_used(self) -> None:
+        with self._lock:
+            self._host_streak = 0
 
     def crossover(self) -> int:
         """Smallest batch the device is predicted to win."""
@@ -163,8 +183,12 @@ class TpuBatchVerifier(BatchVerifier):
         n_ed = len(ed_items)
         forced = _MIN_TPU_BATCH <= 1
         use_device = n_ed >= _MIN_TPU_BATCH and (
-            forced or calibration.device_wins(n_ed)
+            forced
+            or calibration.device_wins(n_ed)
+            or calibration.should_explore()
         )
+        if use_device and not forced:
+            calibration.note_device_used()
         LAST_ROUTE.update(
             path="device" if use_device else "host",
             n=n_ed,

@@ -27,6 +27,37 @@ def canonical_block_id(bid: BlockID) -> bytes:
     return proto.field_bytes(1, bid.hash) + proto.field_message(2, psh)
 
 
+def vote_sign_bytes_parts(
+    chain_id: str,
+    type_: int,
+    height: int,
+    round_: int,
+    block_id: BlockID,
+):
+    """(prefix, suffix) of the CanonicalVote body around the timestamp
+    field — everything except the timestamp is identical across the
+    signatures of one commit, so verification loops encode these once
+    and splice the per-signature timestamp in (150 sigs/commit on the
+    replay path)."""
+    prefix = proto.field_varint(1, type_)
+    prefix += proto.field_sfixed64(2, height)
+    prefix += proto.field_sfixed64(3, round_)
+    cbid = canonical_block_id(block_id)
+    if cbid is not None:
+        prefix += proto.field_message(4, cbid)
+    return prefix, proto.field_string(6, chain_id)
+
+
+def finish_vote_sign_bytes(
+    prefix: bytes, suffix: bytes, timestamp_ns: int
+) -> bytes:
+    return proto.delimited(
+        prefix
+        + proto.field_message(5, proto.timestamp(timestamp_ns))
+        + suffix
+    )
+
+
 def vote_sign_bytes(
     chain_id: str,
     type_: int,
@@ -36,15 +67,10 @@ def vote_sign_bytes(
     timestamp_ns: int,
 ) -> bytes:
     """CanonicalVote encoding, length-delimited (types/vote.go:152)."""
-    body = proto.field_varint(1, type_)
-    body += proto.field_sfixed64(2, height)
-    body += proto.field_sfixed64(3, round_)
-    cbid = canonical_block_id(block_id)
-    if cbid is not None:
-        body += proto.field_message(4, cbid)
-    body += proto.field_message(5, proto.timestamp(timestamp_ns))
-    body += proto.field_string(6, chain_id)
-    return proto.delimited(body)
+    prefix, suffix = vote_sign_bytes_parts(
+        chain_id, type_, height, round_, block_id
+    )
+    return finish_vote_sign_bytes(prefix, suffix, timestamp_ns)
 
 
 def proposal_sign_bytes(

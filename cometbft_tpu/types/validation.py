@@ -22,7 +22,11 @@ from typing import Optional
 
 from ..crypto import batch as crypto_batch
 from .block import BLOCK_ID_FLAG_COMMIT, BlockID, Commit
-from .canonical import PRECOMMIT_TYPE, vote_sign_bytes
+from .canonical import (
+    PRECOMMIT_TYPE,
+    finish_vote_sign_bytes,
+    vote_sign_bytes_parts,
+)
 from .signature_cache import SignatureCache
 from .validator_set import ValidatorSet
 
@@ -40,14 +44,27 @@ class ErrInvalidSignature(CommitVerifyError):
 
 
 def _commit_sign_bytes(chain_id: str, commit: Commit, cs) -> bytes:
-    return vote_sign_bytes(
-        chain_id,
-        PRECOMMIT_TYPE,
-        commit.height,
-        commit.round,
-        cs.block_id(commit.block_id),
-        cs.timestamp_ns,
-    )
+    """Sign bytes for one CommitSig; the timestamp-independent parts
+    are memoized on the commit (one prefix per block-id flag class —
+    decoded commits are immutable by convention, codec.decode_commit),
+    so a 150-signature commit encodes them once, not 150 times."""
+    parts = getattr(commit, "_sb_parts", None)
+    if parts is None:
+        parts = {}
+        commit._sb_parts = parts
+    flag_commit = cs.block_id_flag == BLOCK_ID_FLAG_COMMIT
+    key = (chain_id, flag_commit)
+    ps = parts.get(key)
+    if ps is None:
+        ps = vote_sign_bytes_parts(
+            chain_id,
+            PRECOMMIT_TYPE,
+            commit.height,
+            commit.round,
+            cs.block_id(commit.block_id),
+        )
+        parts[key] = ps
+    return finish_vote_sign_bytes(ps[0], ps[1], cs.timestamp_ns)
 
 
 def _basic_checks(

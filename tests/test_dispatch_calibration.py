@@ -97,3 +97,29 @@ def test_force_min_batch_1_bypasses_calibration(monkeypatch):
     finally:
         crypto_batch.set_min_tpu_batch(old_min)
         crypto_batch.set_default_backend(old)
+
+
+def test_exploration_heals_poisoned_flat_cost():
+    """A 1-10s recompile wall that slips past the first-sample filter
+    inflates flat_s; periodic exploration must route a batch to the
+    device anyway so a healthy sample can pull the estimate back."""
+    c = _Calibration()
+    c.observe_device(4800, 0.1)       # healthy first sample
+    c.observe_device(4800, 3.0)       # per-shape recompile slips in
+    assert not c.device_wins(4800), "poisoned estimate routes host"
+    # every EXPLORE_EVERY'th eligible host-routed batch explores
+    explored = [c.should_explore() for _ in range(c.EXPLORE_EVERY)]
+    assert explored.count(True) == 1 and explored[-1] is True
+    # each explored dispatch lands a healthy wall; the EWMA (alpha
+    # 0.4) converges back within a handful of explore cycles
+    cycles = 0
+    while not c.device_wins(4800):
+        cycles += 1
+        assert cycles <= 10, "exploration failed to heal the estimate"
+        while not c.should_explore():
+            pass
+        c.observe_device(4800, 0.11)
+    assert 1 <= cycles <= 10
+    # device traffic resets the streak
+    c.note_device_used()
+    assert not c.should_explore()
