@@ -146,3 +146,48 @@ def test_fuzz_pubsub_query_parse(s):
         pubsub_query.parse(s)
     except (ValueError, KeyError):
         pass
+
+
+# --- commit codec round-trip property (fast-path decoder) ---------------
+
+
+@settings(parent=FAST)
+@given(data=st.data())
+def test_fuzz_commit_roundtrip(data):
+    """decode(encode(c)) == c for generated commits — the specialized
+    decode_commit scanner must agree with the writer on every shape
+    (flags, empty/nil ids, zero timestamps, absent sigs)."""
+    from cometbft_tpu import types as T
+    from cometbft_tpu.utils import codec
+
+    n_sigs = data.draw(st.integers(min_value=0, max_value=8))
+    sigs = []
+    for _ in range(n_sigs):
+        flag = data.draw(st.sampled_from([1, 2, 3]))
+        sigs.append(
+            T.CommitSig(
+                block_id_flag=flag,
+                validator_address=data.draw(
+                    st.binary(min_size=0, max_size=20)
+                ),
+                timestamp_ns=data.draw(
+                    st.integers(min_value=0, max_value=2**62)
+                ),
+                signature=data.draw(st.binary(min_size=0, max_size=64)),
+            )
+        )
+    bid = T.BlockID(
+        data.draw(st.binary(min_size=0, max_size=32)),
+        T.PartSetHeader(
+            data.draw(st.integers(min_value=0, max_value=1 << 20)),
+            data.draw(st.binary(min_size=0, max_size=32)),
+        ),
+    )
+    c = T.Commit(
+        height=data.draw(st.integers(min_value=0, max_value=2**62)),
+        round=data.draw(st.integers(min_value=0, max_value=1 << 20)),
+        block_id=bid,
+        signatures=sigs,
+    )
+    got = codec.decode_commit(codec.encode_commit(c))
+    assert got == c
