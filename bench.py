@@ -312,6 +312,16 @@ def _timed_with_backend(backend: str, fn, repeats: int = 5):
             dt = time.time() - t0
             best = dt if best is None else min(best, dt)
     finally:
+        # capture the route the LAST timed run actually took BEFORE
+        # restoring the backend: LAST_ROUTE is only written by
+        # TpuBatchVerifier, so reading the global later can return a
+        # stale value (e.g. after a cpu-backend timing or the
+        # device-probe-failed degrade) — ADVICE r3
+        _timed_with_backend.last_route = (
+            crypto_batch.LAST_ROUTE["path"]
+            if backend in ("tpu", "auto")
+            else None
+        )
         crypto_batch.set_min_tpu_batch(old_min)
         crypto_batch.set_default_backend(old_backend)
     return best, out
@@ -346,7 +356,7 @@ def bench_batch64() -> dict:
         "tpu_ms": _ms(tpu),
         "cpu_ms": _ms(cpu),
         "auto_ms": _ms(auto),
-        "auto_path": crypto_batch.LAST_ROUTE["path"],
+        "auto_path": _timed_with_backend.last_route,
         "vs_cpu": _ratio(cpu, auto),
         "note": "64 sigs; auto = calibrated production routing",
     }
@@ -362,8 +372,6 @@ def bench_commit150(gen, parts) -> dict:
     def once():
         T.verify_commit_light(gen.chain_id, vs, meta.block_id, 1, commit)
 
-    from cometbft_tpu.crypto import batch as crypto_batch
-
     tpu, _ = _timed_with_backend("tpu", once)
     cpu, _ = _timed_with_backend("cpu", once)
     auto, _ = _timed_with_backend("auto", once)
@@ -371,7 +379,7 @@ def bench_commit150(gen, parts) -> dict:
         "tpu_ms": _ms(tpu),
         "cpu_ms": _ms(cpu),
         "auto_ms": _ms(auto),
-        "auto_path": crypto_batch.LAST_ROUTE["path"],
+        "auto_path": _timed_with_backend.last_route,
         "vs_cpu": _ratio(cpu, auto),
     }
 
@@ -558,8 +566,6 @@ def bench_bisect(gen, privs) -> dict:
         client.verify_light_block_at_height(TARGET)
         return client.hops
 
-    from cometbft_tpu.crypto import batch as crypto_batch
-
     tpu_dt, hops = _timed_with_backend("tpu", once, repeats=2)
     cpu_dt, cpu_hops = _timed_with_backend("cpu", once, repeats=2)
     auto_dt, _ = _timed_with_backend("auto", once, repeats=2)
@@ -571,7 +577,7 @@ def bench_bisect(gen, privs) -> dict:
         "tpu_s": None if tpu_dt is None else round(tpu_dt, 2),
         "cpu_s": round(cpu_dt, 2),
         "auto_s": None if auto_dt is None else round(auto_dt, 2),
-        "auto_path": crypto_batch.LAST_ROUTE["path"],
+        "auto_path": _timed_with_backend.last_route,
         "vs_cpu": _ratio(cpu_dt, auto_dt),
     }
 

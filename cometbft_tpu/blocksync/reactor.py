@@ -64,7 +64,8 @@ class BlockSyncReactor:
         self.window = verify_window
         self.local_blocks_chain = local_blocks_chain
         self.blocks_applied = 0
-        self._ec_misses: dict = {}  # height -> EC-less fetch count
+        # height -> set of peer ids that served the height EC-less
+        self._ec_misses: dict = {}
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
 
@@ -197,9 +198,38 @@ class BlockSyncReactor:
             try:
                 ec_bytes = self._check_extended_commit(h, blk, peer)
             except MissingExtendedCommit as e:
-                misses = self._ec_misses.get(h, 0) + 1
-                self._ec_misses[h] = misses
-                if misses < EC_MISS_TOLERANCE:
+                served = self._ec_misses.setdefault(h, set())
+                served.add(peer)
+                # Bare-apply rules (the reference hard-rejects EC-less
+                # blocks everywhere, blocksync/reactor.go:618-648; we
+                # tolerate narrowly for liveness):
+                #  - NEVER at the pool's max height — that block is the
+                #    switch-to-consensus tip, and a node that applied
+                #    it bare cannot propose at tip+1 (no EC to carry)
+                #    nor serve the EC to later joiners;
+                #  - only after EC_MISS_TOLERANCE *distinct* peers came
+                #    back bare (a single byzantine peer that wins every
+                #    refetch must not be able to force a bare apply),
+                #    or every known peer has (single-peer nets can
+                #    never reach the distinct-peer bar).
+                # the highest height blocksync can apply is
+                # max_peer_height - 1 (block h needs h+1's commit), and
+                # is_caught_up switches to consensus there — so THAT is
+                # the tip to protect
+                at_tip = h >= self.pool.max_peer_height() - 1
+                # exhaustion counts only peers whose advertised range
+                # can actually serve h — lagging or pruned peers in the
+                # denominator would make exhaustion unreachable and
+                # stall the sync below tip forever
+                can_serve = {
+                    pid
+                    for pid, p in self.pool.peers.items()
+                    if p.base <= h <= p.height
+                }
+                exhausted = bool(can_serve) and served >= can_serve
+                if at_tip or (
+                    len(served) < EC_MISS_TOLERANCE and not exhausted
+                ):
                     # honest peers can lack the EC: refetch WITHOUT
                     # banning, steering the retry to a DIFFERENT peer
                     # (soft exclusion — the fastest peer would
@@ -207,15 +237,16 @@ class BlockSyncReactor:
                     _log.info(
                         "peer lacks extended commit, refetching",
                         height=h,
-                        attempt=misses,
+                        distinct_peers=len(served),
+                        at_tip=at_tip,
                     )
                     self.pool.exclude_peer_for_height(h, peer)
                     self.pool.redo_request(h, None)
                     break
                 _log.info(
-                    "applying block without extended commit",
+                    "applying historical block without extended commit",
                     height=h,
-                    attempts=misses,
+                    distinct_peers=len(served),
                 )
                 ec_bytes = None
             except Exception as e:
@@ -245,6 +276,14 @@ class BlockSyncReactor:
                 parts = T.PartSet.from_data(raw)
                 if parts.header.hash != signed_psh.hash:
                     parts = None
+                    # the peer's encoding was non-canonical: every
+                    # memoized wire-bytes shortcut downstream (store
+                    # save_block persists commit._raw_bytes for SC:/C:
+                    # records) must re-encode canonically too, or the
+                    # store ends up holding the poisoned encoding
+                    for o in (blk, blk.last_commit):
+                        if hasattr(o, "_raw_bytes"):
+                            del o._raw_bytes
             if parts is None:
                 parts = T.PartSet.from_data(codec.encode_block(blk))
             if self.ingestor is not None:

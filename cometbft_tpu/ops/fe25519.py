@@ -38,6 +38,8 @@ the curve25519-voi field element used by the reference's
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import jax.numpy as jnp
 from jax import lax
@@ -47,6 +49,51 @@ LIMB_BITS = 13
 MASK = (1 << LIMB_BITS) - 1
 P = 2**255 - 19
 WRAP = (1 << (NLIMBS * LIMB_BITS)) % P  # 2^260 mod p == 608
+
+
+# --- compact (rolled) mode ---------------------------------------------
+#
+# The tuple-of-limbs convolution unrolls to ~1.4k HLO ops per multiply —
+# ideal for the TPU backend (pure fusable elementwise DAG) but fatal for
+# the XLA *CPU* backend, whose compile time explodes superlinearly on
+# the verify kernel's op count (>80 min / OOM at any width and any opt
+# level; docs/PERF.md "CPU-backend compile pathology"). Compact mode
+# expresses the SAME arithmetic rolled: stacked (nlimbs, N...) arrays, a
+# lax.scan over the 20 partial-product rows, and whole-vector carry
+# rounds — ~70 HLO ops per multiply, which the CPU backend compiles in
+# seconds. Value-identical by construction (same partial products, same
+# carry schedule); differential tests cross-check both forms.
+#
+# Mode selection is per-process: explicitly via set_compact()/env
+# GRAFT_COMPACT_FIELD, else automatic — compact exactly on the CPU
+# backend (the virtual-mesh dryrun, CPU test lanes, entry()'s CPU
+# compile check), tuple form on real accelerators.
+
+_COMPACT = None  # True/False forced, None = auto
+_COMPACT_AUTO = None  # cached auto decision
+
+
+def set_compact(v) -> None:
+    """Force compact mode on/off (tests); None restores auto."""
+    global _COMPACT
+    _COMPACT = v
+
+
+def compact_mode() -> bool:
+    global _COMPACT_AUTO
+    if _COMPACT is not None:
+        return _COMPACT
+    env = os.environ.get("GRAFT_COMPACT_FIELD")
+    if env is not None:
+        return env == "1"
+    if _COMPACT_AUTO is None:
+        try:
+            import jax
+
+            _COMPACT_AUTO = jax.default_backend() == "cpu"
+        except Exception:  # pragma: no cover - uninitializable backend
+            _COMPACT_AUTO = False
+    return _COMPACT_AUTO
 
 
 def to_limbs(x: int) -> np.ndarray:
@@ -89,6 +136,11 @@ def unstack(arr):
     return tuple(arr[i] for i in range(NLIMBS))
 
 
+def unstack_n(arr, n: int):
+    """(n, N...) array -> n-tuple (scalar module's variable widths)."""
+    return tuple(arr[i] for i in range(n))
+
+
 def zero(shape=()):
     z = jnp.zeros(shape, jnp.int32)
     return (z,) * NLIMBS
@@ -103,12 +155,37 @@ def _bshape(*args):
     return jnp.broadcast_shapes(*(jnp.shape(a[0]) for a in args))
 
 
+def _carry_stacked(x, rounds: int, wrap: bool):
+    """Stacked-array carry rounds (compact mode): x is (n, N...) int32.
+
+    wrap=True folds the top limb's carry into limb 0 times WRAP (the
+    20-limb field carry); wrap=False drops it (callers guarantee a zero
+    headroom limb, same contract as the tuple _carry_noWrap)."""
+
+    def rnd(x):
+        c = lax.shift_right_arithmetic(x, LIMB_BITS)
+        r = jnp.bitwise_and(x, MASK)
+        up = jnp.concatenate(
+            [c[-1:] * WRAP if wrap else jnp.zeros_like(c[-1:]), c[:-1]],
+            axis=0,
+        )
+        return r + up
+
+    if rounds > 4:  # long chains (scalar folds) roll the rounds too
+        return lax.fori_loop(0, rounds, lambda _, v: rnd(v), x)
+    for _ in range(rounds):
+        x = rnd(x)
+    return x
+
+
 def carry(x, rounds: int = 3):
     """Propagate carries; carry-out of limb 19 wraps to limb 0 times WRAP.
 
     Preserves the value mod p. With inputs bounded by 2^31 the default 3
     rounds bring limbs into (-2^13, 2^13 + WRAP]; pure per-limb
     elementwise ops, the cross-limb shift is just tuple reindexing."""
+    if compact_mode():
+        return unstack(_carry_stacked(stack(x), rounds, wrap=True))
     for _ in range(rounds):
         c = tuple(lax.shift_right_arithmetic(v, LIMB_BITS) for v in x)
         r = tuple(jnp.bitwise_and(v, MASK) for v in x)
@@ -199,8 +276,42 @@ def _reduce_41(c):
     return carry(tuple(out), 2)
 
 
+def _mul_compact(a, b):
+    """Compact-mode multiply: the same 20x20 schoolbook convolution as
+    _conv_mul/_reduce_41, rolled into a 20-step lax.scan over stacked
+    limbs (value-identical partial products and carry schedule, ~20x
+    smaller HLO — see the compact-mode note at the top)."""
+    A, B = stack(a), stack(b)
+    sh = jnp.broadcast_shapes(A.shape[1:], B.shape[1:])
+
+    def _bcast(x):  # align batch dims from the right (scalar consts)
+        pad = len(sh) - (x.ndim - 1)
+        x = x.reshape((NLIMBS,) + (1,) * pad + x.shape[1:])
+        return jnp.broadcast_to(x, (NLIMBS,) + sh).astype(jnp.int32)
+
+    A, B = _bcast(A), _bcast(B)
+    acc0 = jnp.zeros((2 * NLIMBS + 1,) + sh, jnp.int32)
+
+    def body(acc, i):
+        contrib = lax.dynamic_index_in_dim(A, i, 0, keepdims=False) * B
+        seg = lax.dynamic_slice_in_dim(acc, i, NLIMBS, axis=0)
+        return (
+            lax.dynamic_update_slice_in_dim(acc, seg + contrib, i, axis=0),
+            None,
+        )
+
+    acc, _ = lax.scan(body, acc0, jnp.arange(NLIMBS))
+    # stacked _reduce_41: two no-wrap rounds, fold, two wrap rounds
+    acc = _carry_stacked(acc, 2, wrap=False)
+    out = acc[:NLIMBS] + acc[NLIMBS : 2 * NLIMBS] * WRAP
+    out = out.at[0].add(acc[2 * NLIMBS] * (WRAP * WRAP))
+    return unstack(_carry_stacked(out, 2, wrap=True))
+
+
 def mul(a, b):
     """Field multiply. Inputs must be carried (|limb| <~ 2^13.3)."""
+    if compact_mode():
+        return _mul_compact(a, b)
     return _reduce_41(_conv_mul(a, b))
 
 
@@ -213,6 +324,8 @@ def square(a):
     schedules worse than the regular output-stationary conv, and the
     VPU is not multiply-bound here. Keep the general conv.
     """
+    if compact_mode():
+        return _mul_compact(a, a)
     return _reduce_41(_conv_mul(a, a))
 
 

@@ -28,6 +28,7 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
+from . import fe25519 as fe
 from .fe25519 import LIMB_BITS, MASK, NLIMBS
 
 L = 2**252 + 27742317777372353535851937790883648493
@@ -64,6 +65,12 @@ def carry_plain(x, rounds=None):
     n = len(x)
     if rounds is None:
         rounds = n + 6
+    if fe.compact_mode():
+        # rolled form for the CPU backend (see fe25519 compact note);
+        # identical round-by-round schedule, top carry dropped the same
+        return fe.unstack_n(
+            fe._carry_stacked(fe.stack(x), rounds, wrap=False), n
+        )
     for _ in range(rounds):
         c = tuple(lax.shift_right_arithmetic(v, LIMB_BITS) for v in x)
         r = tuple(jnp.bitwise_and(v, MASK) for v in x)

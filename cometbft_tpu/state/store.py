@@ -390,12 +390,27 @@ class Store:
         # Pointer records at heights >= retain_height may reference a
         # full record BELOW it: keep everything from that anchor up
         # (reference state/store.go:299 keeps the last checkpoint).
+        # The pruning floor is the anchor of the first POINTER record
+        # at or above retain_height — pointer anchors
+        # max(checkpoint(h), changed) are monotone in h (checkpoint
+        # grows with h; changed never decreases along a chain), so the
+        # first one bounds every later anchor. Full records along the
+        # way are skipped, NOT trusted as a floor: a full record is
+        # not necessarily a change point (save()'s upgrade backfill
+        # writes them mid-stream), so a pointer above it can still
+        # anchor below it — including below retain_height, e.g. at a
+        # legacy S:vals record on an upgraded store (ADVICE r3).
         keep_from = retain_height
-        b = self.db.get(_h(b"S:vi:", retain_height))
-        if b is not None:
-            vs, changed = _decode_validators_info(b)
+        for k, v in self.db.iter_prefix(b"S:vi:"):
+            h = int.from_bytes(k[len(b"S:vi:") :], "big")
+            if h < retain_height:
+                continue
+            vs, changed = _decode_validators_info(v)
             if vs is None:
-                keep_from = _last_stored_height_for(retain_height, changed)
+                keep_from = min(
+                    keep_from, _last_stored_height_for(h, changed)
+                )
+                break
         deletes = []
         for prefix in (b"S:vi:", b"S:vals:"):
             for k, _ in self.db.iter_prefix(prefix):

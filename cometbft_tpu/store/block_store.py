@@ -95,7 +95,14 @@ class BlockStore:
         sets = [
             (_hkey(b"H:", h), meta.encode()),
             (b"BH:" + block.hash(), h.to_bytes(8, "big")),
-            (_hkey(b"SC:", h), _commit_bytes(seen_commit)),
+            # SC always re-encodes canonically: in the blocksync loop
+            # the seen commit is sliced from block h+1's wire bytes,
+            # whose canonical-encoding (psh) check only runs one
+            # iteration LATER — trusting its decode-time memo here
+            # would persist a byzantine peer's non-canonical encoding.
+            # C: (below) may reuse the memo: it comes from THIS block,
+            # which every save path has already canonicality-checked.
+            (_hkey(b"SC:", h), codec.encode_commit(seen_commit)),
         ]
         for i in range(part_set.header.total):
             part = part_set.get_part(i)
@@ -118,7 +125,11 @@ class BlockStore:
             self._height = h
 
     def save_seen_commit(self, height: int, commit: Commit) -> None:
-        self.db.set(_hkey(b"SC:", height), _commit_bytes(commit))
+        # canonical re-encode, same reasoning as save_block's SC record
+        # (statesync/bootstrap commits come from light blocks whose
+        # wire encoding is never canonicality-checked, only their
+        # signatures verify)
+        self.db.set(_hkey(b"SC:", height), codec.encode_commit(commit))
 
     def save_extended_commit(self, height: int, ec_bytes: bytes) -> None:
         self.db.set(_hkey(b"EC:", height), ec_bytes)

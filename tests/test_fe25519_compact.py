@@ -1,0 +1,96 @@
+"""Compact (rolled) field arithmetic vs the tuple (unrolled) form.
+
+Compact mode exists so the XLA CPU backend can compile the verify
+kernel (docs/PERF.md "CPU-backend compile pathology"); it must be
+VALUE-IDENTICAL to the tuple form — same partial products, same carry
+schedule. These tests run both forms eagerly on the CPU backend and
+diff them against each other and the big-int oracle. Default test
+lane (no kernel compile involved).
+"""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+import pytest
+
+from cometbft_tpu.ops import fe25519 as fe
+from cometbft_tpu.ops import sc25519 as sc
+
+P = fe.P
+rng = random.Random(99)
+
+
+def _vals(n):
+    vals = [0, 1, 2, P - 1, P - 2, P, P + 1, 2 * P - 1, (1 << 255) - 1]
+    while len(vals) < n:
+        vals.append(rng.randrange(0, 1 << 256))
+    return vals[:n]
+
+
+def _limbs(vals):
+    return fe.unstack(
+        jnp.asarray(np.stack([fe.to_limbs(v) for v in vals], axis=1))
+    )
+
+
+@pytest.fixture(params=[False, True], ids=["tuple", "compact"])
+def compact(request):
+    fe.set_compact(request.param)
+    try:
+        yield request.param
+    finally:
+        fe.set_compact(None)
+
+
+def test_mul_square_carry_match_oracle(compact):
+    va, vb = _vals(24), list(reversed(_vals(24)))
+    a, b = _limbs(va), _limbs(vb)
+    for got, want in (
+        (fe.mul(a, b), [x * y for x, y in zip(va, vb)]),
+        (fe.square(a), [x * x for x in va]),
+        (fe.carry(tuple(x + y for x, y in zip(a, b)), 3),
+         [x + y for x, y in zip(va, vb)]),
+        (fe.mul_scalar(a, 121666), [x * 121666 for x in va]),
+    ):
+        arr = np.asarray(fe.stack(got))
+        for i, w in enumerate(want):
+            assert fe.from_limbs(arr[:, i]) == w % P, i
+
+
+def test_forms_bitwise_identical():
+    """Not just mod-p equal: the exact redundant limb representation
+    matches (same carry schedule), so either form can feed the other
+    mid-computation."""
+    va, vb = _vals(16), _vals(16)[::-1]
+    a, b = _limbs(va), _limbs(vb)
+    fe.set_compact(False)
+    try:
+        t_mul = np.asarray(fe.stack(fe.mul(a, b)))
+        t_sq = np.asarray(fe.stack(fe.square(b)))
+        fe.set_compact(True)
+        c_mul = np.asarray(fe.stack(fe.mul(a, b)))
+        c_sq = np.asarray(fe.stack(fe.square(b)))
+    finally:
+        fe.set_compact(None)
+    np.testing.assert_array_equal(t_mul, c_mul)
+    np.testing.assert_array_equal(t_sq, c_sq)
+
+
+def test_scalar_reduce_matches(compact):
+    xs = [rng.randrange(0, 1 << 512) for _ in range(12)] + [
+        0, sc.L - 1, sc.L, sc.L + 1, (1 << 512) - 1
+    ]
+    rows = np.zeros((40, len(xs)), np.int64)
+    for i, x in enumerate(xs):
+        v = x
+        for j in range(40):
+            rows[j, i] = v & fe.MASK
+            v >>= fe.LIMB_BITS
+    got = sc.reduce_512(
+        fe.unstack_n(jnp.asarray(rows.astype(np.int32)), 40)
+    )
+    arr = np.asarray(fe.stack(got))
+    for i, x in enumerate(xs):
+        assert sc.from_limbs(arr[:, i]) == x % sc.L, i

@@ -154,6 +154,48 @@ def test_prune_keeps_reconstruction_anchor(monkeypatch):
     assert db.get(b"S:vi:" + (5).to_bytes(8, "big")) is None
 
 
+def test_prune_keeps_legacy_anchor_of_upgraded_store(monkeypatch):
+    """ADVICE r3 (medium): on a store upgraded from the legacy S:vals
+    layout, prune_states with retain_height inside the legacy region
+    must not delete the legacy record that post-upgrade pointer
+    records anchor at (save() anchors them at the state's
+    last_height_validators_changed, which can predate retain_height —
+    and an upgrade-backfill FULL record in between must not mask the
+    pointer's true anchor)."""
+    from cometbft_tpu.utils import codec
+
+    monkeypatch.setattr(state_store_mod, "VALSET_CHECKPOINT_INTERVAL", 10)
+    vs, _ = T.random_validator_set(3)
+    db = kv.MemKV()
+    # legacy store: raw S:vals full records at heights 1..12
+    for h in range(1, 13):
+        db.set(
+            b"S:vals:" + h.to_bytes(8, "big"),
+            codec.encode_validator_set(vs),
+        )
+    store = Store(db)
+    # first post-upgrade save: last change happened at legacy height 11
+    state = _mk_state(vs.copy(), 12, changed=11)
+    store.save(state)
+    # the new record at 14 is a pointer anchored at 11 (max(cp=10, 11));
+    # save() backfills a FULL record at 13 (no legacy record there)
+    raw14 = db.get(b"S:vi:" + (14).to_bytes(8, "big"))
+    got14, changed14 = state_store_mod._decode_validators_info(raw14)
+    assert got14 is None and changed14 == 11
+    store.prune_states(12)
+    # the anchor at 11 survives even though 11 < retain_height
+    assert db.get(b"S:vals:" + (11).to_bytes(8, "big")) is not None
+    got = store.load_validators(14)
+    assert got is not None and got.hash() == vs.hash()
+    # retain_height ON the backfill FULL record at 13: a full record is
+    # not a change point, so the pointer at 14 still anchors below it —
+    # the scan must look past full records, not stop at them
+    store.prune_states(13)
+    assert db.get(b"S:vals:" + (11).to_bytes(8, "big")) is not None
+    got = store.load_validators(14)
+    assert got is not None and got.hash() == vs.hash()
+
+
 def test_legacy_full_records_still_load():
     """Stores written before the pointer scheme (raw S:vals records)
     keep loading."""

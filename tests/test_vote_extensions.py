@@ -209,12 +209,15 @@ def test_bad_extension_signature_rejected():
 
 
 def test_blocksync_tolerates_peers_lacking_extended_commits():
-    """ADVICE r2 (medium): an honest peer may hold blocks WITHOUT their
-    extended commits (it pruned them, or tolerated missing ECs while
-    syncing itself). Blocksync must distinguish that from a bad EC:
-    retry without banning, then apply bare once EC_MISS_TOLERANCE
-    fetches came back EC-less — a network where NO reachable peer holds
-    the EC for a height must not stall the joiner forever."""
+    """ADVICE r2 (medium) + ADVICE r3 (low): an honest peer may hold
+    blocks WITHOUT their extended commits (it pruned them, or tolerated
+    missing ECs while syncing itself). Blocksync must distinguish that
+    from a bad EC: retry without banning, then apply bare once every
+    reachable peer came back EC-less — but ONLY for historical heights.
+    The switch-to-consensus tip is never applied bare (a node that did
+    so could neither propose at tip+1 nor serve the EC to later
+    joiners); the joiner switches to consensus one block early and
+    fetches the tip through consensus catch-up instead."""
     from cometbft_tpu.blocksync.reactor import BlockSyncReactor
     from cometbft_tpu.utils.chaingen import StorePeerClient, make_chain
 
@@ -240,10 +243,99 @@ def test_blocksync_tolerates_peers_lacking_extended_commits():
         await reactor.start()
         await asyncio.wait_for(caught.wait(), 60)
         await reactor.stop()
-        assert fresh.block_store.height() >= src.block_store.height() - 1
+        # historical heights applied bare; the tip (max_peer_height-1,
+        # the highest height blocksync can verify) deliberately NOT
+        assert fresh.block_store.height() == src.block_store.height() - 2
         # the peer was never banned for lacking ECs
         assert all(
             p.banned_until == 0.0 for p in reactor.pool.peers.values()
         )
+
+    run(main())
+
+
+def test_blocksync_requires_distinct_peers_for_bare_apply():
+    """ADVICE r3 (low): a single byzantine peer that wins every refetch
+    must not force a bare apply while other peers exist — the EC-less
+    tolerance counts DISTINCT peers, so the refetch (with the bare
+    peer soft-excluded) reaches the honest peer, whose extended commit
+    is applied."""
+    from cometbft_tpu.blocksync.reactor import BlockSyncReactor
+    from cometbft_tpu.utils.chaingen import StorePeerClient, make_chain
+
+    async def main():
+        gen, pvs = make_genesis(3, chain_id="ext-distinct")
+        gen.consensus_params.abci.vote_extensions_enable_height = 1
+        privs = [pv.priv_key for pv in pvs]
+        src = make_chain(gen, privs, 10)
+        # sign valid extended commits for the generated chain (chaingen
+        # itself signs plain commits only)
+        addr_to_priv = {p.pub_key().address(): p for p in privs}
+        from cometbft_tpu.types.canonical import vote_extension_sign_bytes
+
+        for h in range(1, src.block_store.height() + 1):
+            commit = src.block_store.load_seen_commit(h)
+            ext_sigs = []
+            for s in commit.signatures:
+                ext = b"ext|%d|" % h
+                esig = addr_to_priv[s.validator_address].sign(
+                    vote_extension_sign_bytes(
+                        gen.chain_id, h, commit.round, ext
+                    )
+                )
+                ext_sigs.append(
+                    T.ExtendedCommitSig(
+                        block_id_flag=s.block_id_flag,
+                        validator_address=s.validator_address,
+                        timestamp_ns=s.timestamp_ns,
+                        signature=s.signature,
+                        extension=ext,
+                        extension_signature=esig,
+                    )
+                )
+            ec = T.ExtendedCommit(
+                height=h,
+                round=commit.round,
+                block_id=commit.block_id,
+                extended_signatures=ext_sigs,
+            )
+            src.block_store.save_extended_commit(
+                h, codec.encode_extended_commit(ec)
+            )
+
+        class BarePeer(StorePeerClient):
+            """Serves the same blocks but stripped of ECs."""
+
+            async def request_block(self, height):
+                blk = await super().request_block(height)
+                if blk is not None and hasattr(blk, "_ec_bytes"):
+                    del blk._ec_bytes
+                return blk
+
+        fresh = build_node(gen, None)
+        caught = asyncio.Event()
+        reactor = BlockSyncReactor(
+            fresh.state,
+            fresh.block_exec,
+            fresh.block_store,
+            on_caught_up=lambda st: caught.set(),
+        )
+        reactor.pool.set_peer_range(
+            "bare", BarePeer(src), 1, src.block_store.height()
+        )
+        reactor.pool.set_peer_range(
+            "honest", StorePeerClient(src), 1,
+            src.block_store.height(),
+        )
+        await reactor.start()
+        await asyncio.wait_for(caught.wait(), 60)
+        await reactor.stop()
+        assert (
+            fresh.block_store.height() >= src.block_store.height() - 2
+        )
+        # every applied extension-height block carries its EC (no bare
+        # applies happened: the honest peer existed)
+        for h in range(1, fresh.block_store.height() + 1):
+            assert fresh.block_store.load_extended_commit(h) is not None, h
 
     run(main())
