@@ -42,10 +42,12 @@ def _load_entry_module():
 
 def test_default_kernel_budget_fits_driver_window():
     mod = _load_entry_module()
-    assert mod.DEFAULT_KERNEL_BUDGET_S <= 60, (
+    # startup (~15s) + leg budget + quorum compile (~15s) must stay
+    # well inside the 240s wall cap below (MULTICHIP_r02 was rc=124
+    # with a 600s budget; the sharded leg measures ~40s cold-cache)
+    assert mod.DEFAULT_KERNEL_BUDGET_S <= 120, (
         "kernel-leg budget must leave the driver's overall dryrun "
-        "timeout room for startup + quorum compile (MULTICHIP_r02 "
-        "was rc=124 with a 600s budget)"
+        "timeout room for startup + quorum compile"
     )
 
 
@@ -73,20 +75,21 @@ def test_dryrun_flow_completes_under_wall_cap():
         )
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     assert "dryrun_multichip OK" in proc.stdout, proc.stdout[-2000:]
-    # Record which mode the kernel leg ran in — a hang in the sharded
-    # kernel leg must not silently ship as "green via fallback".
-    # Until the kernel HLO compiles on CPU inside the budget
-    # (docs/PERF.md), host-verifier-fallback is the EXPECTED mode on
-    # this box; once it does, GRAFT_REQUIRE_KERNEL_LEG=1 makes the
-    # fallback a failure.
+    # The sharded kernel leg must GENUINELY execute (compact field
+    # mode makes the graph CPU-compilable inside the budget, ~40s
+    # cold / seconds warm — VERDICT r3 #1/#4). The host-verifier
+    # fallback is a resource-exhaustion backstop only; shipping green
+    # via fallback is a regression. GRAFT_ALLOW_KERNEL_FALLBACK=1
+    # tolerates it for debugging on starved boxes.
     mode_line = next(
         l for l in proc.stdout.splitlines() if "kernel_leg=" in l
     )
-    assert (
-        "sharded-kernel" in mode_line
-        or "host-verifier-fallback" in mode_line
-    ), mode_line
-    if os.environ.get("GRAFT_REQUIRE_KERNEL_LEG"):
+    if os.environ.get("GRAFT_ALLOW_KERNEL_FALLBACK"):
+        assert (
+            "sharded-kernel" in mode_line
+            or "host-verifier-fallback" in mode_line
+        ), mode_line
+    else:
         assert "sharded-kernel" in mode_line, mode_line
 
 
