@@ -92,6 +92,11 @@ def _straus(ds, dh, A, shape):
     ds / dh: (64, N) int32 window digits, LSB-first."""
     if fe.compact_mode():
         return _straus_compact(ds, dh, A, shape)
+    if len(shape) == 1 and shape[0] % 128 == 0:
+        from .pallas_ladder import pallas_enabled, straus_pallas
+
+        if pallas_enabled():
+            return straus_pallas(ds, dh, A, shape)
     ident = curve.identity(shape)
 
     # per-lane A table: cached([d]A) for d in 0..15 — kept as a list of

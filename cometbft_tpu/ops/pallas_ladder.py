@@ -1,0 +1,187 @@
+"""Pallas (Mosaic) Straus ladder: VMEM-resident window tables.
+
+The double-scalar ladder is ~60% of the verify kernel's runtime
+(docs/PERF.md ablations). Under plain XLA the per-lane 16-entry window
+table (5.1 KB/lane) streams through HBM on every one of the 64 windows
+— ~43 GB of table traffic per 131072-lane dispatch — because each
+field element is ~10.5 MB at bulk widths and nothing fits in VMEM
+across windows. This kernel blocks the lanes so that, per grid step,
+the table slice, the digit planes and the accumulator point all live
+in VMEM for the whole 64-window loop: table bytes move from HBM once
+per dispatch instead of 64 times, and Mosaic schedules the double/add
+chains directly.
+
+The field math inside the kernel body is the SAME tuple-of-limbs code
+as the XLA path (ops/fe25519, ops/curve25519) — limbs are (S, 128)
+int32 tiles sliced from VMEM refs, and every op is elementwise on
+them, which is exactly what the VPU wants. The window schedule is
+identical to ops/ed25519._straus, so verdicts are bit-identical.
+
+Replaces the hot loop behind the reference's batch-verification seam
+(curve25519-voi Straus ladder used by crypto/ed25519 verification);
+an original design for the TPU memory hierarchy, not a port.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import curve25519 as curve
+from . import fe25519 as fe
+
+# lanes per grid step = BLOCK_SUBLANES * 128. At 8 sublanes (1024
+# lanes) the table slice is 5.2 MB — comfortably inside VMEM with the
+# digit planes (~0.5 MB) and working set; bench-tunable.
+BLOCK_SUBLANES = int(os.environ.get("GRAFT_PALLAS_SUBLANES", "8"))
+
+def pallas_enabled() -> bool:
+    """Ladder backend selection: GRAFT_PALLAS=1 opts in; default off
+    until the Pallas path is driver-benchmarked faster (bench.py
+    measures both and records the ablation in docs/PERF.md). Read
+    dynamically so bench can A/B within one process — but note the
+    production verify_core_jit caches its trace, so flip the env
+    before the first verify_batch of the process."""
+    return os.environ.get("GRAFT_PALLAS") == "1"
+
+
+def _ladder_kernel(ds_ref, dh_ref, table_ref, out_ref):
+    """One lane block: table_ref (16, 4, 20, S, 128) VMEM; ds/dh
+    (64, S, 128); out_ref (3, 20, S, 128) = X, Y, Z of the ladder
+    result (T-less carry, same as _straus)."""
+    s = table_ref.shape[3]
+    shape = (s, 128)
+    ident = curve.identity(shape)
+
+    # B window table: shared host constants, broadcast per lane
+    from .ed25519 import _b_table
+
+    bt = _b_table()  # numpy (16, 3, 20)
+
+    def body(i, q):
+        j = 63 - i
+        d_s = ds_ref[j]
+        d_h = dh_ref[j]
+        q = curve.double(
+            curve.double(
+                curve.double(curve.double(q, need_t=False), need_t=False),
+                need_t=False,
+            )
+        )
+        addend_a = tuple(
+            tuple(
+                lax.select_n(
+                    d_h, *[table_ref[d, k, lj] for d in range(16)]
+                )
+                for lj in range(fe.NLIMBS)
+            )
+            for k in range(4)
+        )
+        q = curve.add_cached(q, addend_a)
+        addend_b = tuple(
+            tuple(
+                lax.select_n(
+                    d_s,
+                    *[
+                        jnp.full(shape, int(bt[d, k, lj]), jnp.int32)
+                        for d in range(16)
+                    ],
+                )
+                for lj in range(fe.NLIMBS)
+            )
+            for k in range(3)
+        )
+        return curve.add_affine_cached(q, addend_b, need_t=False)
+
+    q = lax.fori_loop(0, 64, body, ident[:3] + (None,))
+    for k in range(3):
+        for lj in range(fe.NLIMBS):
+            out_ref[k, lj] = q[k][lj]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _ladder_call(ds, dh, table, interpret=False):
+    """ds/dh (64, R, 128) int32; table (16, 4, 20, R, 128) int32 ->
+    (3, 20, R, 128) int32 (X, Y, Z tuple-of-limbs, carried)."""
+    r = ds.shape[1]
+    # block height must DIVIDE the sublane-row count or the grid would
+    # silently drop the remainder rows (uninitialized verdict lanes):
+    # take the largest divisor of r that fits the configured block
+    s = min(BLOCK_SUBLANES, r)
+    while r % s:
+        s -= 1
+    grid = (r // s,)
+    return pl.pallas_call(
+        _ladder_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (64, s, 128), lambda i: (0, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (64, s, 128), lambda i: (0, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (16, 4, fe.NLIMBS, s, 128),
+                lambda i: (0, 0, 0, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (3, fe.NLIMBS, s, 128),
+            lambda i: (0, 0, i, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (3, fe.NLIMBS, r, 128), jnp.int32
+        ),
+        interpret=interpret,
+    )(ds, dh, table)
+
+
+def straus_pallas(ds, dh, A, shape, interpret=False):
+    """Drop-in for ops/ed25519._straus on lane counts that are
+    multiples of 128: [s]B + [hneg]A via the VMEM-blocked kernel.
+
+    ds/dh: (64, N) digit planes; A: tuple-form extended point; returns
+    the T-less (X, Y, Z, None) tuple-of-limbs point, matching _straus.
+    The per-lane A window table is built in XLA (15 sequential cached
+    adds, the same build as _straus) and handed to the kernel stacked —
+    built once, read once from HBM, resident in VMEM for all windows.
+    """
+    (n,) = shape
+    assert n % 128 == 0, n
+    r = n // 128
+
+    ext = curve.identity(shape)
+    entries = [curve.to_cached(ext)]
+    acc = ext
+    for _ in range(15):
+        acc = curve.add(acc, A)
+        entries.append(curve.to_cached(acc))
+    table = jnp.stack(
+        [
+            jnp.stack([fe.stack(comp) for comp in e])
+            for e in entries
+        ]
+    )  # (16, 4, 20, N)
+
+    table = table.reshape(16, 4, fe.NLIMBS, r, 128)
+    ds_t = ds.reshape(64, r, 128)
+    dh_t = dh.reshape(64, r, 128)
+    out = _ladder_call(ds_t, dh_t, table, interpret=interpret)
+    out = out.reshape(3, fe.NLIMBS, n)
+    return (
+        tuple(out[0, i] for i in range(fe.NLIMBS)),
+        tuple(out[1, i] for i in range(fe.NLIMBS)),
+        tuple(out[2, i] for i in range(fe.NLIMBS)),
+        None,
+    )

@@ -1,0 +1,57 @@
+"""Pallas ladder vs XLA ladder: bit-identical output.
+
+The Pallas kernel (ops/pallas_ladder) re-schedules the Straus ladder
+for VMEM residency but must compute the exact same function as
+ops/ed25519._straus. Runs the Pallas interpreter on the CPU backend
+(Mosaic itself needs TPU hardware); kernel-compiling lane, see
+pytest.ini.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cometbft_tpu.crypto import ref_ed25519 as ref
+from cometbft_tpu.ops import curve25519 as curve
+from cometbft_tpu.ops import ed25519 as ed
+from cometbft_tpu.ops import fe25519 as fe
+from cometbft_tpu.ops import sc25519 as sc
+from cometbft_tpu.ops.pallas_ladder import straus_pallas
+
+pytestmark = pytest.mark.tpu
+
+
+def test_pallas_ladder_matches_xla_ladder():
+    N = 128
+    rng = np.random.default_rng(17)
+    sk = rng.bytes(32)
+    pk = ref.public_from_seed(sk)
+    pkb = jnp.asarray(
+        np.tile(np.frombuffer(pk, np.uint8)[:, None], (1, N))
+    )
+    A, okA = curve.decompress(pkb)
+    assert bool(np.asarray(okA).all())
+
+    s_bytes = np.zeros((32, N), np.uint8)
+    for i in range(N):
+        v = int(rng.integers(0, 2**62)) ** 4 % sc.L
+        s_bytes[:, i] = np.frombuffer(v.to_bytes(32, "little"), np.uint8)
+    s = fe.from_bytes_256(jnp.asarray(s_bytes))
+    h = sc.neg_mod_L(
+        sc.reduce_512(
+            sc.hash_bytes_to_limbs(
+                jnp.asarray(np.vstack([s_bytes, s_bytes]))
+            )
+        )
+    )
+    ds, dh = sc.digits4(s), sc.digits4(h)
+
+    q_ref = ed._straus(ds, dh, A, (N,))
+    q_pal = straus_pallas(ds, dh, A, (N,), interpret=True)
+    for k in range(3):
+        np.testing.assert_array_equal(
+            np.asarray(fe.stack(q_ref[k])),
+            np.asarray(fe.stack(q_pal[k])),
+            err_msg=f"component {k}",
+        )
