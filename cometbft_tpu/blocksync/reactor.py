@@ -21,7 +21,9 @@ import traceback
 from typing import Callable, Optional
 
 from .. import types as T
-from ..types.validation import verify_commits_coalesced
+from ..types.validation import (
+    verify_commits_coalesced_async,
+)
 from ..utils import codec
 from ..utils.log import get_logger
 from .pool import BlockPool
@@ -66,6 +68,15 @@ class BlockSyncReactor:
         self.blocks_applied = 0
         # height -> set of peer ids that served the height EC-less
         self._ec_misses: dict = {}
+        # pipelined verify: (key, handle) for the NEXT window's
+        # already-dispatched signature batch (see _process_window)
+        self._inflight = None
+        self.pipeline_stats = {
+            "reused": 0,        # pre-dispatched handles consumed
+            "dispatched": 0,    # fresh (non-pipelined) dispatches
+            "predispatched": 0, # lookahead dispatches issued
+            "discarded": 0,     # handles dropped (redo/valset/reshuffle)
+        }
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
 
@@ -107,7 +118,11 @@ class BlockSyncReactor:
                     if self.on_caught_up:
                         self.on_caught_up(self.state)
                     return
-            window = self.pool.peek_window(self.window)
+            # peek one extra window of lookahead: _process_window
+            # pre-dispatches the NEXT window's signature batch before
+            # applying the current one (device work overlaps host
+            # decode/apply — docs/PERF.md "overlapped replay dispatch")
+            window = self.pool.peek_window(self.window * 2)
             if len(window) < 2:
                 await self.pool.wait_for_block()
                 continue
@@ -142,32 +157,63 @@ class BlockSyncReactor:
                 window = window[1:]
             if len(window) < 2:
                 return 0
+        # take (and clear) the pre-dispatched handle FIRST: every exit
+        # from this pass either consumes it or drops it — a handle
+        # must never survive a pass whose window it was not checked
+        # against (e.g. the head-mismatch refetch below)
+        inflight, self._inflight = self._inflight, None
         # block at window[i] is verified by window[i+1].last_commit
         vals_hash = self.state.validators.hash()
-        jobs = []
-        for i in range(len(window) - 1):
-            h, blk, peer = window[i]
-            _, nxt, _ = window[i + 1]
-            if blk.header.validators_hash != vals_hash:
-                window = window[: i + 1]
-                break
-            bid = T.BlockID(
-                blk.hash(),
-                nxt.last_commit.block_id.part_set_header,
-            )
-            jobs.append(
-                (self.state.validators, bid, h, nxt.last_commit)
-            )
+        jobs, key = self._build_jobs(window, vals_hash, self.window - 1)
         if not jobs:
+            if inflight is not None:
+                self.pipeline_stats["discarded"] += 1
             if len(window) >= 1:
                 # head block claims a different valset than our state
                 # derives -> it cannot validate; refetch elsewhere
                 h, _, peer = window[0]
                 self.pool.redo_request(h, peer)
             return 0
-        errors = verify_commits_coalesced(
-            self.state.chain_id, jobs, cache=self.sig_cache
-        )
+        # Pipelined verify: reuse the handle pre-dispatched on the
+        # previous pass when its inputs are EXACTLY this window — the
+        # key is CONTENT-based (valset hash + every involved block's
+        # hash), so redo/ban refetches, valset changes and pool
+        # reshuffles all miss it and a wrong verdict can never be
+        # consumed.
+        if inflight is not None and inflight[0] == key:
+            handle = inflight[1]
+            self.pipeline_stats["reused"] += 1
+        else:
+            if inflight is not None:
+                self.pipeline_stats["discarded"] += 1
+            handle = verify_commits_coalesced_async(
+                self.state.chain_id, jobs, cache=self.sig_cache
+            )
+            self.pipeline_stats["dispatched"] += 1
+        # Pre-dispatch the NEXT window's batch before applying this
+        # one: the device verifies window K+1 while the host decodes/
+        # applies window K (docs/PERF.md "overlapped replay
+        # dispatch"). Built against the pre-apply valset — sound
+        # because only heights whose headers claim the SAME
+        # validators_hash enter a batch, and the key check above
+        # re-validates against the post-apply state before reuse.
+        pre = None
+        tail = window[len(jobs):]
+        if len(tail) >= 2:
+            pre_jobs, pre_key = self._build_jobs(
+                tail, vals_hash, self.window - 1
+            )
+            if pre_jobs:
+                pre = (
+                    pre_key,
+                    verify_commits_coalesced_async(
+                        self.state.chain_id,
+                        pre_jobs,
+                        cache=self.sig_cache,
+                    ),
+                )
+                self.pipeline_stats["predispatched"] += 1
+        errors = handle.result()
         applied = 0
         for i, _job in enumerate(jobs):
             h, blk, peer = window[i]
@@ -320,7 +366,49 @@ class BlockSyncReactor:
             self.pool.pop_request()
             self.blocks_applied += 1
             applied += 1
+        else:
+            # every job applied without a redo/ban/ingest break: the
+            # pre-dispatched next-window handle stays valid for reuse
+            # on the next pass (subject to the key re-check). On ANY
+            # break the handle is dropped — its blocks may be
+            # refetched or the valset may have moved.
+            self._inflight = pre
+        if pre is not None and self._inflight is not pre:
+            self.pipeline_stats["discarded"] += 1
         return applied
+
+    def _build_jobs(self, window, vals_hash, max_jobs: int):
+        """Verify jobs for the leading valset-constant prefix of
+        ``window`` (block i verified by block i+1's last_commit,
+        PeekTwoBlocks K-wide), plus a reuse key identifying the exact
+        inputs BY CONTENT: the valset hash and every involved block's
+        hash (the hash covers the header, whose last_commit_hash binds
+        the commit the job verifies). Content keys make refetches safe
+        — a replaced block hashes differently, so a pre-dispatched
+        handle can never be replayed against different inputs, while a
+        content-identical refetch may still reuse it."""
+        jobs = []
+        for i in range(min(len(window) - 1, max_jobs)):
+            h, blk, peer = window[i]
+            _, nxt, _ = window[i + 1]
+            if blk.header.validators_hash != vals_hash:
+                break
+            bid = T.BlockID(
+                blk.hash(),
+                nxt.last_commit.block_id.part_set_header,
+            )
+            jobs.append(
+                (self.state.validators, bid, h, nxt.last_commit)
+            )
+        key = (
+            vals_hash,
+            tuple(
+                bytes(window[i][1].hash()) for i in range(len(jobs) + 1)
+            )
+            if jobs
+            else (),
+        )
+        return jobs, key
 
     def _check_extended_commit(self, h, blk, peer):
         """When vote extensions are enabled at height h the peer SHOULD

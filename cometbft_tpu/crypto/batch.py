@@ -128,10 +128,25 @@ calibration = _Calibration()
 LAST_ROUTE = {"path": None, "n": 0, "crossover": None}
 
 
+class ResolvedVerdicts:
+    """Already-computed verdicts behind the async-handle interface."""
+
+    def __init__(self, all_ok: bool, oks: List[bool]) -> None:
+        self._res = (all_ok, oks)
+
+    def result(self) -> Tuple[bool, List[bool]]:
+        return self._res
+
+
 class BatchVerifier:
     """Accumulate signatures, verify all at once.
 
     add() order is preserved; verify() returns (all_ok, per_item_ok).
+    verify_async() enqueues the work and returns a handle whose
+    ``result()`` blocks for the verdicts — on the TPU backend the XLA
+    dispatch is genuinely asynchronous, so callers can overlap host
+    work (block decode/apply) with device verification (the blocksync
+    window pipeline; docs/PERF.md "overlapped replay dispatch").
     """
 
     def add(self, pk: PubKey, msg: bytes, sig: bytes) -> None:
@@ -139,6 +154,11 @@ class BatchVerifier:
 
     def verify(self) -> Tuple[bool, List[bool]]:
         raise NotImplementedError
+
+    def verify_async(self):
+        """Default: compute now, hand back a resolved handle (host
+        backends have no async dispatch to overlap)."""
+        return ResolvedVerdicts(*self.verify())
 
     def __len__(self) -> int:
         raise NotImplementedError
@@ -171,7 +191,9 @@ class TpuBatchVerifier(BatchVerifier):
     def __len__(self) -> int:
         return len(self.items)
 
-    def verify(self) -> Tuple[bool, List[bool]]:
+    def _route(self):
+        """Split items by curve and take the calibrated routing
+        decision (shared by verify / verify_async)."""
         ed_idx, ed_items, other_idx = [], [], []
         for i, (pk, msg, sig) in enumerate(self.items):
             if isinstance(pk, Ed25519PubKey):
@@ -179,7 +201,6 @@ class TpuBatchVerifier(BatchVerifier):
                 ed_items.append((msg, pk.key_bytes, sig))
             else:
                 other_idx.append(i)
-        oks = [False] * len(self.items)
         n_ed = len(ed_items)
         forced = _MIN_TPU_BATCH <= 1
         use_device = n_ed >= _MIN_TPU_BATCH and (
@@ -194,25 +215,61 @@ class TpuBatchVerifier(BatchVerifier):
             n=n_ed,
             crossover=None if forced else calibration.crossover(),
         )
+        return ed_idx, ed_items, other_idx, use_device
+
+    def _host_lanes(self, oks, ed_idx, other_idx, ed_on_host: bool):
+        if ed_on_host:
+            t0 = time.perf_counter()
+            for i in ed_idx:
+                pk, msg, sig = self.items[i]
+                oks[i] = pk.verify(msg, sig)
+            if ed_idx:
+                calibration.observe_host(
+                    len(ed_idx), time.perf_counter() - t0
+                )
+        for i in other_idx:
+            pk, msg, sig = self.items[i]
+            oks[i] = pk.verify(msg, sig)
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        ed_idx, ed_items, other_idx, use_device = self._route()
+        oks = [False] * len(self.items)
         if use_device:
             from ..ops import ed25519 as _ed
 
             t0 = time.perf_counter()
             verdicts = _ed.verify_batch(ed_items)
-            calibration.observe_device(n_ed, time.perf_counter() - t0)
+            calibration.observe_device(
+                len(ed_items), time.perf_counter() - t0
+            )
             for i, v in zip(ed_idx, verdicts):
                 oks[i] = bool(v)
-        else:
-            t0 = time.perf_counter()
-            for i in ed_idx:
-                pk, msg, sig = self.items[i]
-                oks[i] = pk.verify(msg, sig)
-            if n_ed:
-                calibration.observe_host(n_ed, time.perf_counter() - t0)
-        for i in other_idx:
-            pk, msg, sig = self.items[i]
-            oks[i] = pk.verify(msg, sig)
+        self._host_lanes(oks, ed_idx, other_idx, not use_device)
         return all(oks) and bool(oks), oks
+
+    def verify_async(self):
+        """Enqueue the device dispatch WITHOUT blocking on verdicts.
+        Host-routed lanes (small batches, non-ed25519 curves) are
+        verified eagerly — there is nothing to overlap for them. The
+        overlapped wall time is not a clean device observation, so the
+        async path does not feed the calibration EWMAs."""
+        ed_idx, ed_items, other_idx, use_device = self._route()
+        oks = [False] * len(self.items)
+        if not use_device:
+            self._host_lanes(oks, ed_idx, other_idx, True)
+            return ResolvedVerdicts(all(oks) and bool(oks), oks)
+        from ..ops import ed25519 as _ed
+
+        handle = _ed.verify_batch_async(ed_items)
+        self._host_lanes(oks, ed_idx, other_idx, False)
+
+        class _Pending:
+            def result(_self) -> Tuple[bool, List[bool]]:
+                for i, v in zip(ed_idx, handle.result()):
+                    oks[i] = bool(v)
+                return all(oks) and bool(oks), oks
+
+        return _Pending()
 
 
 _default_backend = "tpu"

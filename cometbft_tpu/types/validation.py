@@ -84,10 +84,10 @@ def _basic_checks(
         raise CommitVerifyError("wrong BlockID in commit")
 
 
-def _run_batch(items, cache: Optional[SignatureCache]):
-    """items: list of (pubkey, sign_bytes, sig). Returns list[bool]."""
-    if not items:
-        return []
+def _run_batch_async(items, cache: Optional[SignatureCache]):
+    """items: list of (pubkey, sign_bytes, sig). Returns a handle whose
+    ``result()`` yields list[bool] — async so callers (the blocksync
+    window pipeline) can overlap host work with the device dispatch."""
     to_verify = []
     skip = [False] * len(items)
     if cache is not None:
@@ -99,15 +99,28 @@ def _run_batch(items, cache: Optional[SignatureCache]):
         if not skip[i]:
             verifier.add(pk, sb, sig)
             to_verify.append(i)
-    oks = [True] * len(items)
-    if len(verifier):
-        _, verdicts = verifier.verify()
-        for i, ok in zip(to_verify, verdicts):
-            oks[i] = ok
-            if ok and cache is not None:
-                pk, sb, sig = items[i]
-                cache.add(sb, sig, pk.key_bytes)
-    return oks
+    pending = verifier.verify_async() if len(verifier) else None
+
+    class _Handle:
+        def result(self):
+            oks = [True] * len(items)
+            if pending is not None:
+                _, verdicts = pending.result()
+                for i, ok in zip(to_verify, verdicts):
+                    oks[i] = ok
+                    if ok and cache is not None:
+                        pk, sb, sig = items[i]
+                        cache.add(sb, sig, pk.key_bytes)
+            return oks
+
+    return _Handle()
+
+
+def _run_batch(items, cache: Optional[SignatureCache]):
+    """items: list of (pubkey, sign_bytes, sig). Returns list[bool]."""
+    if not items:
+        return []
+    return _run_batch_async(items, cache).result()
 
 
 def verify_commit(
@@ -191,21 +204,19 @@ def verify_commit_light(
         )
 
 
-def verify_commits_coalesced(
+def verify_commits_coalesced_async(
     chain_id: str,
     jobs,
     cache: Optional[SignatureCache] = None,
     light: bool = True,
-) -> list:
-    """Verify MANY commits in one TPU dispatch (cross-height coalescing).
-
-    jobs: list of (vals, block_id, height, commit). Returns a list of
-    None (success) or CommitVerifyError per job. This is the bulk seam
-    the reference cannot express: its batch verifier is per-commit
-    (types/validation.go:261); here blocksync/light coalesce whole
-    windows of heights into one signature-lane batch (BASELINE.json
-    north star: amortize thousands of validator sigs per XLA dispatch).
-    """
+):
+    """Async form of verify_commits_coalesced: enqueues ONE lane batch
+    for every job's signatures and returns a handle whose ``result()``
+    blocks for the verdicts and yields the per-job error list. The
+    blocksync reactor dispatches window K+1 through this before
+    applying window K's blocks, hiding the device+link latency behind
+    host execution (reference blocksync/reactor.go:560-700 is strictly
+    sequential per block)."""
     items = []         # global lane batch
     job_lanes = []     # per job: list of (lane_idx, val_idx)
     errors: list = [None] * len(jobs)
@@ -241,28 +252,54 @@ def verify_commits_coalesced(
             lanes = []
         job_lanes.append(lanes)
 
-    oks = _run_batch(items, cache)
+    batch_handle = _run_batch_async(items, cache)
 
-    for j, (vals, block_id, height, commit) in enumerate(jobs):
-        if errors[j] is not None:
-            continue
-        tallied = 0
-        bad = None
-        for lane, i in job_lanes[j]:
-            if not oks[lane]:
-                bad = ErrInvalidSignature(
-                    f"invalid signature for validator {i} at height {height}"
-                )
-                break
-            if commit.signatures[i].for_block():
-                tallied += vals.get_by_index(i).voting_power
-        if bad is not None:
-            errors[j] = bad
-        elif not tallied * 3 > vals.total_voting_power() * 2:
-            errors[j] = ErrNotEnoughVotingPower(
-                f"height {height}: tallied {tallied} <= 2/3"
-            )
-    return errors
+    class _Handle:
+        def result(self):
+            oks = batch_handle.result()
+            for j, (vals, block_id, height, commit) in enumerate(jobs):
+                if errors[j] is not None:
+                    continue
+                tallied = 0
+                bad = None
+                for lane, i in job_lanes[j]:
+                    if not oks[lane]:
+                        bad = ErrInvalidSignature(
+                            f"invalid signature for validator {i} "
+                            f"at height {height}"
+                        )
+                        break
+                    if commit.signatures[i].for_block():
+                        tallied += vals.get_by_index(i).voting_power
+                if bad is not None:
+                    errors[j] = bad
+                elif not tallied * 3 > vals.total_voting_power() * 2:
+                    errors[j] = ErrNotEnoughVotingPower(
+                        f"height {height}: tallied {tallied} <= 2/3"
+                    )
+            return errors
+
+    return _Handle()
+
+
+def verify_commits_coalesced(
+    chain_id: str,
+    jobs,
+    cache: Optional[SignatureCache] = None,
+    light: bool = True,
+) -> list:
+    """Verify MANY commits in one TPU dispatch (cross-height coalescing).
+
+    jobs: list of (vals, block_id, height, commit). Returns a list of
+    None (success) or CommitVerifyError per job. This is the bulk seam
+    the reference cannot express: its batch verifier is per-commit
+    (types/validation.go:261); here blocksync/light coalesce whole
+    windows of heights into one signature-lane batch (BASELINE.json
+    north star: amortize thousands of validator sigs per XLA dispatch).
+    """
+    return verify_commits_coalesced_async(
+        chain_id, jobs, cache=cache, light=light
+    ).result()
 
 
 def verify_commit_light_trusting(

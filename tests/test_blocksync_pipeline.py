@@ -1,0 +1,189 @@
+"""Pipelined blocksync verify dispatch (VERDICT r3 #3).
+
+The window loop pre-dispatches the NEXT window's signature batch
+before applying the current one; the pre-dispatched handle is reused
+only when its inputs (valset hash + block object identities) match
+exactly, and dropped on every redo/ban/valset-change path. These
+tests instrument the dispatch seam to prove both the reuse and the
+discards, and check end-state correctness around them.
+"""
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.blocksync import reactor as reactor_mod
+from cometbft_tpu.blocksync.reactor import BlockSyncReactor
+from cometbft_tpu.node.inprocess import build_node, make_genesis
+from cometbft_tpu.utils.chaingen import StorePeerClient, make_chain
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+class _DispatchCounter:
+    """Wraps verify_commits_coalesced_async: counts dispatches and the
+    number of jobs each carried."""
+
+    def __init__(self, monkeypatch):
+        self.calls = []
+        real = reactor_mod.verify_commits_coalesced_async
+
+        def wrapped(chain_id, jobs, cache=None, light=True):
+            self.calls.append(len(jobs))
+            return real(chain_id, jobs, cache=cache, light=light)
+
+        monkeypatch.setattr(
+            reactor_mod, "verify_commits_coalesced_async", wrapped
+        )
+
+
+def _sync(gen, src, window=8, peers=None):
+    async def main():
+        fresh = build_node(gen, None)
+        caught = asyncio.Event()
+        reactor = BlockSyncReactor(
+            fresh.state,
+            fresh.block_exec,
+            fresh.block_store,
+            on_caught_up=lambda st: caught.set(),
+            verify_window=window,
+        )
+        for name, client in peers or [("src", StorePeerClient(src))]:
+            reactor.pool.set_peer_range(
+                name, client, 1, src.block_store.height()
+            )
+        await reactor.start()
+        await asyncio.wait_for(caught.wait(), 90)
+        await reactor.stop()
+        return fresh, reactor
+
+    return run(main())
+
+
+def test_pipeline_reuses_predispatched_windows(monkeypatch):
+    """Steady-state sync: nearly every pass consumes the handle
+    pre-dispatched by the previous pass, so total dispatches stay
+    close to the number of windows (they would roughly DOUBLE if
+    every pre-dispatch were discarded)."""
+    gen, pvs = make_genesis(3, chain_id="pipe-chain")
+    src = make_chain(gen, [pv.priv_key for pv in pvs], 40)
+    counter = _DispatchCounter(monkeypatch)
+    fresh, reactor = _sync(gen, src, window=8)
+    assert fresh.block_store.height() >= src.block_store.height() - 1
+    jobs_total = sum(counter.calls)
+    applied = reactor.blocks_applied
+    # every dispatched job that was APPLIED was dispatched exactly
+    # once; waste = jobs dispatched beyond the applies (discarded
+    # handles, final partial windows). With working reuse the waste
+    # is bounded by ~2 windows; with no reuse it would be ~applied.
+    assert jobs_total - applied <= 2 * 8, (jobs_total, applied)
+    # and the pipeline genuinely pre-dispatched (more than one call)
+    assert len(counter.calls) >= 2
+    # steady state: only the first window pays a fresh dispatch; every
+    # later pass consumes the previous pass's lookahead
+    stats = reactor.pipeline_stats
+    assert stats["reused"] >= stats["dispatched"], stats
+    assert stats["reused"] >= 2, stats
+
+
+def test_pipeline_discards_on_refetch(monkeypatch):
+    """A mid-chain tampered block forces redo/ban: the pass breaks,
+    the pre-dispatched handle must be dropped (its block objects get
+    refetched), and the sync still converges on honest content."""
+    from cometbft_tpu.utils.chaingen import TamperingPeerClient
+
+    gen, pvs = make_genesis(3, chain_id="pipe-evil")
+    src = make_chain(gen, [pv.priv_key for pv in pvs], 40)
+    counter = _DispatchCounter(monkeypatch)
+    fresh, reactor = _sync(
+        gen,
+        src,
+        window=8,
+        peers=[
+            ("evil", TamperingPeerClient(src, bad_height=12)),
+            ("good", StorePeerClient(src)),
+        ],
+    )
+    assert fresh.block_store.height() >= src.block_store.height() - 1
+    assert (
+        fresh.block_store.load_block(12).hash()
+        == src.block_store.load_block(12).hash()
+    )
+    # the failed pass genuinely DROPPED its pre-dispatched handle (the
+    # tampered window forced a redo, so the lookahead could not be
+    # carried over) — and the pipeline still worked around it
+    assert reactor.pipeline_stats["discarded"] >= 1, (
+        reactor.pipeline_stats
+    )
+    assert reactor.pipeline_stats["reused"] >= 1, reactor.pipeline_stats
+
+
+def test_pipeline_discards_across_valset_change(monkeypatch):
+    """A REAL validator-set change mid-chain (kvstore val-update tx):
+    windows truncate at the change, the pre-dispatched key (bound to
+    the pre-change valset hash) stops matching, and verdicts are never
+    carried across the change. End state must be a full, correct
+    sync that verified post-change commits against the NEW set."""
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+
+    gen, pvs = make_genesis(4, chain_id="pipe-valset")
+    privs = [pv.priv_key for pv in pvs]
+    src = build_node(gen, None)
+    make_chain(gen, privs, 12, node=src)
+    # add a 5th validator via the kvstore app at height 13 (takes
+    # effect two heights later, state/execution.go:713 semantics)
+    newv = Ed25519PrivKey.from_seed(b"\x07" * 32)
+    pk_hex = newv.pub_key().key_bytes.hex().encode()
+    src.mempool.check_tx(b"val:" + pk_hex + b"!5")
+    make_chain(gen, privs + [newv], 28, node=src)
+    assert src.state.validators.size() == 5
+    counter = _DispatchCounter(monkeypatch)
+    fresh, reactor = _sync(gen, src, window=8)
+    assert fresh.block_store.height() >= src.block_store.height() - 1
+    assert fresh.state_store.load().validators.size() == 5
+
+
+def test_async_handle_matches_sync_verdicts():
+    """verify_commits_coalesced_async().result() ==
+    verify_commits_coalesced() on the same jobs (incl. a bad one)."""
+    import copy
+    import dataclasses
+
+    from cometbft_tpu.types.validation import (
+        verify_commits_coalesced,
+        verify_commits_coalesced_async,
+    )
+
+    gen, pvs = make_genesis(4, chain_id="pipe-eq")
+    src = make_chain(gen, [pv.priv_key for pv in pvs], 5)
+    vs = gen.validator_set()
+    store = src.block_store
+    jobs = []
+    for h in range(1, 5):
+        jobs.append(
+            (
+                vs,
+                store.load_block_meta(h).block_id,
+                h,
+                store.load_seen_commit(h),
+            )
+        )
+    bad = copy.deepcopy(store.load_seen_commit(2))
+    sig = bytearray(bad.signatures[0].signature)
+    sig[0] ^= 1
+    bad.signatures[0] = dataclasses.replace(
+        bad.signatures[0], signature=bytes(sig)
+    )
+    jobs.append((vs, store.load_block_meta(2).block_id, 2, bad))
+
+    sync_errors = verify_commits_coalesced(gen.chain_id, jobs)
+    async_errors = verify_commits_coalesced_async(
+        gen.chain_id, jobs
+    ).result()
+    assert [e is None for e in sync_errors] == [
+        e is None for e in async_errors
+    ]
+    assert sync_errors[:4] == [None] * 4
+    assert async_errors[4] is not None
