@@ -456,6 +456,7 @@ def encode_response(kind: int, resp) -> bytes:
             + proto.field_bytes(3, resp.key)
             + proto.field_bytes(4, resp.value)
             + proto.field_varint(5, resp.height)
+            + proto.field_bytes(6, resp.proof_ops)
         )
     elif kind == CHECK_TX:
         body = (
@@ -550,6 +551,7 @@ def decode_response(raw: bytes) -> Tuple[int, object]:
             key=g(3, b""),
             value=g(4, b""),
             height=g(5),
+            proof_ops=g(6, b""),
         )
     if kind == CHECK_TX:
         return kind, abci.ResponseCheckTx(

@@ -202,6 +202,9 @@ class ResponseQuery:
     key: bytes = b""
     value: bytes = b""
     height: int = 0
+    # encoded crypto/merkle proof-op chain (empty = no proof); light
+    # clients verify it against the light-verified AppHash of height+1
+    proof_ops: bytes = b""
 
 
 @dataclass

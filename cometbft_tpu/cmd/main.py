@@ -110,9 +110,14 @@ def cmd_start(args) -> int:
     with open(p["genesis"]) as f:
         gen = GenesisDoc.from_json(f.read())
     if cfg.base.priv_validator_laddr:
-        from ..privval.signer import SignerClient
+        from ..privval.signer import RetrySignerClient, SignerClient
 
-        pv = SignerClient(cfg.base.priv_validator_laddr)
+        # bounded retries around every sign call: a transient signer
+        # hiccup must not become a missed vote (reference
+        # privval/retry_signer_client.go)
+        pv = RetrySignerClient(
+            SignerClient(cfg.base.priv_validator_laddr)
+        )
         print(
             f"waiting for remote signer on {pv.listen_addr} ..."
         )

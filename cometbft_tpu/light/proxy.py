@@ -4,8 +4,11 @@ are LIGHT-VERIFIED before being served — a wallet can point at this
 instead of trusting a full node.
 
 Routes proxied with verification: block, header, commit, validators,
-status (verified tip). Unverifiable routes (tx submission) pass
-through to the primary."""
+status (verified tip), abci_query (merkle proof-op chain against the
+light-verified AppHash of height+1 — value AND absence responses,
+reference light/rpc/client.go:126-187) and tx (inclusion proof
+against the verified header's data hash, :473). Unverifiable routes
+(tx submission) pass through to the primary."""
 
 from __future__ import annotations
 
@@ -116,8 +119,116 @@ class LightProxy:
                 },
                 "verified": True,
             }
-        # passthrough (tx submission, queries)
+        if method == "abci_query":
+            return await self._verified_abci_query(params)
+        if method == "tx":
+            return await self._verified_tx(params)
+        # passthrough (tx submission, unverifiable routes)
         return await self.primary.call(method, **params)
+
+    async def _verified_abci_query(self, params: Dict[str, Any]):
+        """ABCI query with merkle proof verification against the
+        light-verified AppHash (reference light/rpc/client.go:126-187):
+        the primary is forced to prove=true, the proof-op chain must
+        land on the AppHash of the light block at height+1 (the header
+        that commits the post-height state), and BOTH value and
+        absence responses are proven — a primary that tampers with
+        either gets rejected, not relayed."""
+        import base64
+
+        from ..crypto import merkle
+
+        params = dict(params)
+        params["prove"] = True
+        res = await self.primary.call("abci_query", **params)
+        resp = res.get("response") or {}
+        code = int(resp.get("code") or 0)
+        key = base64.b64decode(resp.get("key") or "")
+        value = base64.b64decode(resp.get("value") or "")
+        # the proof must be for the key the CALLER asked about — a
+        # primary substituting another committed key's (genuinely
+        # provable) value or absence must be rejected, not relayed
+        from ..rpc.core import _bytes_param
+
+        requested = _bytes_param(params.get("data"))
+        if key != requested:
+            raise RuntimeError(
+                "primary answered for a different key than requested"
+            )
+        h = int(resp.get("height") or 0)
+        if h <= 0:
+            raise RuntimeError("primary returned no proof height")
+        ops_b64 = resp.get("proof_ops") or ""
+        if not ops_b64:
+            raise RuntimeError(
+                "primary returned no proof ops (app without prove "
+                "support cannot be light-verified)"
+            )
+        ops = merkle.decode_proof_ops(base64.b64decode(ops_b64))
+        # the proof lands on the AppHash of height+1, which only exists
+        # once the NEXT block commits; at the tip that is up to one
+        # block interval away — wait bounded for the chain to advance
+        deadline = time.monotonic() + 15.0
+        while True:
+            st = await self.primary.status()
+            if (
+                int(st["sync_info"]["latest_block_height"]) >= h + 1
+            ):
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"chain did not reach proof height {h + 1}"
+                )
+            await asyncio.sleep(0.1)
+        lb = await self._verified_light_block(h + 1)
+        rt = merkle.ProofRuntime()
+        # route by CODE, not value truthiness: a key legitimately
+        # committed with an EMPTY value still gets a value proof
+        if code == 0:
+            rt.verify_value(ops, lb.header.app_hash, key, value)
+        else:
+            rt.verify_absence(ops, lb.header.app_hash, key)
+        res["verified"] = True
+        return res
+
+    async def _verified_tx(self, params: Dict[str, Any]):
+        """Tx lookup with inclusion-proof verification against the
+        light-verified header's data hash (reference
+        light/rpc/client.go:473)."""
+        import base64
+
+        from ..crypto import merkle
+        from ..types.block import tx_hash
+
+        params = dict(params)
+        params["prove"] = True
+        res = await self.primary.call("tx", **params)
+        height = int(res.get("height") or 0)
+        proof = res.get("proof") or {}
+        if not proof.get("proof_b64"):
+            raise RuntimeError("primary returned no tx inclusion proof")
+        tx_bytes = base64.b64decode(res.get("tx") or "")
+        # the returned tx must BE the one the caller asked about — an
+        # inclusion proof for a different (genuinely committed) tx
+        # would otherwise verify
+        from ..rpc.core import _bytes_param
+
+        requested = _bytes_param(params.get("hash"))
+        if requested and tx_hash(tx_bytes) != requested:
+            raise RuntimeError(
+                "primary returned a different tx than requested"
+            )
+        p = merkle.decode_proof(
+            base64.b64decode(proof["proof_b64"])
+        )
+        lb = await self._verified_light_block(height)
+        if not p.verify(lb.header.data_hash, tx_hash(tx_bytes)):
+            raise RuntimeError(
+                "tx inclusion proof does not verify against the "
+                "light-verified header"
+            )
+        res["verified"] = True
+        return res
 
     # --- http plumbing -------------------------------------------------
 

@@ -14,14 +14,22 @@ import json
 from typing import Dict, List
 
 from ..abci import types as abci
+from ..crypto import merkle
 
 VALIDATOR_TX_PREFIX = b"val:"
 
 
 class KVStoreApplication(abci.Application):
-    def __init__(self, persist_path: str = None):
+    def __init__(self, persist_path: str = None, prove: bool = False):
         self.state: Dict[bytes, bytes] = {}
         self.height = 0
+        # prove=True: the app hash becomes SHA-256(height || merkle
+        # root over the sorted KV leaves) and Query(prove=True) returns
+        # proof ops a light client can check against a verified AppHash
+        # (crypto/merkle ProofRuntime; reference light/rpc/client.go).
+        # Off by default: the flat legacy hash keeps existing chains
+        # (incl. the cached bench corpus) byte-stable.
+        self.prove = prove
         # reference abci/example/kvstore PersistentKVStoreApplication:
         # survive restarts so the handshake replay path is exercised
         self.persist_path = persist_path
@@ -72,13 +80,24 @@ class KVStoreApplication(abci.Application):
 
     # --- hashing ------------------------------------------------------
 
-    def _compute_hash(self) -> bytes:
+    @staticmethod
+    def _hash_state(height: int, state: Dict[bytes, bytes], prove: bool):
+        if prove:
+            root = merkle.hash_from_byte_slices(
+                [merkle.kv_leaf(k, state[k]) for k in sorted(state)]
+            )
+            return hashlib.sha256(
+                height.to_bytes(8, "big") + root
+            ).digest()
         h = hashlib.sha256()
-        h.update(self.height.to_bytes(8, "big"))
-        for k in sorted(self.state):
+        h.update(height.to_bytes(8, "big"))
+        for k in sorted(state):
             h.update(len(k).to_bytes(4, "big") + k)
-            h.update(len(self.state[k]).to_bytes(4, "big") + self.state[k])
+            h.update(len(state[k]).to_bytes(4, "big") + state[k])
         return h.digest()
+
+    def _compute_hash(self) -> bytes:
+        return self._hash_state(self.height, self.state, self.prove)
 
     # --- info/query ---------------------------------------------------
 
@@ -94,13 +113,77 @@ class KVStoreApplication(abci.Application):
     def query(self, req):
         if req.path == "/store" or req.path == "":
             v = self.state.get(req.data)
+            proof_ops = b""
+            if req.prove and self.prove:
+                proof_ops = merkle.encode_proof_ops(
+                    self._query_proof(req.data, v)
+                )
             return abci.ResponseQuery(
                 code=abci.CODE_TYPE_OK if v is not None else 1,
                 key=req.data,
                 value=v or b"",
                 height=self.height,
+                proof_ops=proof_ops,
             )
         return abci.ResponseQuery(code=1, log=f"unknown path {req.path}")
+
+    def _query_proof(self, key: bytes, value):
+        """Proof-op chain for one committed key (or its absence):
+        inclusion/absence against the sorted-KV merkle root, then the
+        app-hash binding op (see crypto/merkle proof operators).
+
+        The full proof-trail set is built once per committed height
+        (state only changes at commit) and cached — per-query cost is
+        then one bisect plus 1-2 proof encodings, not an O(n log n)
+        tree rebuild."""
+        import bisect
+
+        from ..utils import proto
+
+        cache = getattr(self, "_proof_cache", None)
+        if cache is None or cache[0] != self.height:
+            keys = sorted(self.state)
+            _, proofs = merkle.proofs_from_byte_slices(
+                [merkle.kv_leaf(k, self.state[k]) for k in keys]
+            )
+            cache = (self.height, keys, proofs)
+            self._proof_cache = cache
+        _, keys, proofs = cache
+
+        def neighbor(i: int) -> bytes:
+            return proto.field_message(
+                1,
+                proto.field_bytes(
+                    1, merkle.encode_proof(proofs[i])
+                )
+                + proto.field_bytes(2, keys[i])
+                + proto.field_bytes(3, self.state[keys[i]]),
+            )
+
+        if value is not None:
+            idx = bisect.bisect_left(keys, key)
+            first = merkle.ProofOp(
+                merkle.OP_KV_VALUE,
+                key,
+                merkle.encode_proof(proofs[idx]),
+            )
+        else:
+            pos = bisect.bisect_left(keys, key)
+            nbs = b""
+            if keys:
+                if pos == 0:
+                    nbs = neighbor(0)
+                elif pos == len(keys):
+                    nbs = neighbor(len(keys) - 1)
+                else:
+                    nbs = neighbor(pos - 1) + neighbor(pos)
+            first = merkle.ProofOp(merkle.OP_KV_ABSENCE, key, nbs)
+        app_op = merkle.ProofOp(
+            merkle.OP_APP_HASH,
+            b"",
+            proto.field_varint(1, self.height),
+        )
+        return [first, app_op]
 
     # --- mempool ------------------------------------------------------
 
@@ -206,16 +289,12 @@ class KVStoreApplication(abci.Application):
         # stage, compute prospective hash
         pending = dict(self.state)
         pending.update(self.staged)
-        h = hashlib.sha256()
-        h.update(req.height.to_bytes(8, "big"))
-        for k in sorted(pending):
-            h.update(len(k).to_bytes(4, "big") + k)
-            h.update(len(pending[k]).to_bytes(4, "big") + pending[k])
-        self._pending = (req.height, pending, h.digest())
+        app_hash = self._hash_state(req.height, pending, self.prove)
+        self._pending = (req.height, pending, app_hash)
         return abci.ResponseFinalizeBlock(
             tx_results=results,
             validator_updates=list(self.val_updates),
-            app_hash=h.digest(),
+            app_hash=app_hash,
         )
 
     def commit(self):

@@ -42,7 +42,13 @@ MSG_ERROR_RESPONSE = 0x7F
 
 
 class RemoteSignerError(Exception):
-    pass
+    """Definitive signer-side refusal (e.g. the double-sign guard) or
+    exhausted retries — never retried."""
+
+
+class SignerUnavailableError(ConnectionError):
+    """No signer currently connected — transient: the signer redials
+    (serve_forever) and RetrySignerClient retries through it."""
 
 
 async def _send(sconn: SecretConnection, mtype: int, payload: bytes = b""):
@@ -119,21 +125,47 @@ class SignerClient:
 
     def wait_for_signer(self, timeout_s: float = 30.0) -> None:
         if not self._connected.wait(timeout_s):
-            raise RemoteSignerError("no remote signer connected")
+            raise SignerUnavailableError("no remote signer connected")
 
     # --- request/response ------------------------------------------------
 
     def _call(self, mtype: int, payload: bytes = b""):
+        import concurrent.futures
+
         self.wait_for_signer(self.timeout_s)
         with self._lock:
             fut = asyncio.run_coroutine_threadsafe(
                 self._roundtrip(mtype, payload), self._loop
             )
-            return fut.result(self.timeout_s)
+            try:
+                return fut.result(self.timeout_s)
+            except RemoteSignerError:
+                raise  # clean protocol response; stream still in sync
+            except concurrent.futures.TimeoutError:
+                # the orphaned round trip may still complete later and
+                # leave a stale response in the stream: every request
+                # after that would read the WRONG response. Drop the
+                # connection so the signer redials and both ends
+                # resync (the reference drops on timeout too).
+                self._drop_conn()
+                raise
+            except Exception:
+                # transport-level failure: the stream state is unknown
+                self._drop_conn()
+                raise
+
+    def _drop_conn(self) -> None:
+        sconn, self._sconn = self._sconn, None
+        self._connected.clear()
+        if sconn is not None:
+            self._loop.call_soon_threadsafe(sconn.close)
 
     async def _roundtrip(self, mtype: int, payload: bytes):
-        await _send(self._sconn, mtype, payload)
-        rtype, body = await _recv(self._sconn)
+        sconn = self._sconn
+        if sconn is None:
+            raise ConnectionError("remote signer disconnected")
+        await _send(sconn, mtype, payload)
+        rtype, body = await _recv(sconn)
         if rtype == MSG_ERROR_RESPONSE:
             raise RemoteSignerError(body.decode() or "remote signer error")
         return rtype, body
@@ -209,6 +241,89 @@ class SignerClient:
         self._loop.call_soon_threadsafe(self._loop.stop)
 
 
+class RetrySignerClient:
+    """Retrying PrivValidator wrapper around SignerClient (reference
+    privval/retry_signer_client.go): a transient signer hiccup — a
+    dropped connection, a slow redial, a request timeout — must cost a
+    bounded delay, not a missed vote or proposal.
+
+    retries=0 retries forever (the reference's semantics for 0).
+    DEFINITIVE signer refusals (the signer answered with an error
+    payload, e.g. the double-sign guard) are NOT retried: re-asking an
+    HSM to double-sign is never correct and only delays the round —
+    the one deliberate deviation from the reference, which retries
+    every error class."""
+
+    REMOTE_BLOCKING = True
+
+    def __init__(
+        self,
+        client: SignerClient,
+        retries: int = 5,
+        interval_s: float = 0.2,
+    ):
+        self.client = client
+        self.retries = retries
+        self.interval_s = interval_s
+
+    def _retry(self, what: str, fn, *args):
+        import concurrent.futures
+        import time as _t
+
+        n = 0
+        last: Optional[Exception] = None
+        while self.retries == 0 or n < self.retries:
+            try:
+                return fn(*args)
+            except RemoteSignerError:
+                raise  # definitive refusal (e.g. double-sign guard)
+            except (
+                concurrent.futures.TimeoutError,
+                TimeoutError,
+                ConnectionError,
+                OSError,
+                asyncio.IncompleteReadError,
+            ) as e:
+                last = e
+            n += 1
+            _t.sleep(self.interval_s)
+        raise RemoteSignerError(
+            f"{what}: exhausted {self.retries} retries "
+            f"(last: {last!r})"
+        )
+
+    # --- PrivValidator interface (all retried) -------------------------
+
+    def pub_key(self) -> Ed25519PubKey:
+        return self._retry("pub_key", self.client.pub_key)
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        self._retry("sign_vote", self.client.sign_vote, chain_id, vote)
+
+    def sign_vote_extension(self, chain_id: str, vote: Vote) -> None:
+        self._retry(
+            "sign_vote_extension",
+            self.client.sign_vote_extension,
+            chain_id,
+            vote,
+        )
+
+    def sign_proposal(self, chain_id: str, prop: Proposal) -> None:
+        self._retry(
+            "sign_proposal", self.client.sign_proposal, chain_id, prop
+        )
+
+    def wait_for_signer(self, timeout_s: float = 30.0) -> None:
+        self.client.wait_for_signer(timeout_s)
+
+    @property
+    def listen_addr(self) -> str:
+        return self.client.listen_addr
+
+    def close(self) -> None:
+        self.client.close()
+
+
 class SignerServer:
     """Signer-side daemon: dials the validator node and serves signing
     requests from a FilePV (reference privval/signer_server.go +
@@ -220,6 +335,27 @@ class SignerServer:
         self.addr = addr
         self._auth_priv = auth_priv or self.pv.priv_key
         self._stopped = False
+
+    async def serve_forever(self, redial_interval_s: float = 0.2) -> None:
+        """serve() with redial: when the connection to the node drops
+        (or the node is not up yet), dial again after a short pause —
+        the reference's SignerDialerEndpoint retry behavior
+        (privval/signer_dialer_endpoint.go). Pairs with the node-side
+        RetrySignerClient so a transient drop heals from both ends."""
+        while not self._stopped:
+            try:
+                await self.serve()
+            except (
+                ConnectionError,
+                OSError,
+                # IncompleteReadError (an EOFError, NOT an OSError):
+                # node closed the socket mid-handshake, e.g. a restart
+                EOFError,
+                asyncio.TimeoutError,
+            ):
+                pass
+            if not self._stopped:
+                await asyncio.sleep(redial_interval_s)
 
     async def serve(self) -> None:
         host, _, port = _strip_scheme(self.addr).rpartition(":")

@@ -505,6 +505,12 @@ def abci_query(env, path="", data=None, height=0, prove=False) -> Dict[str, Any]
             "key": enc.b64(res.key) if res.key else "",
             "value": enc.b64(res.value) if res.value else "",
             "height": str(res.height),
+            # encoded crypto/merkle proof-op chain (apps that support
+            # prove=true); light proxies verify it against the
+            # light-verified AppHash of height+1
+            "proof_ops": enc.b64(res.proof_ops)
+            if getattr(res, "proof_ops", b"")
+            else "",
         }
     }
 
@@ -520,13 +526,33 @@ def tx(env, hash=None, prove=False) -> Dict[str, Any]:
     if res is None:
         raise RPCError(-32603, f"tx {key.hex()} not found")
     height, index, tx_bytes, tx_result = res
-    return {
+    out = {
         "hash": enc.hexb(key),
         "height": str(height),
         "index": index,
         "tx_result": enc.tx_result_json(tx_result),
         "tx": enc.b64(tx_bytes),
     }
+    if _bool(prove):
+        # merkle inclusion proof of the tx against the block's
+        # data_hash (reference rpc/core/tx.go Prove; the light proxy
+        # verifies it against the light-verified header)
+        blk = env.block_store.load_block(height)
+        if blk is not None and index < len(blk.data.txs):
+            from ..crypto import merkle
+            from ..types.block import tx_hash
+
+            _, proofs = merkle.proofs_from_byte_slices(
+                [tx_hash(t) for t in blk.data.txs]
+            )
+            out["proof"] = {
+                "root_hash": enc.hexb(blk.header.data_hash),
+                "data": enc.b64(tx_bytes),
+                "proof_b64": enc.b64(
+                    merkle.encode_proof(proofs[index])
+                ),
+            }
+    return out
 
 
 def tx_search(
