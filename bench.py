@@ -471,7 +471,7 @@ def bench_replay(gen, parts, n_blocks: int) -> dict:
             # NEXT height's LastCommit, which only consensus provides
             # (reference pool.IsCaughtUp at maxPeerHeight-1)
             assert fresh.block_store.height() >= limit - 1
-            return dt
+            return dt, dict(reactor.pipeline_stats)
 
         return asyncio.run(main())
 
@@ -484,11 +484,11 @@ def bench_replay(gen, parts, n_blocks: int) -> dict:
     # the same _pad_n lane bucket as the timed windows.
     crypto_batch.set_default_backend("tpu")
     replay(min(129, n_blocks), 128)
-    tpu_dt = replay(n_blocks, 128)
+    tpu_dt, pipe_stats = replay(n_blocks, 128)
     # CPU baseline: sequential verify on a 300-block slice, extrapolated
     crypto_batch.set_default_backend("cpu")
     cpu_slice = min(300, n_blocks)
-    cpu_dt = replay(cpu_slice, 128) * (n_blocks / cpu_slice)
+    cpu_dt = replay(cpu_slice, 128)[0] * (n_blocks / cpu_slice)
     crypto_batch.set_default_backend("tpu")
     return {
         "blocks": n_blocks,
@@ -497,6 +497,9 @@ def bench_replay(gen, parts, n_blocks: int) -> dict:
         "sigs_per_s": round(n_sigs / tpu_dt, 1),
         "cpu_wall_s_extrap": round(cpu_dt, 2),
         "vs_cpu": round(cpu_dt / tpu_dt, 2),
+        # pipelined-dispatch observability: reused ~= windows proves
+        # the lookahead overlap genuinely engaged during the run
+        "pipeline": pipe_stats,
     }
 
 
@@ -766,12 +769,6 @@ def main() -> None:
 
     if "kernel" in todo:
         configs["kernel"] = bench_kernel()
-        if (
-            _DEVICE_OK
-            and os.environ.get("GRAFT_PALLAS") != "1"
-            and os.environ.get("BENCH_SKIP_PALLAS") != "1"
-        ):
-            configs["kernel_pallas"] = bench_kernel_pallas()
     need_corpus = todo & {"commit150", "replay", "bisect"}
     if need_corpus:
         n_blocks = int(os.environ.get("BENCH_REPLAY_BLOCKS", "10000"))
@@ -789,6 +786,16 @@ def main() -> None:
         configs["pipeline"] = bench_pipeline()
     if "mixed" in todo:
         configs["mixed"] = bench_mixed()
+    # the Pallas A/B runs LAST: its budgeted subprocess may burn many
+    # minutes on a cold Mosaic compile, and the proven configs above
+    # must be recorded before that risk is taken
+    if (
+        "kernel" in todo
+        and _DEVICE_OK
+        and os.environ.get("GRAFT_PALLAS") != "1"
+        and os.environ.get("BENCH_SKIP_PALLAS") != "1"
+    ):
+        configs["kernel_pallas"] = bench_kernel_pallas()
 
     # headline = the better of the two ladder backends (both recorded:
     # detail.configs carries the full ablation either way)

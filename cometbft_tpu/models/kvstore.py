@@ -79,21 +79,71 @@ class KVStoreApplication(abci.Application):
         os.replace(tmp, self.persist_path)
 
     # --- hashing ------------------------------------------------------
+    #
+    # The flat hash walks EVERY committed kv each block, which turns
+    # quadratic over a long replay (10k blocks x growing state was
+    # ~40% of the projected host pipeline — docs/PERF.md round-4
+    # profile). The chunk cache keeps the per-key length-prefixed
+    # encoding in a sorted list maintained incrementally, so the
+    # per-block cost is the unavoidable hash updates plus O(delta log n)
+    # bookkeeping — the digest itself is UNCHANGED byte for byte.
 
     @staticmethod
-    def _hash_state(height: int, state: Dict[bytes, bytes], prove: bool):
+    def _chunk(k: bytes, v: bytes) -> bytes:
+        return (
+            len(k).to_bytes(4, "big") + k + len(v).to_bytes(4, "big") + v
+        )
+
+    def _chunks_for(self, state: Dict[bytes, bytes]):
+        """Sorted (key, chunk) list for ``state``: cached for the
+        committed state, and computed as a small sorted-overlay delta
+        for finalize_block's prospective (staged) state. commit()
+        promotes the overlay to the new committed cache."""
+        import bisect
+
+        cache = getattr(self, "_chunk_cache", None)
+        if cache is None or cache[0] is not self.state:
+            keys = sorted(self.state)
+            chunks = [self._chunk(k, self.state[k]) for k in keys]
+            cache = (self.state, keys, chunks)
+            self._chunk_cache = cache
+        if state is self.state:
+            return cache[1], cache[2]
+        keys, chunks = list(cache[1]), list(cache[2])
+        for k in sorted(
+            k for k in state if state[k] != self.state.get(k)
+        ):
+            i = bisect.bisect_left(keys, k)
+            ch = self._chunk(k, state[k])
+            if i < len(keys) and keys[i] == k:
+                chunks[i] = ch
+            else:
+                keys.insert(i, k)
+                chunks.insert(i, ch)
+        for k in self.state.keys() - state.keys():  # deletions (unused)
+            i = bisect.bisect_left(keys, k)
+            if i < len(keys) and keys[i] == k:
+                del keys[i]
+                del chunks[i]
+        self._chunk_cache_next = (state, keys, chunks)
+        return keys, chunks
+
+    def _hash_state(self, height: int, state: Dict[bytes, bytes], prove: bool):
+        keys, chunks = self._chunks_for(state)
         if prove:
             root = merkle.hash_from_byte_slices(
-                [merkle.kv_leaf(k, state[k]) for k in sorted(state)]
+                [
+                    merkle.kv_leaf(k, state[k])
+                    for k in keys
+                ]
             )
             return hashlib.sha256(
                 height.to_bytes(8, "big") + root
             ).digest()
         h = hashlib.sha256()
         h.update(height.to_bytes(8, "big"))
-        for k in sorted(state):
-            h.update(len(k).to_bytes(4, "big") + k)
-            h.update(len(state[k]).to_bytes(4, "big") + state[k])
+        for ch in chunks:
+            h.update(ch)
         return h.digest()
 
     def _compute_hash(self) -> bytes:
@@ -303,6 +353,12 @@ class KVStoreApplication(abci.Application):
         self.state = pending
         self.app_hash = app_hash
         self.staged = {}
+        # promote finalize's overlay chunks to the committed cache so
+        # the per-block hash stays incremental across commits
+        nxt = getattr(self, "_chunk_cache_next", None)
+        if nxt is not None and nxt[0] is pending:
+            self._chunk_cache = nxt
+            self._chunk_cache_next = None
         if self.height % 10 == 0:
             self._take_snapshot()
         self._persist()

@@ -225,6 +225,15 @@ class Commit:
 
     def hash(self) -> bytes:
         if self._hash is None:
+            from ..utils import wirecodec
+
+            nat = wirecodec.module()
+            if nat is not None:
+                try:  # one call: native sig encode + RFC 6962 fold
+                    self._hash = nat.commit_merkle_root(self.signatures)
+                    return self._hash
+                except Exception:  # pragma: no cover - odd sig shapes
+                    pass
             self._hash = merkle.hash_from_byte_slices(
                 [cs.encode() for cs in self.signatures]
             )

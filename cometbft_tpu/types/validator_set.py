@@ -98,14 +98,23 @@ class ValidatorSet:
         return None
 
     def hash(self) -> bytes:
-        return merkle.hash_from_byte_slices(
-            [v.encode() for v in self.validators]
-        )
+        # memoized: the hash covers only (pubkey, power) in canonical
+        # order — NOT proposer priorities — so it survives priority
+        # rotation and copies unchanged. The replay pipeline hashes
+        # the (unchanging) valset twice per height without this.
+        h = getattr(self, "_hash", None)
+        if h is None:
+            h = merkle.hash_from_byte_slices(
+                [v.encode() for v in self.validators]
+            )
+            self._hash = h
+        return h
 
     def copy(self) -> "ValidatorSet":
         vs = ValidatorSet.__new__(ValidatorSet)
         vs.validators = [v.copy() for v in self.validators]
         vs._by_address = dict(self._by_address)
+        vs._hash = getattr(self, "_hash", None)
         vs.proposer = (
             None
             if self.proposer is None
@@ -227,6 +236,7 @@ class ValidatorSet:
         new_vals.sort(key=lambda v: (-v.voting_power, v.address))
         self.validators = new_vals
         self._by_address = {v.address: i for i, v in enumerate(new_vals)}
+        self._hash = None  # membership/power changed: drop the memo
         self._shift_by_avg_proposer_priority()
         self.proposer = self._compute_max_priority_validator()
 

@@ -1,0 +1,86 @@
+"""Loader for the native wire codec (native/wirecodec.cpp).
+
+Follows the logdb pattern (utils/logdb.py): built on demand with g++
+into ~/.cache/cometbft_tpu (override with WIRECODEC_SO_DIR), loaded as
+a CPython extension module. ``module()`` returns the extension or None
+— callers (utils/codec.py) keep the pure-Python path as both the
+fallback and the semantic source of truth (the native decoder defers
+to Python on any ValueError, so adversarial-input behavior is
+identical across builds with and without a compiler).
+
+Replay-profile motivation: docs/PERF.md round-4 "replay host
+pipeline" — the commit encode/decode loop was ~25% of non-signature
+host time. GRAFT_NATIVE_CODEC=0 disables.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+import threading
+
+_SRC = os.path.join(
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+    "native",
+    "wirecodec.cpp",
+)
+_SO = os.path.join(
+    os.environ.get(
+        "WIRECODEC_SO_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "cometbft_tpu"),
+    ),
+    "_wirecodec.so",
+)
+
+_mod = None
+_tried = False
+_lock = threading.Lock()
+
+
+def module():
+    """The extension module, or None (no compiler / disabled)."""
+    global _mod, _tried
+    if _tried:
+        return _mod
+    with _lock:
+        if _tried:  # pragma: no cover - race
+            return _mod
+        _tried = True
+        if os.environ.get("GRAFT_NATIVE_CODEC") == "0":
+            return None
+        try:
+            if (
+                not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            ):
+                os.makedirs(os.path.dirname(_SO), exist_ok=True)
+                subprocess.run(
+                    [
+                        "g++",
+                        "-O2",
+                        "-std=c++17",
+                        "-shared",
+                        "-fPIC",
+                        "-I",
+                        sysconfig.get_paths()["include"],
+                        _SRC,
+                        "-o",
+                        _SO,
+                    ],
+                    check=True,
+                    capture_output=True,
+                )
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "_wirecodec", _SO
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _mod = mod
+        except Exception:  # pragma: no cover - toolchain-dependent
+            _mod = None
+        return _mod

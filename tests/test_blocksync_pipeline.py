@@ -39,7 +39,7 @@ class _DispatchCounter:
         )
 
 
-def _sync(gen, src, window=8, peers=None):
+def _sync(gen, src, window=8, peers=None, prefill=0):
     async def main():
         fresh = build_node(gen, None)
         caught = asyncio.Event()
@@ -54,6 +54,15 @@ def _sync(gen, src, window=8, peers=None):
             reactor.pool.set_peer_range(
                 name, client, 1, src.block_store.height()
             )
+        # deterministic pipelining on a loaded box: let the requesters
+        # buffer a lookahead BEFORE the verify loop starts, so the
+        # predispatch/reuse/discard sequence doesn't depend on fetch
+        # timing (set_peer_range already spawned the requesters)
+        deadline = asyncio.get_running_loop().time() + 30
+        while len(reactor.pool.blocks) < prefill:
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError("pool prefill")
+            await asyncio.sleep(0.01)
         await reactor.start()
         await asyncio.wait_for(caught.wait(), 90)
         await reactor.stop()
@@ -70,7 +79,7 @@ def test_pipeline_reuses_predispatched_windows(monkeypatch):
     gen, pvs = make_genesis(3, chain_id="pipe-chain")
     src = make_chain(gen, [pv.priv_key for pv in pvs], 40)
     counter = _DispatchCounter(monkeypatch)
-    fresh, reactor = _sync(gen, src, window=8)
+    fresh, reactor = _sync(gen, src, window=8, prefill=24)
     assert fresh.block_store.height() >= src.block_store.height() - 1
     jobs_total = sum(counter.calls)
     applied = reactor.blocks_applied
@@ -88,36 +97,67 @@ def test_pipeline_reuses_predispatched_windows(monkeypatch):
     assert stats["reused"] >= 2, stats
 
 
-def test_pipeline_discards_on_refetch(monkeypatch):
-    """A mid-chain tampered block forces redo/ban: the pass breaks,
-    the pre-dispatched handle must be dropped (its block objects get
-    refetched), and the sync still converges on honest content."""
-    from cometbft_tpu.utils.chaingen import TamperingPeerClient
+def test_pipeline_discards_on_refetch():
+    """Deterministic direct drive of _process_window (no network
+    races over which peer serves the bad height): a tampered block
+    mid-window breaks the pass, the pre-dispatched handle is dropped
+    (discarded), the refetched honest block forces a FRESH dispatch,
+    and the sync completes with honest content."""
+    from cometbft_tpu.utils import codec
 
     gen, pvs = make_genesis(3, chain_id="pipe-evil")
-    src = make_chain(gen, [pv.priv_key for pv in pvs], 40)
-    counter = _DispatchCounter(monkeypatch)
-    fresh, reactor = _sync(
-        gen,
-        src,
-        window=8,
-        peers=[
-            ("evil", TamperingPeerClient(src, bad_height=12)),
-            ("good", StorePeerClient(src)),
-        ],
+    src = make_chain(gen, [pv.priv_key for pv in pvs], 24)
+    fresh = build_node(gen, None)
+    reactor = BlockSyncReactor(
+        fresh.state,
+        fresh.block_exec,
+        fresh.block_store,
+        verify_window=8,
     )
-    assert fresh.block_store.height() >= src.block_store.height() - 1
+
+    def fill(h0, h1, tamper=()):
+        for h in range(h0, h1 + 1):
+            if h in reactor.pool.blocks:
+                continue
+            blk = src.block_store.load_block(h)
+            if h in tamper:
+                # same corruption as TamperingPeerClient: an injected
+                # tx changes the data hash, so blk.hash() no longer
+                # matches what h+1's commit signed
+                blk.data.txs = list(blk.data.txs) + [b"evil=1"]
+                blk.data._hash = None
+                if hasattr(blk, "_raw_bytes"):
+                    del blk._raw_bytes
+            reactor.pool.blocks[h] = (blk, "evil" if h in tamper else "good")
+
+    # pass 1: clean window 1..7 applied; lookahead 8..14 pre-dispatched
+    fill(1, 17, tamper={12})
+    applied = reactor._process_window(reactor.pool.peek_window(16))
+    assert applied == 7
+    assert reactor._inflight is not None
+    assert reactor.pipeline_stats["predispatched"] == 1
+
+    # pass 2: reuses the lookahead, applies 8..11, breaks at the
+    # tampered 12 -> its own lookahead (15..) must be DISCARDED
+    applied = reactor._process_window(reactor.pool.peek_window(16))
+    assert applied == 4, applied
+    assert reactor._inflight is None
+    assert reactor.pipeline_stats["reused"] == 1
+    assert reactor.pipeline_stats["discarded"] >= 1, (
+        reactor.pipeline_stats
+    )
+
+    # the redo dropped the tampered block; refetch honest + continue:
+    # the refetched window cannot match any old key -> fresh dispatch
+    before = reactor.pipeline_stats["dispatched"]
+    fill(12, 17)
+    applied = reactor._process_window(reactor.pool.peek_window(16))
+    assert applied >= 5
+    assert reactor.pipeline_stats["dispatched"] == before + 1
     assert (
         fresh.block_store.load_block(12).hash()
         == src.block_store.load_block(12).hash()
     )
-    # the failed pass genuinely DROPPED its pre-dispatched handle (the
-    # tampered window forced a redo, so the lookahead could not be
-    # carried over) — and the pipeline still worked around it
-    assert reactor.pipeline_stats["discarded"] >= 1, (
-        reactor.pipeline_stats
-    )
-    assert reactor.pipeline_stats["reused"] >= 1, reactor.pipeline_stats
 
 
 def test_pipeline_discards_across_valset_change(monkeypatch):

@@ -50,10 +50,16 @@ def test_value_and_absence_proofs_roundtrip(proved_app):
     ops, res = _ops(proved_app, b"c")
     assert res.value == b"3"
     rt.verify_value(ops, proved_app.app_hash, b"c", b"3")
-    # a committed EMPTY value is provable as a value (not absence)
-    proved_app.state[b"d"] = b""
+    # a committed EMPTY value is provable as a value (not absence).
+    # NOTE: state changes follow the commit contract — a NEW dict at a
+    # new height (the app's hash/proof caches key on state identity
+    # and height; in-place mutation between commits never happens in
+    # production)
+    new_state = dict(proved_app.state)
+    new_state[b"d"] = b""
+    proved_app.state = new_state
+    proved_app.height = 8
     proved_app.app_hash = proved_app._compute_hash()
-    proved_app._proof_cache = None
     ops, res = _ops(proved_app, b"d")
     assert res.code == 0 and res.value == b""
     rt.verify_value(ops, proved_app.app_hash, b"d", b"")
