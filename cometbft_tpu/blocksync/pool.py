@@ -45,11 +45,14 @@ class PoolPeer:
 
 
 class BlockPool:
-    """Downloads [start_height ..] keeping MAX_PENDING in flight."""
+    """Downloads [start_height ..] keeping ``self.max_pending`` in
+    flight (defaults to MAX_PENDING; the reactor raises it to cover
+    its verify-window lookahead — see start_requesters)."""
 
     def __init__(self, start_height: int):
         self.start_height = start_height
         self.height = start_height  # next height to hand to verify loop
+        self.max_pending = MAX_PENDING  # see start_requesters note
         self.peers: Dict[str, PoolPeer] = {}
         self.blocks: Dict[int, Tuple[object, str]] = {}  # h -> (block, peer)
         # soft per-height exclusions (e.g. "peer lacks the extended
@@ -116,10 +119,17 @@ class BlockPool:
         return candidates[0]
 
     # --- requesters ---------------------------------------------------
+    #
+    # max_pending is an instance attribute so the reactor can raise it
+    # to cover its verify-window LOOKAHEAD: the pipelined dispatch
+    # needs ~2x verify_window buffered blocks or the next-window
+    # pre-dispatch never has a tail to work with (found empirically:
+    # a 128-wide bench replay had predispatched=0 with the fixed
+    # 64-deep pool).
 
     def start_requesters(self) -> None:
         top = min(
-            self.height + MAX_PENDING - 1, self.max_peer_height()
+            self.height + self.max_pending - 1, self.max_peer_height()
         )
         for h in range(self.height, top + 1):
             self._maybe_spawn(h)
@@ -131,7 +141,7 @@ class BlockPool:
             or height in self._tasks
             or height < self.height
             or height > self.max_peer_height()
-            or height >= self.height + MAX_PENDING
+            or height >= self.height + self.max_pending
         ):
             return
         self._tasks[height] = asyncio.create_task(self._fetch(height))

@@ -60,6 +60,13 @@ class BlockSyncReactor:
         self.block_exec = block_exec
         self.block_store = block_store
         self.pool = pool or BlockPool(state.last_block_height + 1)
+        # the pipelined verify needs ~2x the verify window buffered
+        # (current window + pre-dispatched lookahead + the +1 commit
+        # block); a pool shallower than that silently disables the
+        # overlap (see pool.start_requesters)
+        self.pool.max_pending = max(
+            self.pool.max_pending, 2 * verify_window + 2
+        )
         self.sig_cache = signature_cache or T.SignatureCache()
         self.on_caught_up = on_caught_up
         self.ingestor = block_ingestor

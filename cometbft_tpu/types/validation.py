@@ -44,27 +44,35 @@ class ErrInvalidSignature(CommitVerifyError):
 
 
 def _commit_sign_bytes(chain_id: str, commit: Commit, cs) -> bytes:
-    """Sign bytes for one CommitSig; the timestamp-independent parts
-    are memoized on the commit (one prefix per block-id flag class —
-    decoded commits are immutable by convention, codec.decode_commit),
-    so a 150-signature commit encodes them once, not 150 times."""
+    """Sign bytes for one CommitSig, memoized on the commit (decoded
+    commits are immutable by convention, codec.decode_commit) at two
+    levels: the timestamp-independent (prefix, suffix) per block-id
+    flag class, and the FINISHED bytes per (flag, timestamp) —
+    proposer-aligned voting makes many signatures of one commit share
+    a timestamp, so a 150-signature commit often encodes once, and
+    never more than once per distinct timestamp."""
     parts = getattr(commit, "_sb_parts", None)
     if parts is None:
         parts = {}
         commit._sb_parts = parts
     flag_commit = cs.block_id_flag == BLOCK_ID_FLAG_COMMIT
-    key = (chain_id, flag_commit)
-    ps = parts.get(key)
-    if ps is None:
-        ps = vote_sign_bytes_parts(
-            chain_id,
-            PRECOMMIT_TYPE,
-            commit.height,
-            commit.round,
-            cs.block_id(commit.block_id),
-        )
-        parts[key] = ps
-    return finish_vote_sign_bytes(ps[0], ps[1], cs.timestamp_ns)
+    key = (chain_id, flag_commit, cs.timestamp_ns)
+    sb = parts.get(key)
+    if sb is None:
+        pkey = (chain_id, flag_commit)
+        ps = parts.get(pkey)
+        if ps is None:
+            ps = vote_sign_bytes_parts(
+                chain_id,
+                PRECOMMIT_TYPE,
+                commit.height,
+                commit.round,
+                cs.block_id(commit.block_id),
+            )
+            parts[pkey] = ps
+        sb = finish_vote_sign_bytes(ps[0], ps[1], cs.timestamp_ns)
+        parts[key] = sb
+    return sb
 
 
 def _basic_checks(
