@@ -43,6 +43,9 @@ class BaseConfig:
 @dataclass
 class RPCConfig:
     laddr: str = "tcp://127.0.0.1:26657"
+    # legacy gRPC broadcast API (Ping/BroadcastTx) beside JSON-RPC
+    # (reference GRPCListenAddress, rpc/grpc/api.go); "" = disabled
+    grpc_laddr: str = ""
     max_open_connections: int = 900
     max_subscription_clients: int = 100
     timeout_broadcast_tx_commit_s: float = 10.0

@@ -164,6 +164,7 @@ class Node:
         self._adaptive = adaptive
         self._cs_started = False
         self.rpc_server = None
+        self.grpc_server = None
         self._statesync_task = None
         self.statesync_error = None
         self.metrics = None
@@ -274,11 +275,26 @@ class Node:
             chain=self.genesis.chain_id,
             height=self.parts.block_store.height(),
         )
+        rpc_env = None
         if self.config.rpc.laddr:
             from ..rpc import Environment, RPCServer
 
-            self.rpc_server = RPCServer(Environment.from_node(self))
+            rpc_env = Environment.from_node(self)
+            self.rpc_server = RPCServer(rpc_env)
             await self.rpc_server.start(_strip_proto(self.config.rpc.laddr))
+        if self.config.rpc.grpc_laddr:
+            # legacy gRPC broadcast API (reference rpc/grpc) — serves
+            # even when the JSON-RPC listener is disabled
+            from ..rpc import Environment
+            from ..rpc.grpc_api import GRPCBroadcastServer
+
+            self.grpc_server = GRPCBroadcastServer(
+                rpc_env or Environment.from_node(self),
+                _strip_proto(self.config.rpc.grpc_laddr),
+                asyncio.get_running_loop(),
+                timeout_s=self.config.rpc.timeout_broadcast_tx_commit_s,
+            )
+            self.grpc_server.start()
         if self.config.instrumentation.prometheus:
             from ..utils.metrics import MetricsServer, NodeMetrics
 
@@ -335,6 +351,8 @@ class Node:
             await self.metrics_server.stop()
         if self.debug_server is not None:
             await self.debug_server.stop()
+        if self.grpc_server is not None:
+            self.grpc_server.stop()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
         if self._cs_started:
