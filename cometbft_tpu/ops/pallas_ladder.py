@@ -36,10 +36,14 @@ from jax.experimental.pallas import tpu as pltpu
 from . import curve25519 as curve
 from . import fe25519 as fe
 
-# lanes per grid step = BLOCK_SUBLANES * 128. At 8 sublanes (1024
-# lanes) the table slice is 5.2 MB — comfortably inside VMEM with the
-# digit planes (~0.5 MB) and working set; bench-tunable.
-BLOCK_SUBLANES = int(os.environ.get("GRAFT_PALLAS_SUBLANES", "8"))
+# lanes per grid step = BLOCK_SUBLANES * 128. At 4 sublanes (512
+# lanes) the table slice is 2.6 MB — with Pallas's default
+# double-buffering of input/output blocks plus digit planes and the
+# working set that stays well inside the ~16 MB VMEM budget; 8
+# sublanes doubles table residency and may not (untested on silicon —
+# the platform was down all round 4), so the default is the safe one.
+# Bench-tunable via GRAFT_PALLAS_SUBLANES.
+BLOCK_SUBLANES = int(os.environ.get("GRAFT_PALLAS_SUBLANES", "4"))
 
 def pallas_enabled() -> bool:
     """Ladder backend selection: GRAFT_PALLAS=1 opts in; default off
