@@ -54,14 +54,26 @@ def _h(prefix: bytes, height: int) -> bytes:
 def _encode_prio_vector(vs: ValidatorSet) -> bytes:
     """Packed exact priorities + proposer index for one valset: count,
     then one (possibly negative -> 10-byte) varint per validator in
-    stored order, then proposer_index+1 (0 = no proposer)."""
-    out = bytearray(proto.varint(len(vs.validators)))
+    stored order, then proposer_index+1 (0 = no proposer). Three of
+    these encode per replayed height (the slim state blob), so the
+    varint loop takes the native bulk encoder when available."""
     prop_idx = 0
-    for i, v in enumerate(vs.validators):
-        out += proto.varint(v.proposer_priority)
-        if vs.proposer is not None and v.address == vs.proposer.address:
-            prop_idx = i + 1
-    out += proto.varint(prop_idx)
+    if vs.proposer is not None:
+        prop_idx = vs._by_address.get(vs.proposer.address, -1) + 1
+    nums = [len(vs.validators)]
+    nums.extend(v.proposer_priority for v in vs.validators)
+    nums.append(prop_idx)
+    from ..utils import wirecodec
+
+    nat = wirecodec.module()
+    if nat is not None:
+        try:
+            return nat.varints(nums)
+        except Exception:  # pragma: no cover - >64-bit priorities
+            pass
+    out = bytearray()
+    for x in nums:
+        out += proto.varint(x)
     return bytes(out)
 
 

@@ -588,7 +588,33 @@ static PyObject* wc_commit_merkle_root(PyObject*, PyObject* args) {
   return PyBytes_FromStringAndSize((const char*)out, 32);
 }
 
+// varints(seq_of_ints) -> bytes: concatenated LEB128 varints with the
+// proto writer's semantics (negatives as 10-byte two's complement) —
+// the state store's priority-vector hot loop.
+static PyObject* wc_varints(PyObject*, PyObject* args) {
+  PyObject* seq_in;
+  if (!PyArg_ParseTuple(args, "O", &seq_in)) return nullptr;
+  PyObject* seq = PySequence_Fast(seq_in, "varints needs a sequence");
+  if (!seq) return nullptr;
+  Buf out;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* it = PySequence_Fast_GET_ITEM(seq, i);
+    long long v = PyLong_AsLongLong(it);
+    if (v == -1 && PyErr_Occurred()) {  // non-int or >64-bit
+      Py_DECREF(seq);
+      return nullptr;
+    }
+    out.put_varint((uint64_t)(int64_t)v);
+  }
+  Py_DECREF(seq);
+  return PyBytes_FromStringAndSize((const char*)out.d.data(),
+                                   (Py_ssize_t)out.d.size());
+}
+
 static PyMethodDef Methods[] = {
+    {"varints", wc_varints, METH_VARARGS,
+     "varints(ints) -> concatenated LEB128 bytes"},
     {"encode_commit", wc_encode_commit, METH_VARARGS,
      "encode_commit(height, round, bid_bytes, sigs) -> bytes"},
     {"decode_commit", wc_decode_commit, METH_VARARGS,

@@ -186,6 +186,17 @@ def test_native_sha256_edge_lengths():
         ), ln
 
 
+def test_varints_byte_identical():
+    for _ in range(50):
+        nums = [
+            rng.randrange(-(2**63), 2**63)
+            for _ in range(rng.randrange(0, 60))
+        ]
+        assert nat.varints(nums) == b"".join(
+            proto.varint(x) for x in nums
+        )
+
+
 def test_commit_hash_native_equals_python():
     for _ in range(20):
         c = _commit(rng.randrange(0, 160))
