@@ -68,6 +68,7 @@ def generate_one(seed: int) -> Manifest:
             mode="validator",
             power=rng.choice((10, 10, 10, 5, 20)),
             db=rng.choice(DBS),
+            grpc=rng.random() < 0.35,
         )
         # a single-validator net must keep its only proposer alive
         if n_vals > 1:

@@ -50,6 +50,7 @@ class NodeSpec:
     adaptive_sync: bool = False
     mempool: str = "clist"
     db: str = "sqlite"  # sqlite | logdb (native engine) | memdb
+    grpc: bool = False  # serve the legacy gRPC broadcast API
     perturbations: List[Perturbation] = field(default_factory=list)
 
 
@@ -84,6 +85,7 @@ class Manifest:
                 adaptive_sync=bool(nd.get("adaptive_sync", False)),
                 mempool=nd.get("mempool", "clist"),
                 db=nd.get("db", "sqlite"),
+                grpc=bool(nd.get("grpc", False)),
             )
             if nd.get("kill_at"):
                 spec.perturbations.append(

@@ -37,6 +37,7 @@ class RunnerNode:
     home: str
     p2p_port: int
     rpc_port: int
+    grpc_port: int = 0
     node_id: str = ""
     proc: Optional[subprocess.Popen] = None
     started: bool = False
@@ -55,9 +56,10 @@ class Runner:
         port = base_port
         for name, spec in manifest.nodes.items():
             self.nodes[name] = RunnerNode(
-                spec, os.path.join(base_dir, name), port, port + 1
+                spec, os.path.join(base_dir, name), port, port + 1,
+                grpc_port=port + 2,
             )
-            port += 2
+            port += 3
         self.failures: List[str] = []
 
     # --- provisioning -------------------------------------------------
@@ -91,6 +93,12 @@ class Runner:
             cfg.p2p.laddr = f"tcp://127.0.0.1:{rn.p2p_port}"
             cfg.rpc.laddr = f"tcp://127.0.0.1:{rn.rpc_port}"
             cfg.rpc.unsafe = True  # perturbations use the unsafe routes
+            if rn.spec.grpc:
+                cfg.rpc.grpc_laddr = f"tcp://127.0.0.1:{rn.grpc_port}"
+                # commit-await must survive a perturbed, contended net
+                # (kill/pause perturbations land around the same
+                # heights the check runs at)
+                cfg.rpc.timeout_broadcast_tx_commit_s = 30.0
             cfg.p2p.persistent_peers = ",".join(
                 p for p in peers.split(",")
                 if not p.startswith(rn.node_id)
@@ -253,6 +261,12 @@ class Runner:
                     self.failures.append(
                         f"nodes failed to converge: {hs}"
                     )
+            # drive the gRPC broadcast API AFTER convergence: every
+            # node (incl. late joiners) is started, perturbations are
+            # done (no kill racing the in-flight RPC), and the check
+            # cannot stall the monitor loop above
+            if not self.failures:
+                await self._check_grpc_broadcast()
         finally:
             if load_task:
                 load_task.cancel()
@@ -329,6 +343,45 @@ class Runner:
             except Exception:
                 pass
             await asyncio.sleep(interval)
+
+    async def _check_grpc_broadcast(self) -> None:
+        """Black-box drive of the legacy gRPC broadcast API on every
+        grpc-enabled node: Ping + one BroadcastTx with commit
+        semantics (reference test/e2e exercises live RPC the same
+        way). Runs post-convergence; a failure is a testnet
+        failure."""
+        targets = [
+            rn
+            for rn in self.nodes.values()
+            if rn.spec.grpc and rn.started
+        ]
+        if not targets:
+            return
+        from ..rpc.grpc_api import GRPCBroadcastClient
+
+        def drive(rn):
+            cli = GRPCBroadcastClient(f"127.0.0.1:{rn.grpc_port}")
+            try:
+                cli.ping()
+                res = cli.broadcast_tx(
+                    b"grpc-%s=1" % rn.spec.name.encode(), timeout=40.0
+                )
+                if res["check_tx"]["code"] != 0 or res["tx_result"][
+                    "code"
+                ] != 0:
+                    self.failures.append(
+                        f"{rn.spec.name}: gRPC broadcast rejected {res}"
+                    )
+            except Exception as e:
+                self.failures.append(
+                    f"{rn.spec.name}: gRPC broadcast failed: {e!r}"
+                )
+            finally:
+                cli.close()
+
+        await asyncio.gather(
+            *(asyncio.to_thread(drive, rn) for rn in targets)
+        )
 
     async def _perturb_routine(self, rn: RunnerNode) -> None:
         for pert in sorted(rn.spec.perturbations, key=lambda p: p.height):

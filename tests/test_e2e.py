@@ -15,7 +15,7 @@ MANIFEST = {
     "target_height": 12,
     "load_tx_rate": 4,
     "node": {
-        "val0": {"mode": "validator", "evidence_at": 4},
+        "val0": {"mode": "validator", "evidence_at": 4, "grpc": True},
         "val1": {"mode": "validator", "kill_at": 5},
         "val2": {"mode": "validator", "pause_at": 4, "pause_s": 2.0},
         "val3": {

@@ -59,7 +59,10 @@ def test_generated_manifests_valid():
 def test_generated_net_runs(tmp_path, seed):
     m = generate_one(seed)
     runner = Runner(
-        m, str(tmp_path / f"gen{seed}"), base_port=27600 + (seed % 50) * 12
+        # 30 ports/seed: up to 7 nodes x 3 ports each (p2p, rpc, grpc)
+        # with headroom — adjacent seeds must never overlap when run
+        # concurrently
+        m, str(tmp_path / f"gen{seed}"), base_port=27600 + (seed % 50) * 30
     )
     runner.setup()
     try:
