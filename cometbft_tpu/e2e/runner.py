@@ -261,11 +261,18 @@ class Runner:
                     self.failures.append(
                         f"nodes failed to converge: {hs}"
                     )
-            # drive the gRPC broadcast API AFTER convergence: every
-            # node (incl. late joiners) is started, perturbations are
-            # done (no kill racing the in-flight RPC), and the check
-            # cannot stall the monitor loop above
+            # drive the gRPC broadcast API AFTER convergence — and
+            # after QUIESCING the perturbation/load routines: a
+            # lagging perturbation poll could otherwise fire its kill
+            # mid-BroadcastTx and turn an intended perturbation into a
+            # spurious testnet failure
             if not self.failures:
+                for t in [load_task, *pert_tasks]:
+                    if t is not None:
+                        t.cancel()
+                await asyncio.gather(
+                    *(t for t in pert_tasks), return_exceptions=True
+                )
                 await self._check_grpc_broadcast()
         finally:
             if load_task:

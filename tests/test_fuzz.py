@@ -86,6 +86,131 @@ import struct  # noqa: E402
 struct_error = struct.error
 
 
+# --- merkle proof ops (peer-supplied light-client proofs) ---------------
+
+
+@settings(parent=FAST)
+@given(raw=st.binary(min_size=0, max_size=512))
+def test_fuzz_proof_ops_decode_and_verify(raw):
+    """Adversarial proof-op bytes reach the light proxy from the
+    primary: decode and both verify paths must raise ProofError /
+    ValueError, never crash with anything else."""
+    from cometbft_tpu.crypto import merkle
+
+    rt = merkle.ProofRuntime()
+    try:
+        ops = merkle.decode_proof_ops(raw)
+    except (ValueError, KeyError, UnicodeDecodeError):
+        return
+    for fn in (
+        lambda: rt.verify_value(ops, b"\x00" * 32, b"key", b"val"),
+        lambda: rt.verify_absence(ops, b"\x00" * 32, b"key"),
+    ):
+        try:
+            fn()
+        except (merkle.ProofError, ValueError, OverflowError):
+            pass
+
+
+@settings(parent=FAST)
+@given(raw=st.binary(min_size=0, max_size=512))
+def test_fuzz_native_commit_decode_agrees_with_python(raw):
+    """The native decoder and the pure-Python reader must agree on
+    every input: same decoded values or both error (the wrapper's
+    ValueError fallback makes native-only strictness invisible)."""
+    from cometbft_tpu.utils import codec, wirecodec
+
+    if wirecodec.module() is None:
+        return
+    saved = wirecodec._mod
+    try:
+        got = err = None
+        try:
+            got = codec.decode_commit(raw)  # native-first path
+        except (ValueError, OverflowError, struct_error) as e:
+            err = type(e)
+        wirecodec._mod = None
+        try:
+            want = codec.decode_commit(raw)  # pure python
+        except (ValueError, OverflowError, struct_error) as e:
+            assert err is not None, (raw, e)
+            return
+        assert err is None, raw
+        assert got.height == want.height and got.round == want.round
+        assert got.block_id == want.block_id
+        assert got.signatures == want.signatures
+    finally:
+        wirecodec._mod = saved
+
+
+@settings(parent=FAST)
+@given(
+    n_sigs=st.integers(0, 8),
+    flips=st.lists(
+        st.tuples(st.integers(0, 4095), st.integers(0, 255)),
+        max_size=3,
+    ),
+    seed=st.integers(0, 2**32 - 1),
+)
+def test_fuzz_mutated_commit_native_python_agree(n_sigs, flips, seed):
+    """Near-valid inputs (a real commit encoding with a few byte
+    flips) probe the decoders' agreement far deeper than raw noise."""
+    import random as _random
+
+    from cometbft_tpu import types as T
+    from cometbft_tpu.utils import codec, wirecodec
+
+    if wirecodec.module() is None:
+        return
+    rng = _random.Random(seed)
+    sigs = [
+        T.CommitSig(
+            block_id_flag=rng.choice([1, 2, 3]),
+            validator_address=bytes(rng.randbytes(20)),
+            timestamp_ns=rng.randrange(0, 2**62),
+            signature=bytes(rng.randbytes(64)),
+        )
+        for _ in range(n_sigs)
+    ]
+    c = T.Commit(
+        height=rng.randrange(1, 2**40),
+        round=rng.randrange(0, 4),
+        block_id=T.BlockID(
+            bytes(rng.randbytes(32)),
+            T.PartSetHeader(1, bytes(rng.randbytes(32))),
+        ),
+        signatures=sigs,
+    )
+    raw = bytearray(codec.encode_commit(c))
+    for pos, val in flips:
+        if raw:
+            raw[pos % len(raw)] ^= val
+    raw = bytes(raw)
+
+    saved = wirecodec._mod
+    try:
+        got = err = None
+        try:
+            got = codec.decode_commit(raw)
+        except (ValueError, OverflowError, struct_error) as e:
+            err = type(e)
+        wirecodec._mod = None
+        try:
+            want = codec.decode_commit(raw)
+        except (ValueError, OverflowError, struct_error):
+            assert err is not None
+            return
+        assert err is None
+        assert (got.height, got.round, got.block_id, got.signatures) == (
+            want.height,
+            want.round,
+            want.block_id,
+            want.signatures,
+        )
+    finally:
+        wirecodec._mod = saved
+
+
 # --- SecretConnection vs garbage frames ---------------------------------
 
 
