@@ -456,6 +456,45 @@ def cmd_light(args) -> int:
         primary=primary,
         witnesses=witnesses,
     )
+    if args.laddr:
+        # proxy mode (the reference command's primary role): serve
+        # light-verified RPC — including proof-checked abci_query/tx —
+        # while tracking the head in the background
+        import asyncio
+
+        from ..light.proxy import LightProxy
+
+        async def serve():
+            proxy = LightProxy(cli, args.primary)
+            addr = args.laddr
+            for pfx in ("tcp://", "http://"):
+                if addr.startswith(pfx):
+                    addr = addr[len(pfx):]
+            await proxy.start(addr)
+            print(
+                f"light proxy for {args.chain_id} on "
+                f"{proxy.listen_addr} (primary {args.primary})"
+            )
+            try:
+                while True:
+                    try:
+                        await asyncio.to_thread(cli.update)
+                    except Exception as e:
+                        # a transient primary hiccup must not tear the
+                        # proxy daemon down; log and keep polling
+                        print(f"light update failed (retrying): {e!r}")
+                    await asyncio.sleep(args.interval_s)
+            except (KeyboardInterrupt, asyncio.CancelledError):
+                pass
+            finally:
+                await proxy.stop()
+            return 0
+
+        try:
+            return asyncio.run(serve()) or 0
+        except KeyboardInterrupt:
+            return 0
+
     import time as _t
 
     print(f"light client tracking {args.chain_id} via {args.primary}")
@@ -771,7 +810,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("abci_args", nargs="*")
     p.set_defaults(fn=cmd_abci_cli)
 
-    p = sub.add_parser("light", help="light client daemon")
+    p = sub.add_parser("light", help="light client daemon / proxy")
     p.add_argument("chain_id")
     p.add_argument("-p", "--primary", required=True)
     p.add_argument("-w", "--witnesses", default="")
@@ -779,6 +818,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trust-hash", required=True)
     p.add_argument("--trust-period-h", type=float, default=168.0)
     p.add_argument("--interval-s", type=float, default=1.0)
+    p.add_argument(
+        "--laddr",
+        default="",
+        help="serve the light-verified RPC proxy on this address "
+        "(headers/commits/validators/blocks verified; abci_query and "
+        "tx responses proof-checked against the verified AppHash — "
+        "reference `cometbft light` serves :8888)",
+    )
     p.set_defaults(fn=cmd_light)
 
     return ap

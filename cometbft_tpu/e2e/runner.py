@@ -267,12 +267,10 @@ class Runner:
             # mid-BroadcastTx and turn an intended perturbation into a
             # spurious testnet failure
             if not self.failures:
-                for t in [load_task, *pert_tasks]:
-                    if t is not None:
-                        t.cancel()
-                await asyncio.gather(
-                    *(t for t in pert_tasks), return_exceptions=True
-                )
+                quiesce = [t for t in [load_task, *pert_tasks] if t]
+                for t in quiesce:
+                    t.cancel()
+                await asyncio.gather(*quiesce, return_exceptions=True)
                 await self._check_grpc_broadcast()
         finally:
             if load_task:

@@ -64,6 +64,13 @@ class Client:
         self.drift = max_clock_drift_ns
         self.cache = signature_cache or T.SignatureCache()
         self.hops = 0  # bisection hop counter (observability)
+        # serializes the verify/update entry points: the light proxy
+        # runs them from multiple worker threads (background head
+        # tracking + concurrent request handlers) against the one
+        # unlocked LightStore
+        import threading
+
+        self._lock = threading.RLock()
         self._init_trust()
 
     def _init_trust(self) -> None:
@@ -95,20 +102,22 @@ class Client:
     def verify_light_block_at_height(
         self, height: int, now_ns: Optional[int] = None
     ) -> LightBlock:
-        now_ns = now_ns or time.time_ns()
-        got = self.store.get(height)
-        if got is not None:
-            return got
-        target = self.primary.light_block(height)
-        return self.verify_header(target, now_ns)
+        with self._lock:
+            now_ns = now_ns or time.time_ns()
+            got = self.store.get(height)
+            if got is not None:
+                return got
+            target = self.primary.light_block(height)
+            return self.verify_header(target, now_ns)
 
     def update(self, now_ns: Optional[int] = None) -> Optional[LightBlock]:
         """Verify the primary's latest header (reference Client.Update)."""
-        latest = self.primary.light_block(0)
-        trusted = self.store.latest()
-        if trusted is not None and latest.height <= trusted.height:
-            return trusted
-        return self.verify_header(latest, now_ns or time.time_ns())
+        with self._lock:
+            latest = self.primary.light_block(0)
+            trusted = self.store.latest()
+            if trusted is not None and latest.height <= trusted.height:
+                return trusted
+            return self.verify_header(latest, now_ns or time.time_ns())
 
     def verify_header(self, target: LightBlock, now_ns: int) -> LightBlock:
         existing = self.store.get(target.height)

@@ -99,6 +99,87 @@ def test_tampered_proofs_rejected(proved_app):
 # --- e2e: proxy over a live net ----------------------------------------
 
 
+def test_light_cli_proxy_mode():
+    """`cometbft-tpu light --laddr ...` serves the verified proxy (the
+    reference command's primary role): drive the CLI as a subprocess
+    against a live net and query a proof-verified key through it."""
+    import socket
+    import subprocess
+    import sys
+
+    gen, pvs = make_genesis(2, chain_id="cli-proxy")
+
+    async def main():
+        n0 = Node(
+            make_test_cfg("."), gen, privval=pvs[0],
+            app=KVStoreApplication(prove=True),
+        )
+        n1 = Node(
+            make_test_cfg("."), gen, privval=pvs[1],
+            app=KVStoreApplication(prove=True),
+        )
+        await n0.start()
+        await n1.start()
+        await n0.dial(n1.listen_addr)
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://{n0.rpc_server.listen_addr}"
+                "/broadcast_tx_commit?tx=0x" + (b"cli=proxy").hex()
+            ) as resp:
+                assert (await resp.json())["result"]
+        while n0.height < 4:
+            await asyncio.sleep(0.05)
+
+        trust = n0.parts.block_store.load_block(1)
+        with socket.socket() as sock:
+            sock.bind(("127.0.0.1", 0))
+            port = sock.getsockname()[1]
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "cometbft_tpu", "light",
+                "cli-proxy",
+                "-p", n0.rpc_server.listen_addr,
+                "--trust-height", "1",
+                "--trust-hash", trust.hash().hex(),
+                "--laddr", f"tcp://127.0.0.1:{port}",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        try:
+            deadline = asyncio.get_running_loop().time() + 30
+            body = None
+            async with aiohttp.ClientSession() as s:
+                while asyncio.get_running_loop().time() < deadline:
+                    try:
+                        async with s.get(
+                            f"http://127.0.0.1:{port}/abci_query?"
+                            'path="/store"&data=0x' + b"cli".hex()
+                        ) as resp:
+                            body = await resp.json()
+                        if body.get("result"):
+                            break
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.3)
+            assert body and body.get("result"), body
+            assert body["result"]["verified"] is True
+            import base64
+
+            assert (
+                base64.b64decode(body["result"]["response"]["value"])
+                == b"proxy"
+            )
+        finally:
+            proc.terminate()
+            proc.wait(10)
+        await n0.stop()
+        await n1.stop()
+
+    run(main())
+
+
 class _TamperingPrimary:
     """Wraps the proxy's HTTPClient; corrupts selected responses the
     way a byzantine full node would."""
