@@ -41,7 +41,7 @@ def test_e2e_smoke(tmp_path):
     heights = {}
     try:
         ok = asyncio.run(
-            asyncio.wait_for(runner.run(timeout_s=240.0), 280)
+            asyncio.wait_for(runner.run(timeout_s=240.0), 240 + 120 + 60)
         )
         heights = {
             name: runner._height(rn)

@@ -67,7 +67,7 @@ def test_generated_net_runs(tmp_path, seed):
     runner.setup()
     try:
         ok = asyncio.run(
-            asyncio.wait_for(runner.run(timeout_s=240.0), 280)
+            asyncio.wait_for(runner.run(timeout_s=240.0), 240 + 120 + 60)
         )
     finally:
         runner.stop()

@@ -20,17 +20,39 @@ def test_statesync_bootstrap_then_follow():
     gen, pvs = make_genesis(N_VALS, chain_id="ss-chain")
 
     async def main():
+        from cometbft_tpu.models.kvstore import KVStoreApplication
+
         vals = []
         for i, pv in enumerate(pvs):
             cfg = make_test_cfg(".")
             cfg.base.moniker = f"val{i}"
             cfg.blocksync.enable = False
-            vals.append(Node(cfg, gen, privval=pv))
+            vals.append(
+                Node(
+                    cfg, gen, privval=pv,
+                    app=KVStoreApplication(prove=True),
+                )
+            )
         for n in vals:
             await n.start()
         for i, a in enumerate(vals):
             for b in vals[i + 1:]:
                 await a.dial(b.listen_addr)
+        # land a tx BEFORE the first snapshot so the restored state
+        # carries a provable key
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://{vals[0].rpc_server.listen_addr}"
+                "/broadcast_tx_commit?tx=0x" + (b"ss=snap").hex()
+            ) as resp:
+                r = (await resp.json()).get("result") or {}
+        assert r.get("check_tx", {}).get("code", 1) == 0, r
+        # the key must land BEFORE the height-10 snapshot, or the
+        # restored-state proof below would silently test ordinary
+        # blocksync replay instead
+        assert int(r["height"]) < 10, r
         # kvstore snapshots every 10 heights; wait for one + margin
         while vals[0].height < 13:
             await asyncio.sleep(0.05)
@@ -47,7 +69,9 @@ def test_statesync_bootstrap_then_follow():
         cfg.statesync.trust_hash = bytes(trust.hash()).hex()
         cfg.statesync.discovery_time_s = 10.0
         cfg.blocksync.enable = True
-        fresh = Node(cfg, gen, privval=None)
+        fresh = Node(
+            cfg, gen, privval=None, app=KVStoreApplication(prove=True)
+        )
         await fresh.start()
         for v in vals:
             await fresh.dial(v.listen_addr)
@@ -66,10 +90,31 @@ def test_statesync_bootstrap_then_follow():
         assert bytes(
             fresh.parts.block_store.load_block(h).hash()
         ) == bytes(vals[0].parts.block_store.load_block(h).hash())
+        # the snapshot-RESTORED app still serves verifiable proofs:
+        # the pre-snapshot key proves against the consensus-certified
+        # AppHash of query_height+1 (the exact light-proxy check)
+        from cometbft_tpu.abci import types as abci_t
+        from cometbft_tpu.crypto import merkle
+
+        res = fresh.parts.app.query(
+            abci_t.RequestQuery(data=b"ss", path="/store", prove=True)
+        )
+        assert res.code == 0 and res.value == b"snap"
+        while fresh.parts.block_store.height() < res.height + 1:
+            await asyncio.sleep(0.05)
+        want_hash = fresh.parts.block_store.load_block(
+            res.height + 1
+        ).header.app_hash
+        merkle.ProofRuntime().verify_value(
+            merkle.decode_proof_ops(res.proof_ops),
+            want_hash,
+            b"ss",
+            b"snap",
+        )
         for n in vals + [fresh]:
             await n.stop()
 
-    run(main())
+    run(main(), timeout=240)
 
 
 def test_statesync_adaptive_handoff():
