@@ -29,6 +29,11 @@ from ..types.genesis import GenesisDoc
 from .manifest import Manifest, NodeSpec
 
 BASE_PORT = 27000
+# run()'s phase budgets beyond timeout_s: all-node convergence, then
+# quiesce + the gRPC broadcast check. Tests derive their OUTER guard
+# from these so the guard can never truncate a healthy run mid-phase.
+CONVERGENCE_BUDGET_S = 120.0
+POST_BUDGET_S = 60.0
 
 
 @dataclass
@@ -237,7 +242,7 @@ class Runner:
             # wait for EVERY node (incl. late joiners) to converge —
             # pointless if the net never reached the target at all
             if not self.failures:
-                conv_deadline = time.monotonic() + 120.0
+                conv_deadline = time.monotonic() + CONVERGENCE_BUDGET_S
                 hs = {}
                 while time.monotonic() < conv_deadline:
                     started = [

@@ -8,6 +8,7 @@ import os
 import pytest
 
 from cometbft_tpu.e2e.manifest import Manifest
+from cometbft_tpu.e2e import runner as runner_mod
 from cometbft_tpu.e2e.runner import Runner
 
 MANIFEST = {
@@ -41,7 +42,12 @@ def test_e2e_smoke(tmp_path):
     heights = {}
     try:
         ok = asyncio.run(
-            asyncio.wait_for(runner.run(timeout_s=240.0), 240 + 120 + 60)
+            asyncio.wait_for(
+                runner.run(timeout_s=240.0),
+                240
+                + runner_mod.CONVERGENCE_BUDGET_S
+                + runner_mod.POST_BUDGET_S,
+            )
         )
         heights = {
             name: runner._height(rn)

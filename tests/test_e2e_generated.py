@@ -12,6 +12,7 @@ import os
 import pytest
 
 from cometbft_tpu.e2e.generator import generate_one
+from cometbft_tpu.e2e import runner as runner_mod
 from cometbft_tpu.e2e.runner import Runner
 
 _SEEDS = [
@@ -67,7 +68,12 @@ def test_generated_net_runs(tmp_path, seed):
     runner.setup()
     try:
         ok = asyncio.run(
-            asyncio.wait_for(runner.run(timeout_s=240.0), 240 + 120 + 60)
+            asyncio.wait_for(
+                runner.run(timeout_s=240.0),
+                240
+                + runner_mod.CONVERGENCE_BUDGET_S
+                + runner_mod.POST_BUDGET_S,
+            )
         )
     finally:
         runner.stop()

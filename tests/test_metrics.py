@@ -40,6 +40,7 @@ def test_prometheus_metrics_endpoint():
         assert "cometbft_mempool_size" in text
         assert "cometbft_p2p_peers" in text
         assert "cometbft_consensus_total_txs" in text
+        assert "cometbft_blocksync_pipeline_reused_total" in text
         await node.stop()
 
     run(main())

@@ -27,6 +27,10 @@ def test_statesync_bootstrap_then_follow():
             cfg = make_test_cfg(".")
             cfg.base.moniker = f"val{i}"
             cfg.blocksync.enable = False
+            # first-block commit on a freshly-dialed contended net can
+            # exceed the 10s default; a timeout here must not
+            # masquerade as a CheckTx rejection below
+            cfg.rpc.timeout_broadcast_tx_commit_s = 30.0
             vals.append(
                 Node(
                     cfg, gen, privval=pv,
@@ -47,7 +51,9 @@ def test_statesync_bootstrap_then_follow():
                 f"http://{vals[0].rpc_server.listen_addr}"
                 "/broadcast_tx_commit?tx=0x" + (b"ss=snap").hex()
             ) as resp:
-                r = (await resp.json()).get("result") or {}
+                body = await resp.json()
+        assert "error" not in body or not body["error"], body
+        r = body.get("result") or {}
         assert r.get("check_tx", {}).get("code", 1) == 0, r
         # the key must land BEFORE the height-10 snapshot, or the
         # restored-state proof below would silently test ordinary
