@@ -836,6 +836,24 @@ def main(argv=None) -> int:
     if not getattr(args, "fn", None):
         build_parser().print_help()
         return 1
+    # Honor JAX_PLATFORMS over ambient site hooks: a sitecustomize may
+    # force-register a hardware plugin via jax.config at interpreter
+    # start, which BEATS the env var — an operator (or the e2e runner)
+    # pinning JAX_PLATFORMS=cpu would still get the plugin backend,
+    # and on a wedged accelerator the first big verify batch then
+    # hangs the node forever (observed: e2e late joiners stuck in
+    # jax.devices() against a dead tunnel). Re-pin the config itself
+    # before any compute path initializes a backend (after arg
+    # parsing: --help and non-compute subcommands shouldn't pay the
+    # jax import).
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
     try:
         return args.fn(args)
     except KeyboardInterrupt:

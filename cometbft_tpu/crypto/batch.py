@@ -123,6 +123,25 @@ class _Calibration:
 
 calibration = _Calibration()
 
+_BACKEND_IS_CPU = None
+
+
+def _jax_backend_is_cpu() -> bool:
+    """True when the process's jax backend is the CPU platform: the
+    unforced device route is then pointless (it would XLA-compile the
+    kernel for the host, which OpenSSL beats) and is skipped. Forced
+    routing (set_min_tpu_batch(1) — the dryrun/tests) is unaffected:
+    the virtual-mesh validation deliberately runs the kernel on CPU."""
+    global _BACKEND_IS_CPU
+    if _BACKEND_IS_CPU is None:
+        try:
+            import jax
+
+            _BACKEND_IS_CPU = jax.default_backend() == "cpu"
+        except Exception:  # pragma: no cover - uninitializable backend
+            _BACKEND_IS_CPU = True
+    return _BACKEND_IS_CPU
+
 # Last routing decision (observability: bench configs + tests report
 # which path the calibrated dispatch actually chose).
 LAST_ROUTE = {"path": None, "n": 0, "crossover": None}
@@ -203,10 +222,18 @@ class TpuBatchVerifier(BatchVerifier):
                 other_idx.append(i)
         n_ed = len(ed_items)
         forced = _MIN_TPU_BATCH <= 1
+        # calibration first: the backend probe imports jax and
+        # initializes the platform, so it must only run when the
+        # device route is otherwise about to be taken
         use_device = n_ed >= _MIN_TPU_BATCH and (
             forced
-            or calibration.device_wins(n_ed)
-            or calibration.should_explore()
+            or (
+                (
+                    calibration.device_wins(n_ed)
+                    or calibration.should_explore()
+                )
+                and not _jax_backend_is_cpu()
+            )
         )
         if use_device and not forced:
             calibration.note_device_used()

@@ -30,10 +30,13 @@ from .manifest import Manifest, NodeSpec
 
 BASE_PORT = 27000
 # run()'s phase budgets beyond timeout_s: all-node convergence, then
-# quiesce + the gRPC broadcast check. Tests derive their OUTER guard
-# from these so the guard can never truncate a healthy run mid-phase.
+# the post phase — perturbation-finish wait (<=30s), the gRPC
+# broadcast check (<=40s client deadline), and the bulk block-interval
+# benchmark (a handful of 5s-bounded RPCs). Tests derive their OUTER
+# guard from these so the guard can never truncate a healthy run
+# mid-phase.
 CONVERGENCE_BUDGET_S = 120.0
-POST_BUDGET_S = 60.0
+POST_BUDGET_S = 120.0
 
 
 @dataclass
@@ -272,11 +275,19 @@ class Runner:
             # mid-BroadcastTx and turn an intended perturbation into a
             # spurious testnet failure
             if not self.failures:
+                # let lagging perturbation routines FINISH (their height
+                # polls can trail the chain by seconds; cancelling a
+                # not-yet-fired evidence injection would fail the
+                # evidence assertion), then quiesce everything before
+                # the gRPC check so no kill can race the in-flight RPC
+                if pert_tasks:
+                    await asyncio.wait(pert_tasks, timeout=30.0)
                 quiesce = [t for t in [load_task, *pert_tasks] if t]
                 for t in quiesce:
                     t.cancel()
                 await asyncio.gather(*quiesce, return_exceptions=True)
                 await self._check_grpc_broadcast()
+                await asyncio.to_thread(self._benchmark_intervals)
         finally:
             if load_task:
                 load_task.cancel()
@@ -353,6 +364,78 @@ class Runner:
             except Exception:
                 pass
             await asyncio.sleep(interval)
+
+    def _benchmark_intervals(self) -> None:
+        """Block-interval statistics over the run (reference
+        test/e2e/runner/benchmark.go:15-50: mean/stddev/min/max of the
+        header-time deltas), recorded on ``self.benchmark``. Headers
+        come from the bulk ``blockchain`` endpoint (20 metas per call)
+        of a GENESIS node — a statesync joiner lacks pre-snapshot
+        blocks. Non-monotonic header-time pairs (possible under BFT
+        time with clock skew) are counted and reported, not silently
+        dropped."""
+        import statistics
+
+        rn = next(
+            (
+                r
+                for r in self.nodes.values()
+                if r.started and r.spec.start_at == 0
+            ),
+            None,
+        )
+        if rn is None:
+            return
+        times = {}
+        lo, hi = 2, self.m.target_height
+        h = hi
+        while h >= lo:
+            for attempt in (1, 2, 3):
+                try:
+                    res = self._rpc(
+                        rn,
+                        f"blockchain?minHeight={lo}&maxHeight={h}",
+                        timeout=5.0,
+                    )
+                    break
+                except Exception as e:
+                    if attempt == 3:
+                        # post-convergence RPC should answer; a
+                        # silent skip would make the smoke test fail
+                        # with an inexplicable missing benchmark
+                        self.failures.append(
+                            f"benchmark: blockchain RPC failed: {e!r}"
+                        )
+                        return
+                    time.sleep(0.2)
+            metas = res.get("block_metas") or []
+            if not metas:
+                self.failures.append(
+                    f"benchmark: no block metas <= {h}"
+                )
+                return
+            for meta in metas:
+                times[int(meta["header"]["height"])] = int(
+                    meta["header"]["time_ns"]
+                )
+            nxt = min(times) - 1
+            if nxt >= h:  # floor not advancing (pruned store): stop
+                break
+            h = nxt
+        seq = [times[k] for k in sorted(times)]
+        deltas = [(b - a) / 1e9 for a, b in zip(seq, seq[1:])]
+        mono = [d for d in deltas if d > 0]
+        if len(mono) < 2:
+            return
+        self.benchmark = {
+            "blocks": len(seq),
+            "non_monotonic_intervals": len(deltas) - len(mono),
+            "interval_mean_s": round(statistics.mean(mono), 3),
+            "interval_stddev_s": round(statistics.pstdev(mono), 3),
+            "interval_min_s": round(min(mono), 3),
+            "interval_max_s": round(max(mono), 3),
+        }
+        print(f"block-interval benchmark: {self.benchmark}")
 
     async def _check_grpc_broadcast(self) -> None:
         """Black-box drive of the legacy gRPC broadcast API on every

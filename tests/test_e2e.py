@@ -56,6 +56,9 @@ def test_e2e_smoke(tmp_path):
     finally:
         runner.stop()
     assert ok, runner.failures
+    # block-interval stats recorded (reference runner/benchmark.go)
+    bench = getattr(runner, "benchmark", None)
+    assert bench and bench["interval_mean_s"] > 0, bench
     # the killed validator recovered; the late full node blocksynced
     assert heights["val1"] >= m.target_height, heights
     assert heights["full0"] >= m.target_height, heights
