@@ -831,21 +831,29 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
-def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
-    if not getattr(args, "fn", None):
-        build_parser().print_help()
-        return 1
-    # Honor JAX_PLATFORMS over ambient site hooks: a sitecustomize may
-    # force-register a hardware plugin via jax.config at interpreter
-    # start, which BEATS the env var — an operator (or the e2e runner)
-    # pinning JAX_PLATFORMS=cpu would still get the plugin backend,
-    # and on a wedged accelerator the first big verify batch then
-    # hangs the node forever (observed: e2e late joiners stuck in
-    # jax.devices() against a dead tunnel). Re-pin the config itself
-    # before any compute path initializes a backend (after arg
-    # parsing: --help and non-compute subcommands shouldn't pay the
-    # jax import).
+# subcommands whose execution can reach a jax compute path (signature
+# batches / kernels); the others never pay the jax import
+_COMPUTE_CMDS = frozenset(
+    (
+        "cmd_start",
+        "cmd_replay",
+        "cmd_light",
+        "cmd_load",
+        "cmd_bootstrap_state",
+        "cmd_testnet",
+    )
+)
+
+
+def _pin_jax_platform() -> None:
+    """Honor JAX_PLATFORMS over ambient site hooks: a sitecustomize
+    may force-register a hardware plugin via jax.config at interpreter
+    start, which BEATS the env var — an operator (or the e2e runner)
+    pinning JAX_PLATFORMS=cpu would still get the plugin backend, and
+    on a wedged accelerator the first big verify batch then hangs the
+    node forever (observed: e2e late joiners stuck in jax.devices()
+    against a dead tunnel). Re-pin the config itself before any
+    compute path initializes a backend."""
     plat = os.environ.get("JAX_PLATFORMS")
     if plat:
         try:
@@ -854,6 +862,15 @@ def main(argv=None) -> int:
             jax.config.update("jax_platforms", plat)
         except Exception:
             pass
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if not getattr(args, "fn", None):
+        build_parser().print_help()
+        return 1
+    if getattr(args.fn, "__name__", "") in _COMPUTE_CMDS:
+        _pin_jax_platform()
     try:
         return args.fn(args)
     except KeyboardInterrupt:

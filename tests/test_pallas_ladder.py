@@ -22,8 +22,29 @@ from cometbft_tpu.ops.pallas_ladder import straus_pallas
 pytestmark = pytest.mark.tpu
 
 
+def test_pallas_block_divisor_fallback(monkeypatch):
+    """A configured block height that does not divide the sublane-row
+    count must fall back to the largest divisor — NOT silently drop
+    remainder rows (code-review r4 finding). N=384 (3 rows) with
+    blocks of 2 forces the fallback to 1-row blocks; verdict lanes
+    must still be bit-identical across the whole width."""
+    import jax
+
+    from cometbft_tpu.ops import pallas_ladder
+
+    monkeypatch.setattr(pallas_ladder, "BLOCK_SUBLANES", 2)
+    # BLOCK_SUBLANES is read at TRACE time: a warm jit cache for this
+    # shape would silently reuse the default-block compilation and
+    # neutralize the regression coverage
+    jax.clear_caches()
+    _ladder_equivalence(384)
+
+
 def test_pallas_ladder_matches_xla_ladder():
-    N = 128
+    _ladder_equivalence(128)
+
+
+def _ladder_equivalence(N):
     rng = np.random.default_rng(17)
     sk = rng.bytes(32)
     pk = ref.public_from_seed(sk)
