@@ -24,6 +24,15 @@ import sys
 VERSION = "0.1.0"
 
 
+def _compute_cmd(fn):
+    """Marks a subcommand whose execution can reach a jax compute path
+    (signature batches / kernels): main() pins the jax platform for
+    these; the others never pay the jax import. Tagging at the
+    definition site survives renames (vs a name list)."""
+    fn._reaches_jax = True
+    return fn
+
+
 def _home(args) -> str:
     return os.path.expanduser(args.home)
 
@@ -90,6 +99,7 @@ def cmd_init(args) -> int:
 # --- start ---------------------------------------------------------------
 
 
+@_compute_cmd
 def cmd_start(args) -> int:
     from ..node.node import Node
     from ..p2p.key import NodeKey
@@ -219,6 +229,7 @@ def cmd_show_validator(args) -> int:
 # --- testnet -------------------------------------------------------------
 
 
+@_compute_cmd
 def cmd_testnet(args) -> int:
     """Generate a multi-node testnet directory tree (reference
     commands/testnet.go)."""
@@ -368,6 +379,7 @@ def cmd_reindex_event(args) -> int:
     return 0
 
 
+@_compute_cmd
 def cmd_replay(args) -> int:
     """Re-execute stored blocks against a fresh app instance via the
     handshake replay path (reference commands/replay.go)."""
@@ -434,6 +446,7 @@ def cmd_inspect(args) -> int:
     return 0
 
 
+@_compute_cmd
 def cmd_light(args) -> int:
     """Light client daemon: bisection-verify new headers from a
     primary against witnesses (reference cmd light + light/proxy)."""
@@ -597,6 +610,7 @@ def cmd_abci_cli(args) -> int:
     return run_abci_cli(args.address, args.abci_cmd, args.abci_args)
 
 
+@_compute_cmd
 def cmd_bootstrap_state(args) -> int:
     """Offline statesync: light-verify state at a height and seed the
     stores so `start` goes straight to blocksync (reference
@@ -639,6 +653,7 @@ def cmd_debug(args) -> int:
     return 0
 
 
+@_compute_cmd
 def cmd_load(args) -> int:
     """Timestamped tx load + commit-latency report (reference
     test/loadtime)."""
@@ -831,20 +846,6 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
-# subcommands whose execution can reach a jax compute path (signature
-# batches / kernels); the others never pay the jax import
-_COMPUTE_CMDS = frozenset(
-    (
-        "cmd_start",
-        "cmd_replay",
-        "cmd_light",
-        "cmd_load",
-        "cmd_bootstrap_state",
-        "cmd_testnet",
-    )
-)
-
-
 def _pin_jax_platform() -> None:
     """Honor JAX_PLATFORMS over ambient site hooks: a sitecustomize
     may force-register a hardware plugin via jax.config at interpreter
@@ -869,7 +870,7 @@ def main(argv=None) -> int:
     if not getattr(args, "fn", None):
         build_parser().print_help()
         return 1
-    if getattr(args.fn, "__name__", "") in _COMPUTE_CMDS:
+    if getattr(args.fn, "_reaches_jax", False):
         _pin_jax_platform()
     try:
         return args.fn(args)

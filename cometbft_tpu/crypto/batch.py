@@ -157,6 +157,26 @@ class ResolvedVerdicts:
         return self._res
 
 
+class _PendingVerdicts:
+    """In-flight device dispatch: host lanes already resolved in
+    ``oks``; ``result()`` fills the ed25519 lanes from the device
+    handle. Plain fields (not a closure) so the handle object holds
+    exactly what it needs."""
+
+    __slots__ = ("_handle", "_ed_idx", "_oks")
+
+    def __init__(self, handle, ed_idx, oks) -> None:
+        self._handle = handle
+        self._ed_idx = ed_idx
+        self._oks = oks
+
+    def result(self) -> Tuple[bool, List[bool]]:
+        oks = self._oks
+        for i, v in zip(self._ed_idx, self._handle.result()):
+            oks[i] = bool(v)
+        return all(oks) and bool(oks), oks
+
+
 class BatchVerifier:
     """Accumulate signatures, verify all at once.
 
@@ -289,14 +309,7 @@ class TpuBatchVerifier(BatchVerifier):
 
         handle = _ed.verify_batch_async(ed_items)
         self._host_lanes(oks, ed_idx, other_idx, False)
-
-        class _Pending:
-            def result(_self) -> Tuple[bool, List[bool]]:
-                for i, v in zip(ed_idx, handle.result()):
-                    oks[i] = bool(v)
-                return all(oks) and bool(oks), oks
-
-        return _Pending()
+        return _PendingVerdicts(handle, ed_idx, oks)
 
 
 _default_backend = "tpu"

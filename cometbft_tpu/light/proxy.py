@@ -13,14 +13,17 @@ against the verified header's data hash, :473). Unverifiable routes
 from __future__ import annotations
 
 import asyncio
+import base64
 import threading
 import time
 from typing import Any, Dict, Optional
 
 from aiohttp import web
 
+from ..crypto import merkle
 from ..rpc import encoding as enc
 from ..rpc.client import HTTPClient
+from ..rpc.core import _bytes_param
 from ..utils import codec
 from .client import Client
 
@@ -99,8 +102,6 @@ class LightProxy:
             # fetch the full block from the primary, verify its hash
             # against the light-verified header
             res = await self.primary.block(lb.height)
-            import base64
-
             blk = codec.decode_block(base64.b64decode(res["block_b64"]))
             if bytes(blk.hash()) != bytes(lb.header.hash()):
                 raise RuntimeError(
@@ -134,10 +135,6 @@ class LightProxy:
         that commits the post-height state), and BOTH value and
         absence responses are proven — a primary that tampers with
         either gets rejected, not relayed."""
-        import base64
-
-        from ..crypto import merkle
-
         params = dict(params)
         params["prove"] = True
         res = await self.primary.call("abci_query", **params)
@@ -148,8 +145,6 @@ class LightProxy:
         # the proof must be for the key the CALLER asked about — a
         # primary substituting another committed key's (genuinely
         # provable) value or absence must be rejected, not relayed
-        from ..rpc.core import _bytes_param
-
         requested = _bytes_param(params.get("data"))
         if key != requested:
             raise RuntimeError(
@@ -195,9 +190,6 @@ class LightProxy:
         """Tx lookup with inclusion-proof verification against the
         light-verified header's data hash (reference
         light/rpc/client.go:473)."""
-        import base64
-
-        from ..crypto import merkle
         from ..types.block import tx_hash
 
         params = dict(params)
@@ -211,8 +203,6 @@ class LightProxy:
         # the returned tx must BE the one the caller asked about — an
         # inclusion proof for a different (genuinely committed) tx
         # would otherwise verify
-        from ..rpc.core import _bytes_param
-
         requested = _bytes_param(params.get("hash"))
         if requested and tx_hash(tx_bytes) != requested:
             raise RuntimeError(

@@ -108,20 +108,32 @@ def _run_batch_async(items, cache: Optional[SignatureCache]):
             verifier.add(pk, sb, sig)
             to_verify.append(i)
     pending = verifier.verify_async() if len(verifier) else None
+    return _BatchHandle(items, to_verify, pending, cache)
 
-    class _Handle:
-        def result(self):
-            oks = [True] * len(items)
-            if pending is not None:
-                _, verdicts = pending.result()
-                for i, ok in zip(to_verify, verdicts):
-                    oks[i] = ok
-                    if ok and cache is not None:
-                        pk, sb, sig = items[i]
-                        cache.add(sb, sig, pk.key_bytes)
-            return oks
 
-    return _Handle()
+class _BatchHandle:
+    """Cache-aware batch handle: ``result()`` resolves the pending
+    dispatch, fills verdicts over the cache-skipped lanes, and feeds
+    verified signatures back into the cache."""
+
+    __slots__ = ("_items", "_to_verify", "_pending", "_cache")
+
+    def __init__(self, items, to_verify, pending, cache) -> None:
+        self._items = items
+        self._to_verify = to_verify
+        self._pending = pending
+        self._cache = cache
+
+    def result(self):
+        oks = [True] * len(self._items)
+        if self._pending is not None:
+            _, verdicts = self._pending.result()
+            for i, ok in zip(self._to_verify, verdicts):
+                oks[i] = ok
+                if ok and self._cache is not None:
+                    pk, sb, sig = self._items[i]
+                    self._cache.add(sb, sig, pk.key_bytes)
+        return oks
 
 
 def _run_batch(items, cache: Optional[SignatureCache]):
@@ -261,33 +273,47 @@ def verify_commits_coalesced_async(
         job_lanes.append(lanes)
 
     batch_handle = _run_batch_async(items, cache)
+    return _CoalescedHandle(batch_handle, jobs, job_lanes, errors)
 
-    class _Handle:
-        def result(self):
-            oks = batch_handle.result()
-            for j, (vals, block_id, height, commit) in enumerate(jobs):
-                if errors[j] is not None:
-                    continue
-                tallied = 0
-                bad = None
-                for lane, i in job_lanes[j]:
-                    if not oks[lane]:
-                        bad = ErrInvalidSignature(
-                            f"invalid signature for validator {i} "
-                            f"at height {height}"
-                        )
-                        break
-                    if commit.signatures[i].for_block():
-                        tallied += vals.get_by_index(i).voting_power
-                if bad is not None:
-                    errors[j] = bad
-                elif not tallied * 3 > vals.total_voting_power() * 2:
-                    errors[j] = ErrNotEnoughVotingPower(
-                        f"height {height}: tallied {tallied} <= 2/3"
+
+class _CoalescedHandle:
+    """``result()`` blocks for the lane verdicts and folds them back
+    into per-job errors (tally + 2/3 check per commit)."""
+
+    __slots__ = ("_batch", "_jobs", "_job_lanes", "_errors")
+
+    def __init__(self, batch, jobs, job_lanes, errors) -> None:
+        self._batch = batch
+        self._jobs = jobs
+        self._job_lanes = job_lanes
+        self._errors = errors
+
+    def result(self):
+        oks = self._batch.result()
+        errors = self._errors
+        for j, (vals, block_id, height, commit) in enumerate(
+            self._jobs
+        ):
+            if errors[j] is not None:
+                continue
+            tallied = 0
+            bad = None
+            for lane, i in self._job_lanes[j]:
+                if not oks[lane]:
+                    bad = ErrInvalidSignature(
+                        f"invalid signature for validator {i} "
+                        f"at height {height}"
                     )
-            return errors
-
-    return _Handle()
+                    break
+                if commit.signatures[i].for_block():
+                    tallied += vals.get_by_index(i).voting_power
+            if bad is not None:
+                errors[j] = bad
+            elif not tallied * 3 > vals.total_voting_power() * 2:
+                errors[j] = ErrNotEnoughVotingPower(
+                    f"height {height}: tallied {tallied} <= 2/3"
+                )
+        return errors
 
 
 def verify_commits_coalesced(
