@@ -125,14 +125,15 @@ class _BatchHandle:
         self._cache = cache
 
     def result(self):
-        oks = [True] * len(self._items)
+        items, cache = self._items, self._cache
+        oks = [True] * len(items)
         if self._pending is not None:
             _, verdicts = self._pending.result()
             for i, ok in zip(self._to_verify, verdicts):
                 oks[i] = ok
-                if ok and self._cache is not None:
-                    pk, sb, sig = self._items[i]
-                    self._cache.add(sb, sig, pk.key_bytes)
+                if ok and cache is not None:
+                    pk, sb, sig = items[i]
+                    cache.add(sb, sig, pk.key_bytes)
         return oks
 
 
