@@ -459,6 +459,24 @@ def cmd_light(args) -> int:
         for w in (args.witnesses.split(",") if args.witnesses else [])
         if w
     ]
+    store = None
+    if args.dir:
+        # persistent trust store (reference light home db): a
+        # restarted daemon resumes from its last VERIFIED header —
+        # the CLI trust root only seeds an empty store
+        from ..light.store import DBLightStore
+        from ..utils.kv import open_kv
+
+        os.makedirs(os.path.expanduser(args.dir), exist_ok=True)
+        store = DBLightStore(
+            open_kv(
+                "sqlite",
+                os.path.join(
+                    os.path.expanduser(args.dir), "light.db"
+                ),
+            ),
+            args.chain_id,
+        )
     cli = Client(
         args.chain_id,
         TrustOptions(
@@ -468,6 +486,7 @@ def cmd_light(args) -> int:
         ),
         primary=primary,
         witnesses=witnesses,
+        store=store,
     )
     if args.laddr:
         # proxy mode (the reference command's primary role): serve
@@ -833,6 +852,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trust-hash", required=True)
     p.add_argument("--trust-period-h", type=float, default=168.0)
     p.add_argument("--interval-s", type=float, default=1.0)
+    p.add_argument(
+        "--dir",
+        default="",
+        help="persist the trust store here (light.db); a restart "
+        "resumes from the last verified header instead of the CLI "
+        "trust root (reference light home dir)",
+    )
     p.add_argument(
         "--laddr",
         default="",

@@ -281,7 +281,10 @@ class Runner:
                 # evidence assertion), then quiesce everything before
                 # the gRPC check so no kill can race the in-flight RPC
                 if pert_tasks:
-                    await asyncio.wait(pert_tasks, timeout=30.0)
+                    # generous: a lagging evidence routine may still be
+                    # inside its RPC retry loop (serial 3s-timeout
+                    # height polls under contention)
+                    await asyncio.wait(pert_tasks, timeout=60.0)
                 quiesce = [t for t in [load_task, *pert_tasks] if t]
                 for t in quiesce:
                     t.cancel()
@@ -561,18 +564,38 @@ class Runner:
                 # test/e2e/runner/evidence.go:32). Retried: on a loaded
                 # host an RPC can time out transiently.
                 print(f"[perturb] evidence from {rn.spec.name}", flush=True)
-                for attempt in range(5):
-                    try:
-                        await asyncio.to_thread(self._inject_evidence, rn)
-                        self._evidence_injected = True
-                        break
-                    except Exception as e:
-                        print(
-                            f"[perturb] evidence attempt {attempt} "
-                            f"failed: {e}",
-                            flush=True,
+                last_err = None
+                try:
+                    for attempt in range(10):
+                        try:
+                            await asyncio.to_thread(
+                                self._inject_evidence, rn
+                            )
+                            self._evidence_injected = True
+                            break
+                        except Exception as e:
+                            last_err = e
+                            print(
+                                f"[perturb] evidence attempt {attempt} "
+                                f"failed: {e}",
+                                flush=True,
+                            )
+                            await asyncio.sleep(2.0)
+                    else:
+                        # record WHY so a 'never injected' assertion
+                        # is diagnosable instead of a bare flag check
+                        self.failures.append(
+                            f"evidence injection exhausted retries: "
+                            f"{last_err!r}"
                         )
-                        await asyncio.sleep(2.0)
+                except asyncio.CancelledError:
+                    # quiesce cancelled us mid-retry: still leave a
+                    # diagnosable cause behind the flag check
+                    self.failures.append(
+                        "evidence injection cancelled mid-retry "
+                        f"(last error: {last_err!r})"
+                    )
+                    raise
 
     def _inject_evidence(self, rn: RunnerNode) -> None:
         import time as _time

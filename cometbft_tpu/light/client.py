@@ -58,7 +58,10 @@ class Client:
         self.trust = trust_options
         self.primary = primary
         self.witnesses = witnesses or []
-        self.store = store or LightStore()
+        # identity check, NOT truthiness: an EMPTY persistent store
+        # (fresh light home) is falsy via __len__ and `store or ...`
+        # would silently discard it
+        self.store = LightStore() if store is None else store
         self.mode = verification_mode
         self.trust_level = trust_level
         self.drift = max_clock_drift_ns
@@ -76,6 +79,38 @@ class Client:
     def _init_trust(self) -> None:
         lb = self.store.latest()
         if lb is not None:
+            # resuming from a persisted store: the CLI trust root must
+            # AGREE with what we already trust at that height — a
+            # silent override either way would let a typo'd (or
+            # forked) root go unnoticed (reference
+            # light.go checkTrustedHeaderAgainstOptions). Recovery
+            # from a deliberate re-root: clear the light store.
+            stored = self.store.get(self.trust.height)
+            if stored is not None:
+                claimed = bytes(stored.hash())
+            else:
+                # trust height not retained (bisection pivots +
+                # pruning keep a sparse store): compare against the
+                # primary's header at that height — a mismatch means
+                # either the configured root or the primary is on a
+                # different chain, and both deserve a refusal rather
+                # than a silent override. An unreachable primary
+                # tolerates (the daemon resumes from the store and
+                # re-dials).
+                try:
+                    claimed = bytes(
+                        self.primary.light_block(
+                            self.trust.height
+                        ).hash()
+                    )
+                except Exception:
+                    return
+            if claimed != bytes(self.trust.hash):
+                raise LightClientError(
+                    f"trusted store conflicts with the configured "
+                    f"trust root at height {self.trust.height} "
+                    "(re-rooting requires clearing the light store)"
+                )
             return
         lb = self.primary.light_block(self.trust.height)
         if lb.hash() != self.trust.hash:

@@ -1,9 +1,17 @@
-"""Trusted light block store (reference light/store/db)."""
+"""Trusted light block stores (reference light/store/db).
+
+``LightStore`` is the in-memory form (embedded clients, tests);
+``DBLightStore`` persists the trust roots to a KV backend so a light
+daemon restarted from its home dir resumes from its last verified
+header instead of re-trusting the CLI arguments (the reference light
+command backs its store with a db under the light home,
+cmd/cometbft/commands/light.go:187)."""
 
 from __future__ import annotations
 
 from typing import Dict, Optional
 
+from ..utils import kv, proto
 from .types import LightBlock
 
 
@@ -31,11 +39,77 @@ class LightStore:
             return None
         return self._by_height[min(self._by_height)]
 
-    def prune(self, keep: int) -> None:
+    def prune(self, keep: int) -> list:
+        """Drop all but the ``keep`` highest roots; returns the
+        removed heights (subclasses delete their durable copies of
+        EXACTLY these, so the policies can never diverge)."""
         if len(self._by_height) <= keep:
-            return
-        for h in sorted(self._by_height)[:-keep]:
+            return []
+        doomed = sorted(self._by_height)[:-keep]
+        for h in doomed:
             del self._by_height[h]
+        return doomed
 
     def __len__(self) -> int:
         return len(self._by_height)
+
+
+def _encode_light_block(lb: LightBlock) -> bytes:
+    from ..utils import codec
+
+    return (
+        proto.field_message(1, codec.encode_header(lb.header))
+        + proto.field_message(2, codec.encode_commit(lb.commit))
+        + proto.field_message(
+            3, codec.encode_validator_set(lb.validator_set)
+        )
+    )
+
+
+def _decode_light_block(b: bytes) -> LightBlock:
+    from ..utils import codec
+
+    m = proto.parse(b)
+    return LightBlock(
+        header=codec.decode_header(proto.get1(m, 1, b"")),
+        commit=codec.decode_commit(proto.get1(m, 2, b"")),
+        validator_set=codec.decode_validator_set(proto.get1(m, 3, b"")),
+    )
+
+
+class DBLightStore(LightStore):
+    """LightStore persisted to a KV backend: the in-memory index stays
+    authoritative for reads (light stores hold at most pruning_size
+    headers), the KV holds the durable copy, loaded once at open.
+    Keys: ``L:<hex chain_id>:<height BE64>`` — hex keeps the prefix
+    unambiguous for chain ids containing ':'. Saves auto-prune to
+    ``pruning_size`` like the reference's db store (light/store/db
+    SaveLightBlock, default 1000)."""
+
+    def __init__(self, db: kv.KV, chain_id: str, pruning_size: int = 1000):
+        super().__init__()
+        self.db = db
+        self.pruning_size = pruning_size
+        self._prefix = (
+            b"L:" + chain_id.encode().hex().encode() + b":"
+        )
+        for k, v in self.db.iter_prefix(self._prefix):
+            lb = _decode_light_block(v)
+            if lb.header.chain_id != chain_id:
+                continue  # defense in depth vs foreign records
+            self._by_height[lb.height] = lb
+
+    def _key(self, height: int) -> bytes:
+        return self._prefix + height.to_bytes(8, "big")
+
+    def save(self, lb: LightBlock) -> None:
+        super().save(lb)
+        self.db.set(self._key(lb.height), _encode_light_block(lb))
+        if self.pruning_size and len(self._by_height) > self.pruning_size:
+            self.prune(self.pruning_size)
+
+    def prune(self, keep: int) -> list:
+        doomed = super().prune(keep)
+        for h in doomed:
+            self.db.delete(self._key(h))
+        return doomed

@@ -180,6 +180,95 @@ def test_light_cli_proxy_mode():
     run(main())
 
 
+def test_light_cli_dir_persists_trust_across_restarts(tmp_path):
+    """`light --dir` (reference light home db): a restarted daemon
+    resumes from its last VERIFIED header — demonstrated by
+    restarting with a BOGUS trust root, which an empty store would
+    reject but a persisted one never consults."""
+    import socket
+    import subprocess
+    import sys
+
+    gen, pvs = make_genesis(2, chain_id="cli-dir")
+
+    async def main():
+        n0 = Node(make_test_cfg("."), gen, privval=pvs[0])
+        n1 = Node(make_test_cfg("."), gen, privval=pvs[1])
+        await n0.start()
+        await n1.start()
+        await n0.dial(n1.listen_addr)
+        while n0.height < 4:
+            await asyncio.sleep(0.05)
+        trust = n0.parts.block_store.load_block(1)
+
+        async def run_once(trust_hash):
+            with socket.socket() as sock:
+                sock.bind(("127.0.0.1", 0))
+                port = sock.getsockname()[1]
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "cometbft_tpu", "light",
+                    "cli-dir",
+                    "-p", n0.rpc_server.listen_addr,
+                    "--trust-height", "1",
+                    "--trust-hash", trust_hash,
+                    "--dir", str(tmp_path / "lighthome"),
+                    "--laddr", f"tcp://127.0.0.1:{port}",
+                ],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            try:
+                deadline = asyncio.get_running_loop().time() + 40
+                async with aiohttp.ClientSession() as s:
+                    while asyncio.get_running_loop().time() < deadline:
+                        try:
+                            async with s.get(
+                                f"http://127.0.0.1:{port}/status"
+                            ) as resp:
+                                body = await resp.json()
+                            if body.get("result", {}).get("verified"):
+                                return body["result"]
+                        except Exception:
+                            pass
+                        await asyncio.sleep(0.3)
+            finally:
+                proc.terminate()
+                proc.wait(10)
+            raise AssertionError("light proxy never served status")
+
+        first = await run_once(trust.hash().hex())
+        assert int(first["sync_info"]["latest_block_height"]) >= 1
+        # same-root restart resumes from the persisted store
+        second = await run_once(trust.hash().hex())
+        assert int(second["sync_info"]["latest_block_height"]) >= 1
+        # a MISMATCHED root against the persisted store must REFUSE to
+        # start (reference checkTrustedHeaderAgainstOptions), not
+        # silently serve either chain of trust
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "cometbft_tpu", "light",
+                "cli-dir",
+                "-p", n0.rpc_server.listen_addr,
+                "--trust-height", "1",
+                "--trust-hash", "00" * 32,
+                "--dir", str(tmp_path / "lighthome"),
+                "--laddr", "tcp://127.0.0.1:0",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        out, _ = await asyncio.to_thread(proc.communicate, None, 40)
+        assert proc.returncode != 0, out[-400:]
+        assert "re-rooting" in out, out[-400:]
+        await n0.stop()
+        await n1.stop()
+
+    run(main())
+
+
 class _TamperingPrimary:
     """Wraps the proxy's HTTPClient; corrupts selected responses the
     way a byzantine full node would."""
