@@ -185,6 +185,85 @@ def test_pipeline_discards_across_valset_change(monkeypatch):
     assert fresh.state_store.load().validators.size() == 5
 
 
+def test_blocksync_interrupt_and_resume(tmp_path):
+    """Blocksync stopped abruptly mid-catch-up (in-flight pipelined
+    lookahead and all) must resume cleanly from the persisted stores
+    in a fresh process-equivalent and complete the sync."""
+    from cometbft_tpu.config.config import test_config
+
+    gen, pvs = make_genesis(3, chain_id="resume-chain")
+    src = make_chain(gen, [pv.priv_key for pv in pvs], 40)
+    home = str(tmp_path / "node")
+
+    def build(h):
+        import os
+
+        os.makedirs(h, exist_ok=True)
+        cfg = test_config(h)
+        cfg.base.db_backend = "sqlite"
+        return build_node(gen, None, config=cfg, home=h)
+
+    fresh = build(home)
+
+    async def phase1():
+        r = BlockSyncReactor(
+            fresh.state, fresh.block_exec, fresh.block_store,
+            verify_window=8,
+        )
+        r.pool.set_peer_range(
+            "src", StorePeerClient(src), 1, src.block_store.height()
+        )
+        # prefill so the pipelined lookahead genuinely engages before
+        # the abrupt stop (otherwise this degrades to a plain restart
+        # test on a slow-fetch box)
+        deadline = asyncio.get_running_loop().time() + 30
+        while len(r.pool.blocks) < 20:
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError("pool prefill")
+            await asyncio.sleep(0.01)
+        await r.start()
+        while fresh.block_store.height() < 15:
+            await asyncio.sleep(0.01)
+        stats = dict(r.pipeline_stats)
+        await r.stop()  # abrupt: lookahead handle dies with it
+        assert stats["predispatched"] >= 1, stats
+
+    run(phase1())
+    h1 = fresh.block_store.height()
+    assert h1 >= 15
+    fresh.close_stores()
+
+    # "restart": a new node over the same home resumes from disk
+    fresh2 = build(home)
+    assert fresh2.block_store.height() == h1
+    assert fresh2.state.last_block_height == h1
+
+    async def phase2():
+        caught = asyncio.Event()
+        r = BlockSyncReactor(
+            fresh2.state, fresh2.block_exec, fresh2.block_store,
+            on_caught_up=lambda st: caught.set(),
+            verify_window=8,
+        )
+        r.pool.set_peer_range(
+            "src", StorePeerClient(src), 1, src.block_store.height()
+        )
+        await r.start()
+        await asyncio.wait_for(caught.wait(), 60)
+        await r.stop()
+
+    run(phase2())
+    assert (
+        fresh2.block_store.height() >= src.block_store.height() - 1
+    )
+    h = fresh2.block_store.height()
+    assert (
+        fresh2.block_store.load_block(h).hash()
+        == src.block_store.load_block(h).hash()
+    )
+    fresh2.close_stores()
+
+
 def test_async_handle_matches_sync_verdicts():
     """verify_commits_coalesced_async().result() ==
     verify_commits_coalesced() on the same jobs (incl. a bad one)."""
