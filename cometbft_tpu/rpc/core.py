@@ -534,25 +534,52 @@ def tx(env, hash=None, prove=False) -> Dict[str, Any]:
         "tx": enc.b64(tx_bytes),
     }
     if _bool(prove):
-        # merkle inclusion proof of the tx against the block's
-        # data_hash (reference rpc/core/tx.go Prove; the light proxy
-        # verifies it against the light-verified header)
-        blk = env.block_store.load_block(height)
-        if blk is not None and index < len(blk.data.txs):
-            from ..crypto import merkle
-            from ..types.block import tx_hash
-
-            _, proofs = merkle.proofs_from_byte_slices(
-                [tx_hash(t) for t in blk.data.txs]
-            )
-            out["proof"] = {
-                "root_hash": enc.hexb(blk.header.data_hash),
-                "data": enc.b64(tx_bytes),
-                "proof_b64": enc.b64(
-                    merkle.encode_proof(proofs[index])
-                ),
-            }
+        # merkle inclusion proof against the block's data_hash
+        # (reference rpc/core/tx.go Prove; the light proxy verifies
+        # it against the light-verified header)
+        out["proof"] = _tx_proof(env, height, index, tx_bytes, {})
     return out
+
+
+def _height_tx_proofs(env, height: int, cache: dict):
+    """(data_hash, [Proof per tx]) for one block, memoized in ``cache``
+    so a proved tx_search page over one block builds the merkle tree
+    ONCE, not per hit. Raises when the block is pruned/missing — a
+    requested proof that cannot be produced is an error, never a
+    silently proof-less response (reference rpc/core/tx.go proveTx)."""
+    got = cache.get(height)
+    if got is None:
+        blk = env.block_store.load_block(height)
+        if blk is None:
+            raise RPCError(
+                -32603,
+                f"cannot prove tx: block {height} not in store "
+                "(pruned?)",
+            )
+        from ..crypto import merkle
+        from ..types.block import tx_hash
+
+        _, proofs = merkle.proofs_from_byte_slices(
+            [tx_hash(t) for t in blk.data.txs]
+        )
+        got = (blk.header.data_hash, proofs)
+        cache[height] = got
+    return got
+
+
+def _tx_proof(env, height: int, index: int, tx_bytes: bytes, cache: dict):
+    from ..crypto import merkle
+
+    data_hash, proofs = _height_tx_proofs(env, height, cache)
+    if index >= len(proofs):
+        raise RPCError(
+            -32603, f"cannot prove tx: index {index} out of range"
+        )
+    return {
+        "root_hash": enc.hexb(data_hash),
+        "data": enc.b64(tx_bytes),
+        "proof_b64": enc.b64(merkle.encode_proof(proofs[index])),
+    }
 
 
 def tx_search(
@@ -566,17 +593,22 @@ def tx_search(
         hits = list(reversed(hits))
     page, per_page = _page(page), min(_h(per_page, 30) or 30, 100)
     start = (page - 1) * per_page
-    out = []
+    with_proof = _bool(prove)
+    proof_cache: dict = {}  # height -> (data_hash, proofs): one tree
+    out = []                # build per block, however many hits share it
     for height, index, tx_bytes, tx_result, key in hits[start : start + per_page]:
-        out.append(
-            {
-                "hash": enc.hexb(key),
-                "height": str(height),
-                "index": index,
-                "tx_result": enc.tx_result_json(tx_result),
-                "tx": enc.b64(tx_bytes),
-            }
-        )
+        item = {
+            "hash": enc.hexb(key),
+            "height": str(height),
+            "index": index,
+            "tx_result": enc.tx_result_json(tx_result),
+            "tx": enc.b64(tx_bytes),
+        }
+        if with_proof:
+            item["proof"] = _tx_proof(
+                env, height, index, tx_bytes, proof_cache
+            )
+        out.append(item)
     return {"txs": out, "total_count": str(len(hits))}
 
 

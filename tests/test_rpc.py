@@ -69,9 +69,23 @@ def test_broadcast_tx_commit_and_tx_query():
         txr = await cli.call("tx", hash=hashlib.sha256(tx).hexdigest())
         assert int(txr["height"]) == height
         assert base64.b64decode(txr["tx"]) == tx
-        # tx_search by height
-        sr = await cli.call("tx_search", query=f"tx.height={height}")
+        # tx_search by height — with prove=true each hit carries a
+        # verifiable inclusion proof against the block's data hash
+        sr = await cli.call(
+            "tx_search", query=f"tx.height={height}", prove=True
+        )
         assert int(sr["total_count"]) >= 1
+        hit = next(
+            t for t in sr["txs"] if base64.b64decode(t["tx"]) == tx
+        )
+        from cometbft_tpu.crypto import merkle
+        from cometbft_tpu.types.block import tx_hash
+
+        proof = merkle.decode_proof(
+            base64.b64decode(hit["proof"]["proof_b64"])
+        )
+        root = bytes.fromhex(hit["proof"]["root_hash"])
+        assert proof.verify(root, tx_hash(tx))
         # abci_query sees the committed kv pair
         q = await cli.abci_query("/store", b"rpckey")
         assert base64.b64decode(q["response"]["value"] or "") == b"rpcval"
