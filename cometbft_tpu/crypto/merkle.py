@@ -276,6 +276,15 @@ def kv_leaf(key: bytes, value: bytes) -> bytes:
 
 
 def _leaf_root(proof: Proof, leaf: bytes):
+    # Bounds must be enforced here, not just in Proof.verify: the
+    # absence-op adjacency/ordering checks (index+1, index==0/total-1)
+    # assume index integrity that _compute_root alone does not give —
+    # the extreme leaves' proofs also verify under inflated/negative
+    # indices.
+    if proof.total <= 0:
+        raise ProofError("inclusion proof with non-positive tree size")
+    if not (0 <= proof.index < proof.total):
+        raise ProofError("inclusion proof index out of bounds")
     lh = leaf_hash(leaf)
     root = _compute_root(proof.total, proof.index, lh, proof.aunts)
     if root is None:

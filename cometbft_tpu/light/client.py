@@ -90,21 +90,77 @@ class Client:
                 claimed = bytes(stored.hash())
             else:
                 # trust height not retained (bisection pivots +
-                # pruning keep a sparse store): compare against the
-                # primary's header at that height — a mismatch means
-                # either the configured root or the primary is on a
-                # different chain, and both deserve a refusal rather
-                # than a silent override. An unreachable primary
-                # tolerates (the daemon resumes from the store and
-                # re-dials).
+                # pruning keep a sparse store): fetch the primary's
+                # header at that height and ANCHOR it to the persisted
+                # trust chain before using it as the comparison basis
+                # — an unanchored header would let a colluding primary
+                # confirm a mis-rooted configuration (the check exists
+                # to catch exactly that). An unreachable primary
+                # tolerates with a prominent warning (the daemon
+                # resumes from the store and re-dials).
+                from ..utils.log import get_logger
+
+                log = get_logger("light")
                 try:
-                    claimed = bytes(
-                        self.primary.light_block(
-                            self.trust.height
-                        ).hash()
+                    fetched = self.primary.light_block(
+                        self.trust.height
                     )
                 except Exception:
+                    log.error(
+                        "trust-root cross-check SKIPPED: primary "
+                        "unreachable and persisted store does not "
+                        "retain the trust height",
+                        height=self.trust.height,
+                    )
                     return
+                try:
+                    lowest = self.store.lowest()
+                    if fetched.height < lowest.height:
+                        self._verify_backwards(lowest, fetched)
+                    else:
+                        anchor = self.store.latest_before(
+                            fetched.height
+                        )
+                        self._verify_skipping(
+                            anchor or lowest, fetched, time.time_ns()
+                        )
+                except verifier.ErrOldHeaderExpired:
+                    raise LightClientError(
+                        f"cannot confirm the configured trust root: "
+                        f"the persisted anchor near height "
+                        f"{self.trust.height} is outside the trust "
+                        "period (re-root with a fresh height/hash "
+                        "after clearing the light store)"
+                    )
+                except (
+                    ProviderError,
+                    ConnectionError,
+                    OSError,
+                    TimeoutError,
+                ):
+                    log.error(
+                        "trust-root cross-check SKIPPED: could not "
+                        "anchor the primary's header to the stored "
+                        "chain (provider error)",
+                        height=self.trust.height,
+                    )
+                    return
+                except Exception:
+                    # any VERIFICATION failure (hash-chain break,
+                    # invalid commit/header, valset mismatch — raised
+                    # as assorted types by validate_basic and the
+                    # commit verifiers) means the primary's header
+                    # does NOT anchor: refuse, never skip — skipping
+                    # here would let a colluding primary confirm a
+                    # mis-rooted config by serving an unverifiable
+                    # header
+                    raise LightClientError(
+                        f"primary's header at trust height "
+                        f"{self.trust.height} does not chain to the "
+                        "persisted trusted store (primary diverged "
+                        "or store corrupt)"
+                    )
+                claimed = bytes(fetched.hash())
             if claimed != bytes(self.trust.hash):
                 raise LightClientError(
                     f"trusted store conflicts with the configured "

@@ -194,8 +194,24 @@ class LightProxy:
 
         params = dict(params)
         params["prove"] = True
+        # the hash param must be present and parseable BEFORE the
+        # primary is consulted: without it the identity check below
+        # has nothing to bind to, and a primary could return any
+        # committed tx with a valid inclusion proof and have it marked
+        # verified
+        requested = _bytes_param(params.get("hash"))
+        if not requested:
+            raise RuntimeError(
+                "verified tx lookup requires a tx hash param"
+            )
         res = await self.primary.call("tx", **params)
         height = int(res.get("height") or 0)
+        if height <= 0:
+            # height=0 would resolve _verified_light_block to the
+            # primary-chosen latest height — reject malformed responses
+            raise RuntimeError(
+                "primary returned a tx without a positive height"
+            )
         proof = res.get("proof") or {}
         if not proof.get("proof_b64"):
             raise RuntimeError("primary returned no tx inclusion proof")
@@ -203,8 +219,7 @@ class LightProxy:
         # the returned tx must BE the one the caller asked about — an
         # inclusion proof for a different (genuinely committed) tx
         # would otherwise verify
-        requested = _bytes_param(params.get("hash"))
-        if requested and tx_hash(tx_bytes) != requested:
+        if tx_hash(tx_bytes) != requested:
             raise RuntimeError(
                 "primary returned a different tx than requested"
             )

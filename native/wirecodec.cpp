@@ -78,20 +78,22 @@ static void put_timestamp(Buf& out, unsigned field, int64_t ns) {
   out.field_message(field, ts.d.data(), ts.d.size());
 }
 
-static bool get_bytes_attr(PyObject* obj, const char* name,
-                           const uint8_t** p, Py_ssize_t* n) {
+// Returns a NEW reference to the attribute (or nullptr on error) and
+// fills p/n with its buffer. The caller must hold the returned
+// reference until it is done with *p: if the attribute were a property
+// returning a fresh bytes object, an early DECREF would leave *p
+// dangling (use-after-free).
+static PyObject* get_bytes_attr(PyObject* obj, const char* name,
+                                const uint8_t** p, Py_ssize_t* n) {
   PyObject* v = PyObject_GetAttrString(obj, name);
-  if (!v) return false;
+  if (!v) return nullptr;
   char* cp;
   if (PyBytes_AsStringAndSize(v, &cp, n) < 0) {
     Py_DECREF(v);
-    return false;
+    return nullptr;
   }
   *p = (const uint8_t*)cp;
-  // the commit object keeps the bytes alive for the duration of the
-  // call (attributes of live sig objects); safe to borrow
-  Py_DECREF(v);
-  return true;
+  return v;
 }
 
 static bool get_i64_attr(PyObject* obj, const char* name, int64_t* out) {
@@ -100,6 +102,36 @@ static bool get_i64_attr(PyObject* obj, const char* name, int64_t* out) {
   *out = (int64_t)PyLong_AsLongLong(v);
   Py_DECREF(v);
   return !(PyErr_Occurred());
+}
+
+// encode one CommitSig object into sub (cleared first); false on error
+// with the Python exception set. Attribute references are held until
+// their buffers have been copied into sub.
+static bool encode_commitsig(PyObject* cs, Buf& sub) {
+  int64_t flag, ts;
+  const uint8_t *addr, *sig;
+  Py_ssize_t addr_n, sig_n;
+  if (!get_i64_attr(cs, "block_id_flag", &flag)) return false;
+  PyObject* addr_o =
+      get_bytes_attr(cs, "validator_address", &addr, &addr_n);
+  if (!addr_o) return false;
+  if (!get_i64_attr(cs, "timestamp_ns", &ts)) {
+    Py_DECREF(addr_o);
+    return false;
+  }
+  PyObject* sig_o = get_bytes_attr(cs, "signature", &sig, &sig_n);
+  if (!sig_o) {
+    Py_DECREF(addr_o);
+    return false;
+  }
+  sub.d.clear();
+  sub.field_varint(1, flag);
+  sub.field_bytes(2, addr, (size_t)addr_n);
+  put_timestamp(sub, 3, ts);
+  sub.field_bytes(4, sig, (size_t)sig_n);
+  Py_DECREF(addr_o);
+  Py_DECREF(sig_o);
+  return true;
 }
 
 // encode_commit(height, round, block_id_bytes, sigs) -> bytes
@@ -125,21 +157,10 @@ static PyObject* wc_encode_commit(PyObject*, PyObject* args) {
   Buf sub;
   for (Py_ssize_t i = 0; i < n; i++) {
     PyObject* cs = PySequence_Fast_GET_ITEM(seq, i);
-    int64_t flag, ts;
-    const uint8_t *addr, *sig;
-    Py_ssize_t addr_n, sig_n;
-    if (!get_i64_attr(cs, "block_id_flag", &flag) ||
-        !get_bytes_attr(cs, "validator_address", &addr, &addr_n) ||
-        !get_i64_attr(cs, "timestamp_ns", &ts) ||
-        !get_bytes_attr(cs, "signature", &sig, &sig_n)) {
+    if (!encode_commitsig(cs, sub)) {
       Py_DECREF(seq);
       return nullptr;
     }
-    sub.d.clear();
-    sub.field_varint(1, flag);
-    sub.field_bytes(2, addr, (size_t)addr_n);
-    put_timestamp(sub, 3, ts);
-    sub.field_bytes(4, sig, (size_t)sig_n);
     out.field_message(4, sub.d.data(), sub.d.size());
   }
   Py_DECREF(seq);
@@ -565,21 +586,10 @@ static PyObject* wc_commit_merkle_root(PyObject*, PyObject* args) {
   Buf sub;
   for (Py_ssize_t i = 0; i < n; i++) {
     PyObject* cs = PySequence_Fast_GET_ITEM(seq, i);
-    int64_t flag, ts;
-    const uint8_t *addr, *sig;
-    Py_ssize_t addr_n, sig_n;
-    if (!get_i64_attr(cs, "block_id_flag", &flag) ||
-        !get_bytes_attr(cs, "validator_address", &addr, &addr_n) ||
-        !get_i64_attr(cs, "timestamp_ns", &ts) ||
-        !get_bytes_attr(cs, "signature", &sig, &sig_n)) {
+    if (!encode_commitsig(cs, sub)) {
       Py_DECREF(seq);
       return nullptr;
     }
-    sub.d.clear();
-    sub.field_varint(1, flag);
-    sub.field_bytes(2, addr, (size_t)addr_n);
-    put_timestamp(sub, 3, ts);
-    sub.field_bytes(4, sig, (size_t)sig_n);
     acc.push_leaf(sub.d.data(), sub.d.size());
   }
   Py_DECREF(seq);

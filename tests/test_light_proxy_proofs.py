@@ -96,6 +96,42 @@ def test_tampered_proofs_rejected(proved_app):
         rt.verify_value(ops, proved_app.app_hash, b"a", b"1")
 
 
+def test_out_of_bounds_proof_indices_rejected(proved_app):
+    """ADVICE r4 (medium): the extreme leaves' inclusion proofs ALSO
+    recompute the correct root under inflated (rightmost) / negative
+    (leftmost) indices — _leaf_root must enforce 0 <= index < total
+    itself, or the absence-op adjacency checks sit on unverified index
+    integrity."""
+    rt = merkle.ProofRuntime()
+
+    def mutate(key, value, index=None, total=None):
+        ops, _ = _ops(proved_app, key)
+        p = merkle.decode_proof(ops[0].data)
+        if index is not None:
+            p.index = index
+        if total is not None:
+            p.total = total
+        ops[0].data = merkle.encode_proof(p)
+        return ops
+
+    # rightmost leaf (b"e", index 2 of 3): inflated index
+    for idx, tot in ((5, 3), (3, 3), (2, 0), (2, -1)):
+        with pytest.raises(merkle.ProofError):
+            rt.verify_value(
+                mutate(b"e", b"5", index=idx, total=tot),
+                proved_app.app_hash, b"e", b"5",
+            )
+    # leftmost leaf (b"a", index 0): negative index
+    with pytest.raises(merkle.ProofError):
+        rt.verify_value(
+            mutate(b"a", b"1", index=-1),
+            proved_app.app_hash, b"a", b"1",
+        )
+    # unmutated controls still verify
+    ops, _ = _ops(proved_app, b"e")
+    rt.verify_value(ops, proved_app.app_hash, b"e", b"5")
+
+
 # --- e2e: proxy over a live net ----------------------------------------
 
 
@@ -297,6 +333,10 @@ class _TamperingPrimary:
             import base64
 
             res["tx"] = base64.b64encode(b"forged-tx=1").decode()
+        if method == "tx" and self.mode == "txheight":
+            # malformed/malicious: no committed height — must not
+            # resolve the proof against a primary-chosen latest block
+            res["height"] = "0"
         return res
 
     def __getattr__(self, name):
@@ -406,6 +446,18 @@ def test_proxy_verifies_queries_and_rejects_tampering():
 
         # 6. forged tx bytes -> rejected
         tamper.mode = "tx"
+        body = await get(f"/tx?hash={tx_hash_hex}")
+        assert "error" in body and body["error"], body
+
+        # 7. tx lookup WITHOUT a hash param -> refused up front (the
+        # identity check would otherwise have nothing to bind to)
+        tamper.mode = None
+        body = await get("/tx")
+        assert "error" in body and body["error"], body
+
+        # 8. zeroed height in the response -> rejected before any
+        # light-block resolution
+        tamper.mode = "txheight"
         body = await get(f"/tx?hash={tx_hash_hex}")
         assert "error" in body and body["error"], body
 

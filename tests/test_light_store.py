@@ -118,3 +118,106 @@ def test_db_light_store_roundtrip_and_resume(tmp_path):
     # chain-id prefix isolation: another chain's records don't bleed
     other = DBLightStore(store3.db, "other-chain")
     assert len(other) == 0
+    store3.db.close()
+
+
+def test_sparse_store_trust_check_anchors_to_chain(tmp_path):
+    """ADVICE r4: when the persisted store no longer retains the trust
+    height, the primary's header at that height must be ANCHORED to
+    the stored trust chain before it can confirm the configured root —
+    a colluding primary serving a forged header that matches a
+    mis-rooted config must be refused, and an unreachable primary must
+    tolerate (resume from the store), not silently confirm."""
+    import dataclasses
+
+    gen, pvs = make_genesis(3, chain_id="light-anchor")
+    src = make_chain(gen, [pv.priv_key for pv in pvs], 12)
+    provider = StoreBackedProvider(src, gen.chain_id)
+    trust = src.block_store.load_block(1)
+
+    def sparse_client(primary, trust_hash):
+        # persisted store retaining only the tip: trust height 1 gone
+        store = LightStore()
+        cli = Client(
+            "light-anchor",
+            TrustOptions(
+                period_ns=3600 * 10**9, height=1, hash=trust.hash()
+            ),
+            primary=provider,
+            store=store,
+        )
+        cli.verify_light_block_at_height(9)
+        store.prune(1)
+        return Client(
+            "light-anchor",
+            TrustOptions(
+                period_ns=3600 * 10**9, height=1, hash=trust_hash
+            ),
+            primary=primary,
+            store=store,
+        )
+
+    class ForgingProvider:
+        """Serves a forged header at the trust height whose hash
+        matches the (mis-rooted) configured trust hash; genuine
+        everywhere else — exactly a colluding primary confirming a
+        typo'd root."""
+
+        def __init__(self):
+            genuine = provider.light_block(1)
+            forged_header = dataclasses.replace(
+                genuine.header, time_ns=genuine.header.time_ns + 1
+            )
+            self.forged = dataclasses.replace(
+                genuine, header=forged_header
+            )
+
+        def light_block(self, height):
+            if height == 1:
+                return self.forged
+            return provider.light_block(height)
+
+    forger = ForgingProvider()
+    with pytest.raises(LightClientError, match="does not chain"):
+        sparse_client(forger, bytes(forger.forged.hash()))
+
+    class DeadProvider:
+        def light_block(self, height):
+            raise ConnectionError("primary unreachable")
+
+    # unreachable primary: resume from the store (prominently logged),
+    # never a refusal and never a silent confirmation of ANY root
+    cli = sparse_client(DeadProvider(), b"\x77" * 32)
+    assert cli.store.latest() is not None
+
+    # forged header ABOVE the lowest stored block: anchoring runs the
+    # SKIPPING path, whose verifiers raise assorted (non-
+    # LightClientError) types — those must classify as refusal, not as
+    # a skippable provider error (code-review r5 finding)
+    store2 = LightStore()
+    for h in (2, 9):
+        store2.save(provider.light_block(h))
+    genuine5 = provider.light_block(1 + 4)
+    forged5 = dataclasses.replace(
+        genuine5,
+        header=dataclasses.replace(
+            genuine5.header, time_ns=genuine5.header.time_ns + 1
+        ),
+    )
+
+    class MidForger:
+        def light_block(self, height):
+            if height == 5:
+                return forged5
+            return provider.light_block(height)
+
+    with pytest.raises(LightClientError, match="does not chain"):
+        Client(
+            "light-anchor",
+            TrustOptions(
+                period_ns=3600 * 10**9, height=5,
+                hash=bytes(forged5.hash()),
+            ),
+            primary=MidForger(),
+            store=store2,
+        )
