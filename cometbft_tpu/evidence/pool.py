@@ -90,6 +90,26 @@ class EvidencePool:
         if common_vals is None:
             raise EvidenceError("no validators at common height")
         lb = ev.conflicting_block
+        # the "conflicting" block must actually CONFLICT with our
+        # chain: accepting evidence whose block matches our own header
+        # would let anyone submit the real chain as an "attack" and
+        # slash its honest signers (reference verify.go compares
+        # against the locally trusted header). A height we cannot
+        # compare (not yet synced) must REJECT, not skip — a lagging
+        # node would otherwise accept the real chain's tip as
+        # "evidence" (the reference errors when the trusted header is
+        # unavailable); the reporter retries via gossip once we catch
+        # up.
+        ours = self.block_store.load_block_meta(lb.height)
+        if ours is None:
+            raise EvidenceError(
+                f"cannot judge conflict at height {lb.height}: "
+                "block not yet available locally"
+            )
+        if bytes(ours.block_id.hash) == bytes(lb.hash()):
+            raise EvidenceError(
+                "conflicting block matches our own chain (no attack)"
+            )
         # trusting verification against the common valset, then full
         # verification by the conflicting block's own valset
         T.verify_commit_light_trusting(
@@ -103,6 +123,25 @@ class EvidencePool:
             lb.commit,
             all_signatures=True,
         )
+        # the claimed byzantine set and total power must equal what WE
+        # derive from the common valset — the slashing targets cannot
+        # be attacker-chosen (reference evidence/verify.go:124-136)
+        expected = ev.byzantine_from(common_vals)
+        if [v.address for v in ev.byzantine_validators] != [
+            v.address for v in expected
+        ]:
+            raise EvidenceError(
+                "byzantine validators do not match the derived set"
+            )
+        for claimed, exp in zip(ev.byzantine_validators, expected):
+            if claimed.voting_power != exp.voting_power:
+                raise EvidenceError(
+                    "byzantine validator power mismatch"
+                )
+        if ev.total_voting_power != common_vals.total_voting_power():
+            raise EvidenceError(
+                "evidence total voting power mismatch"
+            )
 
     # --- egress -------------------------------------------------------
 

@@ -95,6 +95,12 @@ class LightClientAttackEvidence:
         body += proto.field_varint(4, self.common_height)
         body += proto.field_varint(5, self.total_voting_power)
         body += proto.field_message(6, proto.timestamp(self.timestamp_ns))
+        from ..utils import codec
+
+        body += b"".join(
+            proto.field_message(7, codec.encode_validator(v))
+            for v in self.byzantine_validators
+        )
         return body
 
     def hash(self) -> bytes:
@@ -105,6 +111,25 @@ class LightClientAttackEvidence:
             raise ValueError("invalid common height")
         if self.conflicting_block is None:
             raise ValueError("missing conflicting block")
+
+    def byzantine_from(self, common_vals) -> list:
+        """The attack's byzantine set, derived (not trusted from the
+        wire): signers of the conflicting commit that sit in the
+        common validator set, descending power (reference
+        types/evidence.go GetByzantineValidators — the lunatic-attack
+        arm; both verifier and reporter compute THIS and the verifier
+        rejects evidence whose claimed set differs)."""
+        from ..types.block import BLOCK_ID_FLAG_COMMIT
+
+        out = []
+        for cs in self.conflicting_block.commit.signatures:
+            if cs.block_id_flag != BLOCK_ID_FLAG_COMMIT:
+                continue
+            _, val = common_vals.get_by_address(cs.validator_address)
+            if val is not None:
+                out.append(val)
+        out.sort(key=lambda v: (-v.voting_power, v.address))
+        return out
 
 
 def decode_evidence(b: bytes):
@@ -133,5 +158,8 @@ def decode_evidence(b: bytes):
             common_height=proto.get1(m, 4, 0),
             total_voting_power=proto.get1(m, 5, 0),
             timestamp_ns=proto.parse_timestamp(proto.get1(m, 6, b"")),
+            byzantine_validators=[
+                codec.decode_validator(x) for x in m.get(7, [])
+            ],
         )
     raise ValueError(f"unknown evidence type {t}")

