@@ -150,18 +150,27 @@ def bench_kernel() -> dict:
 
     # measure the kernel production picks at this width (see
     # ops/ed25519.PRECOMP_MAX_LANES): plain for bulk widths, precomp
-    # (host-expanded pubkeys) for latency-sensitive small batches
-    if N <= ed.PRECOMP_MAX_LANES:
+    # (host-expanded pubkeys) for latency-sensitive small batches.
+    # GRAFT_PRECOMP_MAX_LANES + GRAFT_PRECOMP_TUPLE reach here so the
+    # lever-#6 A/B leg can force tuple-form precomp at bulk widths.
+    if N <= ed._precomp_max_lanes():
         a_arr = np.zeros((4, 20, N), np.int32)
         for i in range(N):
             k, _, _ = pool_items[i % pool]  # lane i's key, same as pks
             a_arr[:, :, i] = ed._expand_pubkey(pubs[k])
-        arrays = (msgs, lens, a_arr, pks, rs, ss)
-        kernel = ed._verify_core_precomp
+        if ed.precomp_tuple_enabled():
+            arrays = (
+                msgs, lens, ed.a_tree_from_stacked(a_arr),
+                pks, rs, ss,
+            )
+            kernel = ed._verify_core_precomp_tuple
+        else:
+            arrays = (msgs, lens, a_arr, pks, rs, ss)
+            kernel = ed._verify_core_precomp
     else:
         arrays = (msgs, lens, pks, rs, ss)
         kernel = ed._verify_core
-    args = [jax.device_put(jnp.asarray(a)) for a in arrays]
+    args = [jax.device_put(a) for a in arrays]
     comp = jax.jit(kernel).lower(*args).compile()
     out = np.asarray(comp(*args))  # warm-up + correctness
     assert out.all(), "benchmark signatures must all verify"
@@ -264,21 +273,6 @@ def _subprocess_config(
         return {"rate": None, "note": f"unparseable child output: {e}"}
 
 
-def bench_kernel_pallas() -> dict:
-    """The kernel config again with the Pallas VMEM-resident ladder
-    (ops/pallas_ladder) — subprocess-budgeted; on timeout the
-    xla-ladder numbers stand. The headline takes the better of the
-    two backends; both are recorded (the docs/PERF.md ablation)."""
-    budget_s = int(os.environ.get("BENCH_PALLAS_BUDGET_S", "1500"))
-    inner = _subprocess_config(
-        "kernel",
-        {"GRAFT_PALLAS": "1"},
-        budget_s,
-        "pallas kernel leg (cold Mosaic compile through the tunnel)",
-    )
-    if inner.get("rate") is not None or "note" not in inner:
-        inner["note"] = "pallas VMEM-resident ladder (GRAFT_PALLAS=1)"
-    return inner
 
 
 # --- corpus: 150-validator chain (cached across rounds) ----------------
@@ -881,25 +875,72 @@ def main() -> None:
                 "skipped": f"host budget ({host_budget_s:.0f}s) "
                 "exhausted before this config"
             }
-    # the Pallas A/B runs LAST: its budgeted subprocess may burn many
-    # minutes on a cold Mosaic compile, and the proven configs above
-    # must be recorded before that risk is taken
+    # the experimental kernel legs run LAST: each budgeted subprocess
+    # may burn many minutes on a cold Mosaic compile, and the proven
+    # configs above must be recorded before that risk is taken.
+    # Sweep (VERDICT r4 #1 prep): pallas sublanes {4, 8} + the
+    # tuple-form precomp A input (docs/PERF.md lever #6), best rate
+    # wins the headline, every leg recorded for the ablation table.
     if (
         "kernel" in todo
         and _DEVICE_OK
         and os.environ.get("GRAFT_PALLAS") != "1"
+        and os.environ.get("GRAFT_PRECOMP_TUPLE") != "1"
         and os.environ.get("BENCH_SKIP_PALLAS") != "1"
     ):
-        configs["kernel_pallas"] = bench_kernel_pallas()
+        leg_budget = int(
+            os.environ.get("BENCH_PALLAS_BUDGET_S", "1200")
+        )
+        extra_wall = float(
+            os.environ.get("BENCH_EXTRA_LEGS_BUDGET_S", "2700")
+        )
+        t_extra = time.time()
+        legs = [
+            (
+                "kernel_pallas_s4",
+                {"GRAFT_PALLAS": "1", "GRAFT_PALLAS_SUBLANES": "4"},
+                "pallas VMEM ladder, 4 sublanes",
+            ),
+            (
+                "kernel_pallas_s8",
+                {"GRAFT_PALLAS": "1", "GRAFT_PALLAS_SUBLANES": "8"},
+                "pallas VMEM ladder, 8 sublanes",
+            ),
+            (
+                "kernel_precomp_tuple",
+                {
+                    "GRAFT_PRECOMP_TUPLE": "1",
+                    "GRAFT_PRECOMP_MAX_LANES": "1000000000",
+                },
+                "tuple-form precomp A at bulk width (lever #6)",
+            ),
+        ]
+        for name, envx, what in legs:
+            if time.time() - t_extra > extra_wall:
+                configs[name] = {
+                    "rate": None,
+                    "note": f"extra-legs wall budget "
+                    f"({extra_wall:.0f}s) exhausted before: {what}",
+                }
+                continue
+            inner = _subprocess_config("kernel", envx, leg_budget, what)
+            if inner.get("rate") is not None or "note" not in inner:
+                inner["note"] = what
+            configs[name] = inner
 
-    # headline = the better of the two ladder backends (both recorded:
+    # headline = the best of every measured kernel leg (all recorded:
     # detail.configs carries the full ablation either way)
     headline = configs.get("kernel", {})
-    pallas = configs.get("kernel_pallas") or {}
-    if (pallas.get("rate") or 0) > (headline.get("rate") or 0):
-        headline = dict(pallas, ladder_backend="pallas")
-    elif "kernel" in configs:
+    if "kernel" in configs:
         headline = dict(headline, ladder_backend="xla")
+    for leg_name, backend in (
+        ("kernel_pallas_s4", "pallas-s4"),
+        ("kernel_pallas_s8", "pallas-s8"),
+        ("kernel_precomp_tuple", "xla-precomp-tuple"),
+    ):
+        leg = configs.get(leg_name) or {}
+        if (leg.get("rate") or 0) > (headline.get("rate") or 0):
+            headline = dict(leg, ladder_backend=backend)
     metric = "ed25519_batch_verify_throughput"
     value = headline.get("rate")
     unit = "verifies/sec"

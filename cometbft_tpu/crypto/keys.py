@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import threading
 from dataclasses import dataclass
 
 from . import ref_ed25519 as _ref
@@ -61,6 +62,37 @@ class PubKey:
         return self.key_bytes
 
 
+# Constructed-OpenSSL-object cache: validator keys repeat massively
+# (a 10k-block replay has ~150 distinct keys for ~1.5M verifies), and
+# Ed25519PublicKey.from_public_bytes costs ~1.5x the hash of the vote
+# itself (profile_replay r5). Only VALID constructions are cached;
+# invalid keys re-raise (and fall through to the liberal check) every
+# time, which is the rare path.
+_EVP_CACHE: dict = {}
+_EVP_CACHE_MAX = 4096
+_EVP_LOCK = threading.Lock()
+
+
+def _openssl_pub(key_bytes: bytes):
+    with _EVP_LOCK:
+        evp = _EVP_CACHE.get(key_bytes)
+    if evp is None:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PublicKey,
+        )
+
+        evp = Ed25519PublicKey.from_public_bytes(key_bytes)
+        # verification runs on worker threads (coalesce, statesync,
+        # light proxy): eviction must not race — an escaped KeyError
+        # here would silently demote the verify to the slow liberal
+        # path via the caller's blanket except
+        with _EVP_LOCK:
+            while len(_EVP_CACHE) >= _EVP_CACHE_MAX:
+                _EVP_CACHE.pop(next(iter(_EVP_CACHE)))
+            _EVP_CACHE[key_bytes] = evp
+    return evp
+
+
 @dataclass(frozen=True)
 class Ed25519PubKey(PubKey):
     @property
@@ -79,13 +111,7 @@ class Ed25519PubKey(PubKey):
             return False
         if _HAVE_OSSL:
             try:
-                from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-                    Ed25519PublicKey,
-                )
-
-                Ed25519PublicKey.from_public_bytes(self.key_bytes).verify(
-                    sig, msg
-                )
+                _openssl_pub(self.key_bytes).verify(sig, msg)
                 return True
             except Exception:
                 pass  # fall through to the liberal ZIP-215 check

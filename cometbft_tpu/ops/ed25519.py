@@ -29,6 +29,7 @@ fallback behavior, can be reproduced by AND-reducing the lane mask).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -272,26 +273,15 @@ def _verify_core_precomp(msgs, lens, a_arr, pks, rs, ss):
     keys for ~1.5M lanes — so only R still pays the ~254-deep sqrt
     chain, halving the decompression stage's depth-dominated cost.
     pks is still an input: the hash is SHA-512(R || A_bytes || M).
+
+    Delegates to the tuple-form body after unpacking the stacked A —
+    ONE verification body serves both dispatch modes (the modes must
+    stay bit-identical; tests assert it).
     """
-    cap = msgs.shape[0]
-    n = rs.shape[1]
     A = tuple(
         tuple(a_arr[k, j] for j in range(fe.NLIMBS)) for k in range(4)
     )
-    R, ok_r = curve.decompress(rs)
-    s = fe.from_bytes_256(ss)
-    ok_s = sc.lt_L(s)
-
-    hin = jnp.concatenate([rs, pks, msgs], axis=0)
-    digest = sha512.sha512(hin, lens + 64, cap + 64)
-    h = sc.reduce_512(sc.hash_bytes_to_limbs(digest))
-    hneg = sc.neg_mod_L(h)
-
-    q = _straus(sc.digits4(s), sc.digits4(hneg), A, (n,))
-    p8 = curve.mul_by_cofactor(
-        curve.add_projective(q, (fe.neg(R[0]), R[1], R[2]))
-    )
-    return ok_r & ok_s & curve.is_identity(p8)
+    return _verify_core_precomp_tuple(msgs, lens, A, pks, rs, ss)
 
 
 def _ladder_backend_key() -> tuple:
@@ -313,11 +303,66 @@ def _ladder_backend_key() -> tuple:
     )
 
 
+def _verify_core_precomp_tuple(msgs, lens, a_tree, pks, rs, ss):
+    """Precomp verify with A handed over as a PYTREE of 80 separate
+    (N,) int32 arrays instead of one stacked (4, 20, N) input
+    (docs/PERF.md lever #6, round-5). The stacked form loses at bulk
+    widths (550 vs 363 ms @131072) because slicing it back apart
+    defeats tuple-of-limbs fusion; jit boundaries accept pytrees, so
+    this variant preserves the tuple form end to end while still
+    skipping A's half of the depth-bound sqrt chain. Opt-in via
+    GRAFT_PRECOMP_TUPLE=1 pending a silicon A/B (not shipped blind).
+    """
+    cap = msgs.shape[0]
+    n = rs.shape[1]
+    A = a_tree
+    R, ok_r = curve.decompress(rs)
+    s = fe.from_bytes_256(ss)
+    ok_s = sc.lt_L(s)
+
+    hin = jnp.concatenate([rs, pks, msgs], axis=0)
+    digest = sha512.sha512(hin, lens + 64, cap + 64)
+    h = sc.reduce_512(sc.hash_bytes_to_limbs(digest))
+    hneg = sc.neg_mod_L(h)
+
+    q = _straus(sc.digits4(s), sc.digits4(hneg), A, (n,))
+    p8 = curve.mul_by_cofactor(
+        curve.add_projective(q, (fe.neg(R[0]), R[1], R[2]))
+    )
+    return ok_r & ok_s & curve.is_identity(p8)
+
+
+def precomp_tuple_enabled() -> bool:
+    return os.environ.get("GRAFT_PRECOMP_TUPLE") == "1"
+
+
+def a_tree_from_stacked(a_arr):
+    """Host-side: stacked (4, NLIMBS, N) numpy A -> the pytree of 80
+    separate (N,) device arrays the tuple kernel takes. The ONE
+    builder production and bench share, so the A/B leg measures the
+    exact input form production dispatches."""
+    return tuple(
+        tuple(
+            jnp.asarray(np.ascontiguousarray(a_arr[k, j]))
+            for j in range(fe.NLIMBS)
+        )
+        for k in range(4)
+    )
+
+
+def _precomp_max_lanes() -> int:
+    """Width cutoff for the precomp kernel; env-overridable so the
+    bench can force precomp at bulk widths for the lever-#6 A/B."""
+    v = os.environ.get("GRAFT_PRECOMP_MAX_LANES")
+    return int(v) if v else PRECOMP_MAX_LANES
+
+
 @functools.lru_cache(maxsize=None)
 def _keyed_jit(kind: str, key: tuple):
     core = {
         "plain": _verify_core,
         "precomp": _verify_core_precomp,
+        "precomp_tuple": _verify_core_precomp_tuple,
     }[kind]
     return jax.jit(core)
 
@@ -331,6 +376,12 @@ def verify_core_jit(msgs, lens, pks, rs, ss):
 def verify_core_precomp_jit(msgs, lens, a_arr, pks, rs, ss):
     return _keyed_jit("precomp", _ladder_backend_key())(
         msgs, lens, a_arr, pks, rs, ss
+    )
+
+
+def verify_core_precomp_tuple_jit(msgs, lens, a_tree, pks, rs, ss):
+    return _keyed_jit("precomp_tuple", _ladder_backend_key())(
+        msgs, lens, a_tree, pks, rs, ss
     )
 
 
@@ -397,10 +448,10 @@ _SHARDED_FNS: dict = {}
 LAST_DISPATCH: dict = {}
 
 
-def _sharded_fn(precomp: bool):
-    """(n_devices, fn): lane-sharded verify (precomp or plain kernel)
-    over all local devices, or (1, None) when single-device /
-    uninitializable backend."""
+def _sharded_fn(mode: str):
+    """(n_devices, fn): lane-sharded verify over all local devices, or
+    (1, None) when single-device / uninitializable backend. ``mode``:
+    "plain" | "precomp" | "precomp_tuple"."""
     try:
         n = len(jax.devices())
     except Exception:  # pragma: no cover - backend init failure
@@ -409,14 +460,12 @@ def _sharded_fn(precomp: bool):
         return 1, None
     # backend key: the sharded program traces through _straus too, so
     # a mid-process backend flip must map to a fresh shard_map program
-    key = (n, precomp, _ladder_backend_key())
+    key = (n, mode, _ladder_backend_key())
     if key not in _SHARDED_FNS:
         from ..parallel.mesh import make_mesh
         from ..parallel.sharded_verify import make_sharded_core
 
-        _SHARDED_FNS[key] = make_sharded_core(
-            make_mesh(n), precomp=precomp
-        )
+        _SHARDED_FNS[key] = make_sharded_core(make_mesh(n), mode)
     return n, _SHARDED_FNS[key]
 
 
@@ -448,7 +497,7 @@ def verify_batch_async(items) -> AsyncVerdicts:
     max_len = max(len(m) for m, _, _ in items)
     cap = bucket_cap(max_len)
     np_ = _pad_n(n)
-    n_dev, probe = _sharded_fn(True)
+    n_dev, probe = _sharded_fn("precomp")
     if probe is not None and np_ % n_dev:
         np_ += n_dev - (np_ % n_dev)
 
@@ -456,10 +505,17 @@ def verify_batch_async(items) -> AsyncVerdicts:
     # precomp (host-expanded A) below the cutoff — the depth-bound
     # decompression dominates there — plain above it, where depth
     # amortizes and the stacked A input costs more than it saves
-    use_precomp = (np_ // n_dev) <= PRECOMP_MAX_LANES
+    # (unless the tuple-form A opt-in is on, docs/PERF.md lever #6)
+    use_precomp = (np_ // n_dev) <= _precomp_max_lanes()
+    tuple_a = use_precomp and precomp_tuple_enabled()
+    mode = (
+        "precomp_tuple"
+        if tuple_a
+        else ("precomp" if use_precomp else "plain")
+    )
     sharded = None
     if probe is not None:
-        _, sharded = _sharded_fn(use_precomp)
+        _, sharded = _sharded_fn(mode)
 
     msgs = np.zeros((cap, np_), np.uint8)
     lens = np.zeros(np_, np.int32)
@@ -493,8 +549,27 @@ def verify_batch_async(items) -> AsyncVerdicts:
         lanes=np_,
         cap=cap,
         precomp=use_precomp,
+        mode=mode,
         backend_key=_ladder_backend_key(),
     )
+    if tuple_a:
+        # pytree A: 80 separate (N,) arrays, preserving tuple-of-limbs
+        # fusion across the jit boundary (lever #6)
+        a_tree = a_tree_from_stacked(a_arr)
+        fn = (
+            sharded
+            if sharded is not None
+            else verify_core_precomp_tuple_jit
+        )
+        res = fn(
+            jnp.asarray(msgs),
+            jnp.asarray(lens),
+            a_tree,
+            jnp.asarray(pks),
+            jnp.asarray(rs),
+            jnp.asarray(ss),
+        )
+        return AsyncVerdicts(res, bad, n)
     if use_precomp:
         fn = sharded if sharded is not None else verify_core_precomp_jit
         arrays = (msgs, lens, a_arr, pks, rs, ss)

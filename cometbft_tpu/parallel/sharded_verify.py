@@ -45,13 +45,14 @@ from ..ops import ed25519 as ed
 from .mesh import DATA_AXIS
 
 
-def make_sharded_core(mesh, precomp: bool = True):
+def make_sharded_core(mesh, mode="precomp"):
     """Lane-sharded verify kernel: per-device ZIP-215 verdicts, no
     cross-device communication (the tally/quorum reduction lives in
     ``make_quorum_reducer``; the host path in types/validation.py does
-    its own arbitrary-precision tally). ``precomp`` selects the
-    host-expanded-pubkey kernel (small per-device widths) or the plain
-    kernel (bulk widths) — same width rule as single-device dispatch
+    its own arbitrary-precision tally). ``mode`` selects the kernel:
+    "precomp" (host-expanded A, small per-device widths), "plain"
+    (bulk widths), or "precomp_tuple" (pytree A — docs/PERF.md lever
+    #6) — same width rule as single-device dispatch
     (ops/ed25519.PRECOMP_MAX_LANES).
 
     This is the PRODUCTION seam: ``ops/ed25519.verify_batch`` (behind
@@ -63,12 +64,30 @@ def make_sharded_core(mesh, precomp: bool = True):
     spec_lanes = P(None, DATA_AXIS)     # (bytes, N)
     spec_limbs = P(None, None, DATA_AXIS)  # (4, 20, N)
     spec_vec = P(DATA_AXIS)             # (N,)
-    if precomp:
+    if mode == "precomp":
         inner = ed._verify_core_precomp
         in_specs = (
             spec_lanes,  # msgs
             spec_vec,    # lens
             spec_limbs,  # precomputed A
+            spec_lanes,  # pks
+            spec_lanes,  # rs
+            spec_lanes,  # ss
+        )
+    elif mode == "precomp_tuple":
+        inner = ed._verify_core_precomp_tuple
+        # pytree A: 4 components x NLIMBS separate (N,) leaves, each
+        # lane-sharded — the spec mirrors the pytree structure
+        from ..ops import fe25519 as fe
+
+        a_specs = tuple(
+            tuple(spec_vec for _ in range(fe.NLIMBS))
+            for _ in range(4)
+        )
+        in_specs = (
+            spec_lanes,  # msgs
+            spec_vec,    # lens
+            a_specs,     # A as tuple-of-limbs pytree
             spec_lanes,  # pks
             spec_lanes,  # rs
             spec_lanes,  # ss

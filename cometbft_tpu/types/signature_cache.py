@@ -8,7 +8,6 @@ dedup across overlapping valsets and bisection hops
 
 from __future__ import annotations
 
-import hashlib
 import threading
 from collections import OrderedDict
 
@@ -18,16 +17,20 @@ DEFAULT_CACHE_SIZE = 10_000
 class SignatureCache:
     def __init__(self, size: int = DEFAULT_CACHE_SIZE):
         self.size = size
-        self._od: OrderedDict[bytes, None] = OrderedDict()
+        self._od: OrderedDict[tuple, None] = OrderedDict()
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
 
     @staticmethod
-    def key(sign_bytes: bytes, sig: bytes, pubkey: bytes) -> bytes:
-        return hashlib.sha256(
-            len(sign_bytes).to_bytes(4, "big") + sign_bytes + sig + pubkey
-        ).digest()
+    def key(sign_bytes: bytes, sig: bytes, pubkey: bytes) -> tuple:
+        """Plain tuple key: collision-free by construction (no digest
+        needed — the reference hashes only to bound Go map key size),
+        and cheap on the miss-then-add path because Python caches each
+        bytes object's hash, so the second keying of the SAME objects
+        costs almost nothing (profile_replay r5: sha256 keying was
+        ~3% of replay host wall with a 0% hit rate on linear sync)."""
+        return (sign_bytes, sig, pubkey)
 
     def contains(self, sign_bytes: bytes, sig: bytes, pubkey: bytes) -> bool:
         k = self.key(sign_bytes, sig, pubkey)

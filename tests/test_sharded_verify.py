@@ -87,6 +87,37 @@ def test_plain_kernel_branch_at_bulk_widths(monkeypatch):
 
 
 @pytest.mark.tpu
+def test_precomp_tuple_mode_matches_stacked(monkeypatch):
+    """docs/PERF.md lever #6 (round 5): GRAFT_PRECOMP_TUPLE=1 hands A
+    to the kernel as a pytree of 80 (N,) arrays instead of one stacked
+    (4,20,N) input. Verdicts must be bit-identical to the stacked
+    precomp kernel through the SHARDED production seam, and the
+    backend-keyed dispatch must flip cleanly mid-process."""
+    rng = np.random.default_rng(6)
+    items = []
+    bad = {3}
+    for i in range(12):
+        sk = rng.bytes(32)
+        pk = ref.public_from_seed(sk)
+        m = bytes(rng.bytes(19))
+        sig = ref.sign(sk, m)
+        if i in bad:
+            sig = sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]
+        items.append((m, pk, sig))
+
+    monkeypatch.setenv("GRAFT_PRECOMP_TUPLE", "1")
+    got = ed.verify_batch(items)
+    assert ed.LAST_DISPATCH["mode"] == "precomp_tuple"
+    assert ed.LAST_DISPATCH["sharded"] is True
+
+    monkeypatch.delenv("GRAFT_PRECOMP_TUPLE")
+    want = ed.verify_batch(items)
+    assert ed.LAST_DISPATCH["mode"] == "precomp"
+    np.testing.assert_array_equal(got, want)
+    assert list(want) == [i not in bad for i in range(12)]
+
+
+@pytest.mark.tpu
 def test_verify_commits_coalesced_sharded_matches_host():
     """Same commits, sharded TPU path vs host path: identical verdicts
     (including the bad-signature job)."""
