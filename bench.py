@@ -508,8 +508,21 @@ def bench_replay(gen, parts, n_blocks: int) -> dict:
         crypto_batch.set_default_backend("cpu")
         replay(min(129, n_blocks), 128)  # warm stores/caches
         host_dt, pipe_stats = replay(n_blocks, 128)
-        seq_slice = min(300, n_blocks)
-        seq_dt = replay(seq_slice, 2)[0] * (n_blocks / seq_slice)
+        # honest baseline = the FULL corpus at window=2 (measured r5:
+        # a 300-block slice extrapolates to 139 s where the real full
+        # run is 169.5 s — late-chain costs grow, so slices flatter
+        # the baseline). BENCH_SEQ_FULL=0 restores the cheap slice
+        # (with its bias named) when the budget is tight.
+        if os.environ.get("BENCH_SEQ_FULL", "1") == "1":
+            seq_dt = replay(n_blocks, 2)[0]
+            seq_note = "full-length window=2 (per-block verify)"
+        else:
+            seq_slice = min(300, n_blocks)
+            seq_dt = replay(seq_slice, 2)[0] * (n_blocks / seq_slice)
+            seq_note = (
+                "300-block window=2 slice extrapolated — "
+                "UNDERSTATES late-chain costs by ~20% (r5 measurement)"
+            )
         return {
             "blocks": n_blocks,
             "validators": N_VALS,
@@ -517,7 +530,8 @@ def bench_replay(gen, parts, n_blocks: int) -> dict:
             "wall_s": round(host_dt, 2),
             "blocks_per_s": round(n_blocks / host_dt, 1),
             "sigs_per_s": round(n_sigs / host_dt, 1),
-            "sequential_wall_s_extrap": round(seq_dt, 2),
+            "sequential_wall_s": round(seq_dt, 2),
+            "sequential_note": seq_note,
             "vs_sequential": round(seq_dt / host_dt, 2),
             "pipeline": pipe_stats,
         }
@@ -881,13 +895,11 @@ def main() -> None:
     # Sweep (VERDICT r4 #1 prep): pallas sublanes {4, 8} + the
     # tuple-form precomp A input (docs/PERF.md lever #6), best rate
     # wins the headline, every leg recorded for the ablation table.
-    if (
-        "kernel" in todo
-        and _DEVICE_OK
-        and os.environ.get("GRAFT_PALLAS") != "1"
-        and os.environ.get("GRAFT_PRECOMP_TUPLE") != "1"
-        and os.environ.get("BENCH_SKIP_PALLAS") != "1"
-    ):
+    ambient_leg = (
+        os.environ.get("GRAFT_PALLAS") == "1"
+        or os.environ.get("GRAFT_PRECOMP_TUPLE") == "1"
+    )  # we ARE a child leg: never recurse into the sweep
+    if "kernel" in todo and _DEVICE_OK and not ambient_leg:
         leg_budget = int(
             os.environ.get("BENCH_PALLAS_BUDGET_S", "1200")
         )
@@ -895,16 +907,21 @@ def main() -> None:
             os.environ.get("BENCH_EXTRA_LEGS_BUDGET_S", "2700")
         )
         t_extra = time.time()
+        # per-leg gates record WHY a leg was skipped — the ablation
+        # table must never read as if a suppressed leg was unplanned
+        skip_pallas = os.environ.get("BENCH_SKIP_PALLAS") == "1"
         legs = [
             (
                 "kernel_pallas_s4",
                 {"GRAFT_PALLAS": "1", "GRAFT_PALLAS_SUBLANES": "4"},
                 "pallas VMEM ladder, 4 sublanes",
+                skip_pallas,
             ),
             (
                 "kernel_pallas_s8",
                 {"GRAFT_PALLAS": "1", "GRAFT_PALLAS_SUBLANES": "8"},
                 "pallas VMEM ladder, 8 sublanes",
+                skip_pallas,
             ),
             (
                 "kernel_precomp_tuple",
@@ -913,9 +930,16 @@ def main() -> None:
                     "GRAFT_PRECOMP_MAX_LANES": "1000000000",
                 },
                 "tuple-form precomp A at bulk width (lever #6)",
+                os.environ.get("BENCH_SKIP_PRECOMP_TUPLE") == "1",
             ),
         ]
-        for name, envx, what in legs:
+        for name, envx, what, gated_off in legs:
+            if gated_off:
+                configs[name] = {
+                    "rate": None,
+                    "note": f"leg gated off by env: {what}",
+                }
+                continue
             if time.time() - t_extra > extra_wall:
                 configs[name] = {
                     "rate": None,

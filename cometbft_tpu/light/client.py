@@ -203,17 +203,77 @@ class Client:
             got = self.store.get(height)
             if got is not None:
                 return got
-            target = self.primary.light_block(height)
+            target = self._primary_block(height)
             return self.verify_header(target, now_ns)
 
     def update(self, now_ns: Optional[int] = None) -> Optional[LightBlock]:
         """Verify the primary's latest header (reference Client.Update)."""
         with self._lock:
-            latest = self.primary.light_block(0)
+            latest = self._primary_block(0)
             trusted = self.store.latest()
             if trusted is not None and latest.height <= trusted.height:
                 return trusted
             return self.verify_header(latest, now_ns or time.time_ns())
+
+    # --- primary lifecycle ---------------------------------------------
+
+    def _primary_block(self, height: int) -> LightBlock:
+        """Fetch from the primary, REPLACING it with a responsive
+        witness when it fails (reference light/client.go:1000-1016 +
+        findNewPrimary :1045): the first witness that serves the
+        height is promoted (and leaves the witness rotation); the old
+        primary is appended to the BACK of the witness list, where the
+        ordinary witness lifecycle (strikes / invalid-conflict
+        removal / divergence evidence) judges it from then on — the
+        reference's remove-vs-demote split keys on its typed provider
+        errors, which our transports collapse into ProviderError, so
+        demote-and-let-the-detector-decide is the honest equivalent.
+
+        A not-found height is NOT unresponsiveness: a query for a
+        not-yet-produced height (the proxy serves user-chosen heights)
+        must surface to the caller — promoting/striking on it would
+        let an unauthenticated client burn the whole witness set by
+        polling a future height."""
+        from .provider import LightBlockNotFound
+
+        try:
+            return self.primary.light_block(height)
+        except LightBlockNotFound:
+            raise
+        except Exception:
+            pass
+        from ..utils.log import get_logger
+
+        log = get_logger("light")
+        bad = []
+        for i, w in enumerate(self.witnesses):
+            try:
+                lb = w.light_block(height)
+            except Exception:
+                if self.note_witness_failure(w):
+                    bad.append(i)
+                continue
+            old = self.primary
+            self.primary = w
+            log.error(
+                "primary unresponsive: promoted a witness",
+                height=height,
+                remaining_witnesses=len(self.witnesses) - 1,
+            )
+            # promoted witness leaves the rotation; the demoted
+            # primary joins its tail. Removal CANNOT empty the set
+            # here (the demotion refills it), so do it directly
+            # rather than through remove_witnesses' emptiness check.
+            self.witnesses.pop(i)
+            self.clear_witness_failures(w)
+            self.witnesses.append(old)
+            self.remove_witnesses(bad)
+            return lb
+        self.remove_witnesses(bad)
+        raise LightClientError(
+            f"primary unreachable and no witness could serve "
+            f"height {height} as a replacement"
+        )
 
     def verify_header(self, target: LightBlock, now_ns: int) -> LightBlock:
         existing = self.store.get(target.height)
@@ -250,7 +310,7 @@ class Client:
             nxt = (
                 target
                 if h == target.height
-                else self.primary.light_block(h)
+                else self._primary_block(h)
             )
             verifier.verify_adjacent(
                 self.chain_id,
@@ -312,7 +372,7 @@ class Client:
                     raise LightClientError(
                         "bisection cannot make progress"
                     )
-                pivots.append(self.primary.light_block(pivot_h))
+                pivots.append(self._primary_block(pivot_h))
 
     def _verify_backwards(
         self, trusted: LightBlock, target: LightBlock
@@ -341,7 +401,7 @@ class Client:
             lower = (
                 target
                 if lower_h == target.height
-                else self.primary.light_block(lower_h)
+                else self._primary_block(lower_h)
             )
             if lower.height != lower_h:
                 # also exact adjacency: lower_h == cur.height - 1 and

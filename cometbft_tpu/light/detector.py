@@ -34,6 +34,28 @@ class DivergenceError(Exception):
         self.evidence = evidence
 
 
+class ProposerPrioritiesDivergeError(Exception):
+    """Headers agree but the derived proposer priorities do not
+    (reference ErrProposerPrioritiesDiverge): priorities are NOT
+    committed in the header, so a lying side cannot be attributed —
+    the client halts and the operator picks whom to trust."""
+
+    def __init__(self, witness_idx: int):
+        super().__init__(
+            f"witness {witness_idx} reports identical header but "
+            "conflicting proposer priorities"
+        )
+        self.witness_idx = witness_idx
+
+
+def _priorities_diverge(a, b) -> bool:
+    """Same valset hash is guaranteed by the header match; compare the
+    per-validator priorities (address-keyed — ordering is canonical)."""
+    pa = {v.address: v.proposer_priority for v in a.validators}
+    pb = {v.address: v.proposer_priority for v in b.validators}
+    return pa != pb
+
+
 def check_against_witnesses(client, verified: LightBlock) -> None:
     bad: List[int] = []
     diverged = None  # (idx, evidence)
@@ -49,6 +71,27 @@ def check_against_witnesses(client, verified: LightBlock) -> None:
             continue
         client.clear_witness_failures(w)
         if wlb.hash() == verified.hash():
+            # addresses/powers ARE header-committed: a witness whose
+            # valset does not hash to the agreed header's
+            # validators_hash is provably lying — remove it (reference
+            # errBadWitness), never halt on it. Only a VALID valset
+            # with different priorities (the one field the header does
+            # not commit) is unattributable and halts.
+            if bytes(wlb.validator_set.hash()) != bytes(
+                wlb.header.validators_hash
+            ):
+                bad.append(i)
+            elif _priorities_diverge(
+                wlb.validator_set, verified.validator_set
+            ):
+                # clean up staged removals before halting — struck-out
+                # witnesses must not survive because a later witness
+                # halted the pass
+                try:
+                    client.remove_witnesses(bad)
+                except Exception:
+                    pass
+                raise ProposerPrioritiesDivergeError(i)
             continue
         # conflicting header: is the witness's block even SELF-valid?
         try:

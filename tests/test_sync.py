@@ -249,6 +249,133 @@ def test_dead_witness_pruned_during_verification(source_chain):
             lone.verify_light_block_at_height(h)
 
 
+def test_unresponsive_primary_replaced_by_witness(source_chain):
+    """Reference findNewPrimary (light/client.go:1000-1045): when the
+    primary stops serving blocks, the first responsive witness is
+    PROMOTED to primary (leaving the witness rotation) and the old
+    primary is demoted to the back of the witness list, where the
+    ordinary lifecycle judges it. With no promotable witness, the
+    client errors instead of spinning."""
+    from cometbft_tpu.light.client import LightClientError
+
+    gen, pvs, src = source_chain
+
+    class FlakyPrimary:
+        """Healthy until killed."""
+
+        def __init__(self, real):
+            self.real = real
+            self.dead = False
+
+        def light_block(self, height):
+            if self.dead:
+                raise ConnectionError("primary down")
+            return self.real.light_block(height)
+
+        def report_evidence(self, ev):
+            pass
+
+    real = StoreBackedProvider(
+        gen.chain_id, src.block_store, src.state_store
+    )
+    primary = FlakyPrimary(real)
+    witness = FlakyPrimary(real)
+    trusted = real.light_block(1)
+    client = Client(
+        gen.chain_id,
+        TrustOptions(period_ns=10**18, height=1, hash=trusted.hash()),
+        primary,
+        witnesses=[witness],
+    )
+    client.verify_light_block_at_height(5)
+    primary.dead = True
+    lb = client.verify_light_block_at_height(10)
+    assert lb.height == 10
+    assert client.primary is witness, "witness was not promoted"
+    # the demoted primary joined the rotation's tail
+    assert client.witnesses == [primary]
+
+    # the promoted primary dies too (its only witness, the demoted
+    # old primary, is already dead): error out, never spin
+    witness.dead = True
+    with pytest.raises(LightClientError, match="no witness could"):
+        client.verify_light_block_at_height(15)
+
+
+def test_proposer_priority_divergence_halts(source_chain):
+    """Same header, different proposer priorities: priorities are not
+    header-committed, so neither side can be proven wrong — the client
+    halts (reference ErrProposerPrioritiesDiverge)."""
+    import dataclasses
+
+    from cometbft_tpu.light.detector import (
+        ProposerPrioritiesDivergeError,
+    )
+
+    gen, pvs, src = source_chain
+    provider = StoreBackedProvider(
+        gen.chain_id, src.block_store, src.state_store
+    )
+
+    class SkewedWitness:
+        def __init__(self, real):
+            self.real = real
+
+        def light_block(self, height):
+            lb = self.real.light_block(height)
+            vs = lb.validator_set.copy()
+            vs.validators[0] = dataclasses.replace(
+                vs.validators[0],
+                proposer_priority=(
+                    vs.validators[0].proposer_priority + 99
+                ),
+            )
+            return dataclasses.replace(lb, validator_set=vs)
+
+        def report_evidence(self, ev):
+            pass
+
+    trusted = provider.light_block(1)
+    client = Client(
+        gen.chain_id,
+        TrustOptions(period_ns=10**18, height=1, hash=trusted.hash()),
+        provider,
+        witnesses=[SkewedWitness(provider)],
+    )
+    with pytest.raises(ProposerPrioritiesDivergeError):
+        client.verify_light_block_at_height(6)
+
+    # a witness agreeing on the header but serving a valset that does
+    # NOT hash to the header's validators_hash is provably lying:
+    # removed (errBadWitness), never a halt
+    class FabricatedValsetWitness:
+        def __init__(self, real):
+            self.real = real
+
+        def light_block(self, height):
+            lb = self.real.light_block(height)
+            vs = T.ValidatorSet(lb.validator_set.validators[:-1])
+            return dataclasses.replace(lb, validator_set=vs)
+
+        def report_evidence(self, ev):
+            pass
+
+    good = StoreBackedProvider(
+        gen.chain_id, src.block_store, src.state_store
+    )
+    liar = FabricatedValsetWitness(provider)
+    client2 = Client(
+        gen.chain_id,
+        TrustOptions(period_ns=10**18, height=1, hash=trusted.hash()),
+        provider,
+        witnesses=[good, liar],
+    )
+    lb = client2.verify_light_block_at_height(6)
+    assert lb.height == 6
+    assert liar not in client2.witnesses
+    assert good in client2.witnesses
+
+
 def test_invalid_conflict_witness_removed_without_halt(source_chain):
     """A witness serving a SELF-INVALID conflicting block (commit not
     for the header) is provably bad: removed immediately, no evidence,
