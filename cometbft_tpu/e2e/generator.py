@@ -40,8 +40,13 @@ def _perturb(rng: random.Random, spec: NodeSpec, target: int, is_val: bool):
             )
         )
     elif roll < 0.55 and is_val:
+        # 60/40 split: duplicate-vote equivocation vs a lunatic-fork
+        # light-client attack (both land as committed evidence + ABCI
+        # misbehavior; the runner crafts each from the real validator
+        # keys)
+        kind = "evidence" if rng.random() < 0.6 else "evidence_lca"
         spec.perturbations.append(
-            Perturbation("evidence", rng.randint(lo, hi))
+            Perturbation(kind, rng.randint(lo, hi))
         )
     elif roll < 0.65:
         # graceful binary-swap restart (reference testnet.go:62

@@ -31,7 +31,8 @@ from typing import Dict, List, Optional
 
 @dataclass
 class Perturbation:
-    kind: str  # "kill" | "pause" | "disconnect" | "evidence" | "upgrade"
+    kind: str  # "kill" | "pause" | "disconnect" | "evidence" |
+    #            "evidence_lca" | "upgrade"
     height: int
     pause_s: float = 3.0
     restart_delay_s: float = 2.0
@@ -134,6 +135,15 @@ class Manifest:
                 # test/e2e/runner/evidence.go:32)
                 spec.perturbations.append(
                     Perturbation("evidence", int(nd["evidence_at"]))
+                )
+            if nd.get("evidence_lca_at"):
+                # lunatic-fork LightClientAttackEvidence signed by a
+                # >1/3-power subset of the net's validator keys
+                # (runner._inject_lca_evidence)
+                spec.perturbations.append(
+                    Perturbation(
+                        "evidence_lca", int(nd["evidence_lca_at"])
+                    )
                 )
             m.nodes[name] = spec
         if not m.nodes:

@@ -298,7 +298,7 @@ class Runner:
                 t.cancel()
         self._check_agreement()
         if any(
-            p.kind == "evidence"
+            p.kind in ("evidence", "evidence_lca")
             for rn in self.nodes.values()
             for p in rn.spec.perturbations
         ):
@@ -557,20 +557,29 @@ class Runner:
                         f"{rn.spec.name} never reported upgraded "
                         f"version {pert.upgrade_version}"
                     )
-            elif pert.kind == "evidence":
-                # this node's validator key equivocates: craft
-                # DuplicateVoteEvidence and submit it through another
-                # node's broadcast_evidence RPC (reference
-                # test/e2e/runner/evidence.go:32). Retried: on a loaded
+            elif pert.kind in ("evidence", "evidence_lca"):
+                # byzantine-evidence injection through another node's
+                # broadcast_evidence RPC (reference
+                # test/e2e/runner/evidence.go:32): "evidence" = this
+                # node's key equivocates (DuplicateVoteEvidence);
+                # "evidence_lca" = a >1/3-power subset of the real
+                # validator keys signs a lunatic fork
+                # (LightClientAttackEvidence). Retried: on a loaded
                 # host an RPC can time out transiently.
-                print(f"[perturb] evidence from {rn.spec.name}", flush=True)
+                inject = (
+                    self._inject_lca_evidence
+                    if pert.kind == "evidence_lca"
+                    else self._inject_evidence
+                )
+                print(
+                    f"[perturb] {pert.kind} from {rn.spec.name}",
+                    flush=True,
+                )
                 last_err = None
                 try:
                     for attempt in range(10):
                         try:
-                            await asyncio.to_thread(
-                                self._inject_evidence, rn
-                            )
+                            await asyncio.to_thread(inject, rn)
                             self._evidence_injected = True
                             break
                         except Exception as e:
@@ -596,6 +605,127 @@ class Runner:
                         f"(last error: {last_err!r})"
                     )
                     raise
+
+    def _inject_lca_evidence(self, rn: RunnerNode) -> None:
+        """Craft a lunatic-fork LightClientAttackEvidence signed by a
+        >1/3-power subset of the net's real validator keys (the runner
+        owns every validator home) and submit it over another node's
+        broadcast_evidence RPC — the e2e twin of the in-process attack
+        in tests/test_byzantine.py. The receiving pool must re-derive
+        the byzantine set, verify both commits, and gossip it into a
+        block."""
+        import base64
+        import dataclasses
+        import time as _time
+
+        from ..evidence.types import LightClientAttackEvidence
+        from ..light.types import LightBlock
+        from ..privval.file_pv import FilePV
+        from ..utils import codec
+        from .. import types as T
+
+        target = next(
+            o for o in self.nodes.values() if o is not rn and o.started
+        )
+        h = self._height(target) - 1
+        if h < 2:
+            raise RuntimeError("chain too short for LCA evidence")
+        com = self._rpc(target, f"commit?height={h}")
+        header = codec.decode_header(
+            base64.b64decode(com["header_b64"])
+        )
+        vs = codec.decode_validator_set(
+            base64.b64decode(
+                self._rpc(target, f"validators?height={h}")[
+                    "validator_set_b64"
+                ]
+            )
+        )
+        common_vals = codec.decode_validator_set(
+            base64.b64decode(
+                self._rpc(target, f"validators?height={h - 1}")[
+                    "validator_set_b64"
+                ]
+            )
+        )
+        pv_by_addr = {}
+        for o in self.nodes.values():
+            keyfile = os.path.join(
+                o.home, "config", "priv_validator_key.json"
+            )
+            if o.spec.mode != "validator" or not os.path.exists(keyfile):
+                continue
+            pv = FilePV.load(
+                keyfile,
+                os.path.join(
+                    o.home, "data", "priv_validator_state.json"
+                ),
+            )
+            pv_by_addr[pv.pub_key().address()] = pv
+        total = common_vals.total_voting_power()
+        chosen, power = [], 0
+        for v in sorted(vs.validators, key=lambda x: -x.voting_power):
+            pv = pv_by_addr.get(v.address)
+            if pv is None:
+                continue
+            chosen.append((v, pv))
+            power += v.voting_power
+            if power * 3 > total:
+                break
+        if not power * 3 > total:
+            raise RuntimeError(
+                "not enough validator keys for >1/3 power"
+            )
+        fvs = T.ValidatorSet([v for v, _ in chosen])
+        forged = dataclasses.replace(
+            header,
+            app_hash=b"\x77" * 32,
+            validators_hash=fvs.hash(),
+            next_validators_hash=fvs.hash(),
+        )
+        fbid = T.BlockID(
+            forged.hash(), T.PartSetHeader(1, forged.hash())
+        )
+        now = _time.time_ns()
+        sigs = []
+        for v, pv in chosen:
+            vote = T.Vote(
+                type_=T.PRECOMMIT,
+                height=h,
+                round=0,
+                block_id=fbid,
+                timestamp_ns=now,
+                validator_address=v.address,
+                validator_index=0,
+            )
+            sigs.append(
+                T.CommitSig(
+                    block_id_flag=T.BLOCK_ID_FLAG_COMMIT,
+                    validator_address=v.address,
+                    timestamp_ns=now,
+                    signature=pv.priv_key.sign(
+                        vote.sign_bytes(self.m.chain_id)
+                    ),
+                )
+            )
+        lb = LightBlock(
+            header=forged,
+            commit=T.Commit(h, 0, fbid, sigs),
+            validator_set=fvs,
+        )
+        ev = LightClientAttackEvidence(
+            conflicting_block=lb,
+            common_height=h - 1,
+            total_voting_power=total,
+            timestamp_ns=now,
+        )
+        ev.byzantine_validators = ev.byzantine_from(common_vals)
+        self._rpc_post(
+            target,
+            "broadcast_evidence",
+            {"evidence": "0x" + ev.encode().hex()},
+            5.0,
+        )
 
     def _inject_evidence(self, rn: RunnerNode) -> None:
         import time as _time

@@ -90,6 +90,23 @@ class EvidencePool:
         if common_vals is None:
             raise EvidenceError("no validators at common height")
         lb = ev.conflicting_block
+        # the conflicting block must be INTERNALLY consistent —
+        # commit.block_id for the header, valset hashing to the
+        # header's validators_hash (reference evidence ValidateBasic →
+        # LightBlock.ValidateBasic, types/evidence.go:385). Without
+        # this, a GENUINE commit (real signatures over the real block)
+        # paired with a fabricated header would verify and slash the
+        # honest signers.
+        try:
+            lb.validate_basic(state.chain_id)
+        except ValueError as e:
+            raise EvidenceError(
+                f"invalid conflicting light block: {e}"
+            )
+        if ev.common_height > lb.height:
+            raise EvidenceError(
+                "common height is ahead of the conflicting block"
+            )
         # the "conflicting" block must actually CONFLICT with our
         # chain: accepting evidence whose block matches our own header
         # would let anyone submit the real chain as an "attack" and

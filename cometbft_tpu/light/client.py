@@ -240,8 +240,8 @@ class Client:
             return self.primary.light_block(height)
         except LightBlockNotFound:
             raise
-        except Exception:
-            pass
+        except Exception as e:
+            primary_err = e
         from ..utils.log import get_logger
 
         log = get_logger("light")
@@ -249,6 +249,12 @@ class Client:
         for i, w in enumerate(self.witnesses):
             try:
                 lb = w.light_block(height)
+            except LightBlockNotFound:
+                # the height does not exist anywhere reachable: this
+                # is the caller's future-height poll, not witness
+                # unresponsiveness — no strike (same carve-out as the
+                # primary path above)
+                raise
             except Exception:
                 if self.note_witness_failure(w):
                     bad.append(i)
@@ -258,6 +264,7 @@ class Client:
             log.error(
                 "primary unresponsive: promoted a witness",
                 height=height,
+                primary_error=repr(primary_err),
                 remaining_witnesses=len(self.witnesses) - 1,
             )
             # promoted witness leaves the rotation; the demoted
@@ -273,7 +280,7 @@ class Client:
         raise LightClientError(
             f"primary unreachable and no witness could serve "
             f"height {height} as a replacement"
-        )
+        ) from primary_err
 
     def verify_header(self, target: LightBlock, now_ns: int) -> LightBlock:
         existing = self.store.get(target.height)
