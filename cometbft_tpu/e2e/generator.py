@@ -97,6 +97,16 @@ def generate_one(seed: int) -> Manifest:
             else:
                 _perturb(rng, spec, target, is_val=False)
             m.nodes[spec.name] = spec
+        if rng.random() < 0.4:
+            # a LIGHT node: the verifying RPC proxy daemon, trust-
+            # rooted once the chain is a few blocks tall; the runner's
+            # status/agreement assertions then exercise the light-
+            # verified path end to end
+            m.nodes["light0"] = NodeSpec(
+                name="light0",
+                mode="light",
+                start_at=rng.randint(3, 5),
+            )
 
     return m
 

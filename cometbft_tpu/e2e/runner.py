@@ -94,6 +94,9 @@ class Runner:
         peers = ",".join(
             f"{rn.node_id}@127.0.0.1:{rn.p2p_port}"
             for rn in self.nodes.values()
+            # light nodes run only the proxy daemon — nothing ever
+            # listens on their p2p port
+            if rn.spec.mode != "light"
         )
         for name, rn in self.nodes.items():
             cfg = default_config(rn.home)
@@ -139,12 +142,20 @@ class Runner:
 
     # --- process control ----------------------------------------------
 
-    def _launch(self, rn: RunnerNode, extra_env=None) -> None:
+    def _launch(self, rn: RunnerNode, extra_env=None, argv=None) -> None:
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         if extra_env:
             env.update(extra_env)
+        if argv is None:
+            # light nodes launch via _launch_light (which builds the
+            # proxy argv with retries); they have no perturbations, so
+            # no other path reaches here for them
+            argv = [
+                sys.executable, "-m", "cometbft_tpu",
+                "--home", rn.home, "start",
+            ]
         rn.proc = subprocess.Popen(
-            [sys.executable, "-m", "cometbft_tpu", "--home", rn.home, "start"],
+            argv,
             cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
             env=env,
             stdout=open(os.path.join(rn.home, "node.log"), "a"),
@@ -153,12 +164,76 @@ class Runner:
         )
         rn.started = True
 
+    async def _launch_light(self, rn: RunnerNode) -> None:
+        """Launch a light-mode node: the verifying RPC proxy daemon
+        (reference e2e light-node dimension), trust-rooted at block 1
+        of a REACHABLE full node, witnesses wired to the other full
+        nodes, serving on the node's rpc_port — so every runner
+        assertion (status polling, agreement at the target height)
+        exercises the LIGHT-VERIFIED path for this node. Retried off
+        the event loop: the anchor candidates may be mid-perturbation
+        (killed/paused) when the start height arrives."""
+        last_err = None
+        for _ in range(10):
+            try:
+                argv = await asyncio.to_thread(self._light_argv, rn)
+                self._launch(rn, argv=argv)
+                return
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                last_err = e
+                await asyncio.sleep(2.0)
+        self.failures.append(
+            f"light node {rn.spec.name} never launched: {last_err!r}"
+        )
+
+    def _light_argv(self, rn: RunnerNode) -> list:
+        full = [
+            o
+            for o in self.nodes.values()
+            if o is not rn and o.started and o.spec.mode != "light"
+        ]
+        primary = None
+        trust = None
+        for cand in full:
+            try:
+                trust = self._rpc(cand, "block?height=1")
+                primary = cand
+                break
+            except Exception:
+                continue
+        if primary is None:
+            raise RuntimeError(
+                "no reachable full node to anchor the light node"
+            )
+        witnesses = [o for o in full if o is not primary][:2]
+        argv = [
+            sys.executable, "-m", "cometbft_tpu", "light",
+            self.m.chain_id,
+            "-p", f"127.0.0.1:{primary.rpc_port}",
+            "--trust-height", "1",
+            "--trust-hash", trust["block_id"]["hash"].lower(),
+            "--laddr", f"tcp://127.0.0.1:{rn.rpc_port}",
+            "--dir", os.path.join(rn.home, "light"),
+        ]
+        if witnesses:
+            argv += [
+                "-w",
+                ",".join(
+                    f"127.0.0.1:{o.rpc_port}" for o in witnesses
+                ),
+            ]
+        return argv
+
     def _peer_addrs(self, rn: RunnerNode) -> list:
         """Other nodes' id@host:port addresses (reconnect targets)."""
         return [
             f"{other.node_id}@127.0.0.1:{other.p2p_port}"
             for name, other in self.nodes.items()
-            if other is not rn and other.started
+            if other is not rn
+            and other.started
+            and other.spec.mode != "light"
         ]
 
     def _rpc(self, rn: RunnerNode, path: str, timeout: float = 3.0):
@@ -226,13 +301,23 @@ class Runner:
         late = [
             rn for rn in self.nodes.values() if rn.spec.start_at > 0
         ]
+        aux_tasks: List[asyncio.Task] = []
         try:
             while time.monotonic() < deadline:
                 h = await self._network_height()
                 for rn in late[:]:
                     if h >= rn.spec.start_at:
-                        await asyncio.to_thread(self._fill_trust, rn)
-                        self._launch(rn)
+                        if rn.spec.mode == "light":
+                            aux_tasks.append(
+                                asyncio.create_task(
+                                    self._launch_light(rn)
+                                )
+                            )
+                        else:
+                            await asyncio.to_thread(
+                                self._fill_trust, rn
+                            )
+                            self._launch(rn)
                         late.remove(rn)
                 if h >= self.m.target_height:
                     break
@@ -242,6 +327,11 @@ class Runner:
                     f"timed out below target height "
                     f"({self.network_height()}/{self.m.target_height})"
                 )
+            # light-node launches must FINISH before convergence is
+            # judged (a still-retrying launch would silently exclude
+            # the node from the all-nodes check)
+            if aux_tasks:
+                await asyncio.gather(*aux_tasks, return_exceptions=True)
             # wait for EVERY node (incl. late joiners) to converge —
             # pointless if the net never reached the target at all
             if not self.failures:
@@ -295,6 +385,8 @@ class Runner:
             if load_task:
                 load_task.cancel()
             for t in pert_tasks:
+                t.cancel()
+            for t in aux_tasks:
                 t.cancel()
         self._check_agreement()
         if any(
