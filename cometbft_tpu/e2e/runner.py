@@ -147,13 +147,16 @@ class Runner:
         if extra_env:
             env.update(extra_env)
         if argv is None:
-            # light nodes launch via _launch_light (which builds the
-            # proxy argv with retries); they have no perturbations, so
-            # no other path reaches here for them
-            argv = [
-                sys.executable, "-m", "cometbft_tpu",
-                "--home", rn.home, "start",
-            ]
+            if rn.spec.mode == "light":
+                # a perturbation restart (hand-written manifests may
+                # kill a light node) must relaunch the PROXY daemon,
+                # never a full node on the light node's port
+                argv = self._light_argv(rn)
+            else:
+                argv = [
+                    sys.executable, "-m", "cometbft_tpu",
+                    "--home", rn.home, "start",
+                ]
         rn.proc = subprocess.Popen(
             argv,
             cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),

@@ -229,41 +229,51 @@ class Client:
         errors, which our transports collapse into ProviderError, so
         demote-and-let-the-detector-decide is the honest equivalent.
 
-        A not-found height is NOT unresponsiveness: a query for a
-        not-yet-produced height (the proxy serves user-chosen heights)
-        must surface to the caller — promoting/striking on it would
-        let an unauthenticated client burn the whole witness set by
-        polling a future height."""
+        A primary NOT-FOUND still probes the witnesses (a pruned or
+        lagging primary is replaced by a witness that retains the
+        height — reference treats ErrLightBlockNotFound as a
+        findNewPrimary trigger) but WITHOUT striking them: a query for
+        a not-yet-produced height (the proxy serves user-chosen
+        heights) must surface to the caller, never burn the witness
+        set."""
         from .provider import LightBlockNotFound
 
         try:
             return self.primary.light_block(height)
-        except LightBlockNotFound:
-            raise
+        except LightBlockNotFound as e:
+            primary_err, primary_not_found = e, True
         except Exception as e:
-            primary_err = e
+            primary_err, primary_not_found = e, False
         from ..utils.log import get_logger
 
         log = get_logger("light")
         bad = []
+        not_found_err = primary_err if primary_not_found else None
         for i, w in enumerate(self.witnesses):
             try:
                 lb = w.light_block(height)
-            except LightBlockNotFound:
-                # the height does not exist anywhere reachable: this
-                # is the caller's future-height poll, not witness
-                # unresponsiveness — no strike (same carve-out as the
-                # primary path above)
-                raise
+            except LightBlockNotFound as e:
+                # this witness lacks the height too: no strike (it may
+                # be the caller's future-height poll), but keep
+                # probing — a LATER witness may retain it
+                not_found_err = not_found_err or e
+                continue
             except Exception:
-                if self.note_witness_failure(w):
+                if not primary_not_found and self.note_witness_failure(
+                    w
+                ):
                     bad.append(i)
                 continue
             old = self.primary
             self.primary = w
             log.error(
-                "primary unresponsive: promoted a witness",
+                "replacing primary with a witness",
                 height=height,
+                reason=(
+                    "primary pruned/lags the height"
+                    if primary_not_found
+                    else "primary unresponsive"
+                ),
                 primary_error=repr(primary_err),
                 remaining_witnesses=len(self.witnesses) - 1,
             )
@@ -277,6 +287,9 @@ class Client:
             self.remove_witnesses(bad)
             return lb
         self.remove_witnesses(bad)
+        if not_found_err is not None:
+            # not an outage: nobody reachable has the height
+            raise not_found_err
         raise LightClientError(
             f"primary unreachable and no witness could serve "
             f"height {height} as a replacement"

@@ -302,6 +302,72 @@ def test_unresponsive_primary_replaced_by_witness(source_chain):
         client.verify_light_block_at_height(15)
 
 
+def test_pruned_primary_promoted_and_notfound_never_strikes(
+    source_chain,
+):
+    """A primary that PRUNED the requested height (not-found, not an
+    outage) is replaced by a witness that retains it (reference treats
+    ErrLightBlockNotFound as a findNewPrimary trigger); a height NO
+    provider has surfaces as not-found and never strikes witnesses —
+    a future-height poll must not burn the witness set."""
+    from cometbft_tpu.light.provider import LightBlockNotFound
+
+    gen, pvs, src = source_chain
+    real = StoreBackedProvider(
+        gen.chain_id, src.block_store, src.state_store
+    )
+
+    class PrunedPrimary:
+        def light_block(self, height):
+            if 0 < height < 8:
+                raise LightBlockNotFound(f"height {height} pruned")
+            return real.light_block(height)
+
+        def report_evidence(self, ev):
+            pass
+
+    witness = StoreBackedProvider(
+        gen.chain_id, src.block_store, src.state_store
+    )
+    trusted = real.light_block(10)
+
+    pruned = PrunedPrimary()
+    # FIRST witness is pruned too: the probe must keep scanning and
+    # promote the later witness that retains the height
+    client = Client(
+        gen.chain_id,
+        TrustOptions(
+            period_ns=10**18, height=10, hash=trusted.hash()
+        ),
+        pruned,
+        witnesses=[PrunedPrimary(), witness],
+    )
+    lb = client.verify_light_block_at_height(5)  # backwards walk
+    assert lb.height == 5
+    assert client.primary is witness, "pruned primary not replaced"
+
+    # future-height poll: not-found surfaces, no strikes, set intact
+    class NotFoundEverywhere:
+        def light_block(self, height):
+            raise LightBlockNotFound("beyond tip")
+
+        def report_evidence(self, ev):
+            pass
+
+    client2 = Client(
+        gen.chain_id,
+        TrustOptions(
+            period_ns=10**18, height=10, hash=trusted.hash()
+        ),
+        real,
+        witnesses=[NotFoundEverywhere()],
+    )
+    for _ in range(5):
+        with pytest.raises(LightBlockNotFound):
+            client2.verify_light_block_at_height(10_000)
+    assert len(client2.witnesses) == 1, "witness burned by polls"
+
+
 def test_proposer_priority_divergence_halts(source_chain):
     """Same header, different proposer priorities: priorities are not
     header-committed, so neither side can be proven wrong — the client
