@@ -148,15 +148,18 @@ class Runner:
             env.update(extra_env)
         if argv is None:
             if rn.spec.mode == "light":
-                # a perturbation restart (hand-written manifests may
-                # kill a light node) must relaunch the PROXY daemon,
-                # never a full node on the light node's port
-                argv = self._light_argv(rn)
-            else:
-                argv = [
-                    sys.executable, "-m", "cometbft_tpu",
-                    "--home", rn.home, "start",
-                ]
+                # every light launch path (initial + perturbation
+                # restart) must go through _launch_light, which builds
+                # the proxy argv with retries off the event loop — a
+                # bare relaunch here would start a FULL node on the
+                # light node's port
+                raise RuntimeError(
+                    "light nodes launch via _launch_light"
+                )
+            argv = [
+                sys.executable, "-m", "cometbft_tpu",
+                "--home", rn.home, "start",
+            ]
         rn.proc = subprocess.Popen(
             argv,
             cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
@@ -586,7 +589,12 @@ class Runner:
                 rn.proc.wait()
                 await asyncio.sleep(pert.restart_delay_s)
                 print(f"[perturb] restart {rn.spec.name}", flush=True)
-                self._launch(rn)
+                if rn.spec.mode == "light":
+                    # retried off the event loop; anchors may be
+                    # mid-perturbation themselves
+                    await self._launch_light(rn)
+                else:
+                    self._launch(rn)
             elif pert.kind == "pause":
                 print(f"[perturb] SIGSTOP {rn.spec.name}", flush=True)
                 rn.proc.send_signal(signal.SIGSTOP)

@@ -248,15 +248,13 @@ class Client:
 
         log = get_logger("light")
         bad = []
-        not_found_err = primary_err if primary_not_found else None
         for i, w in enumerate(self.witnesses):
             try:
                 lb = w.light_block(height)
-            except LightBlockNotFound as e:
+            except LightBlockNotFound:
                 # this witness lacks the height too: no strike (it may
                 # be the caller's future-height poll), but keep
                 # probing — a LATER witness may retain it
-                not_found_err = not_found_err or e
                 continue
             except Exception:
                 if not primary_not_found and self.note_witness_failure(
@@ -287,9 +285,13 @@ class Client:
             self.remove_witnesses(bad)
             return lb
         self.remove_witnesses(bad)
-        if not_found_err is not None:
-            # not an outage: nobody reachable has the height
-            raise not_found_err
+        if primary_not_found:
+            # not an outage: the primary says the height doesn't
+            # exist and no witness could serve it either — surface
+            # the not-found (a witness's not-found must NOT mask a
+            # real primary outage, so only the primary's own
+            # classification picks this branch)
+            raise primary_err
         raise LightClientError(
             f"primary unreachable and no witness could serve "
             f"height {height} as a replacement"
