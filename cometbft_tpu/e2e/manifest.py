@@ -148,6 +148,17 @@ class Manifest:
             m.nodes[name] = spec
         if not m.nodes:
             raise ValueError("manifest has no nodes")
+        for n in m.nodes.values():
+            if n.mode == "light" and any(
+                p.kind != "kill" for p in n.perturbations
+            ):
+                # the light daemon has no p2p/mempool/consensus to
+                # pause/disconnect/upgrade/equivocate; only
+                # kill+relaunch is meaningful (runner._launch_light)
+                raise ValueError(
+                    f"light node {n.name} supports only 'kill' "
+                    "perturbations"
+                )
         if not any(
             n.mode == "validator" and n.start_at == 0
             for n in m.nodes.values()

@@ -184,12 +184,37 @@ class Runner:
             try:
                 argv = await asyncio.to_thread(self._light_argv, rn)
                 self._launch(rn, argv=argv)
-                return
             except asyncio.CancelledError:
                 raise
             except Exception as e:
                 last_err = e
                 await asyncio.sleep(2.0)
+                continue
+            # SUPERVISE startup: the daemon's own trust-root fetch can
+            # hit the anchor mid-perturbation and exit — a dead or
+            # never-serving daemon retries with a freshly-chosen
+            # anchor instead of silently failing convergence
+            for _ in range(30):
+                if rn.proc.poll() is not None:
+                    last_err = RuntimeError(
+                        "light daemon exited at startup "
+                        f"rc={rn.proc.returncode}"
+                    )
+                    break
+                h = await asyncio.to_thread(self._height, rn)
+                if h >= 0:
+                    return  # serving verified status
+                await asyncio.sleep(0.5)
+            else:
+                last_err = RuntimeError(
+                    "light daemon never served status"
+                )
+                try:
+                    rn.proc.terminate()
+                except ProcessLookupError:
+                    pass
+            rn.started = False
+            await asyncio.sleep(1.0)
         self.failures.append(
             f"light node {rn.spec.name} never launched: {last_err!r}"
         )
@@ -290,10 +315,18 @@ class Runner:
 
     async def run(self, timeout_s: float = 300.0) -> bool:
         deadline = time.monotonic() + timeout_s
-        # start genesis nodes
+        aux_tasks: List[asyncio.Task] = []
+        # start genesis nodes (a start_at=0 LIGHT node anchors itself
+        # once the chain reaches height 1 — the retrying launcher
+        # absorbs the wait)
         for rn in self.nodes.values():
             if rn.spec.start_at == 0:
-                self._launch(rn)
+                if rn.spec.mode == "light":
+                    aux_tasks.append(
+                        asyncio.create_task(self._launch_light(rn))
+                    )
+                else:
+                    self._launch(rn)
         load_task = (
             asyncio.create_task(self._load_routine())
             if self.m.load_tx_rate > 0
@@ -307,7 +340,6 @@ class Runner:
         late = [
             rn for rn in self.nodes.values() if rn.spec.start_at > 0
         ]
-        aux_tasks: List[asyncio.Task] = []
         try:
             while time.monotonic() < deadline:
                 h = await self._network_height()

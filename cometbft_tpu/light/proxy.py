@@ -128,8 +128,48 @@ class LightProxy:
             return await self._verified_tx(params)
         if method == "block_results":
             return await self._verified_block_results(h)
+        if method == "consensus_params":
+            return await self._verified_consensus_params(h)
         # passthrough (tx submission, unverifiable routes)
         return await self.primary.call(method, **params)
+
+    async def _verified_consensus_params(self, height: Optional[int]):
+        """Consensus params whose hash must equal the trusted
+        header's consensus_hash at that height (reference
+        light/rpc/client.go:229-256)."""
+        from ..state.state_types import ConsensusParams
+
+        params = {} if height is None else {"height": str(height)}
+        res = await self.primary.call("consensus_params", **params)
+        h = int(res.get("block_height") or 0)
+        if h <= 0:
+            raise RuntimeError(
+                "primary returned no height for consensus params"
+            )
+        if height is not None and h != height:
+            raise RuntimeError(
+                "primary answered for a different height than "
+                "requested"
+            )
+        cp = ConsensusParams.decode(
+            base64.b64decode(res.get("params_b64") or "")
+        )
+        lb = await self._verified_light_block(h)
+        if bytes(cp.hash()) != bytes(lb.header.consensus_hash):
+            raise RuntimeError(
+                "consensus params do not match the trusted header's "
+                "consensus hash"
+            )
+        # serve the dict REBUILT from the verified bytes: the
+        # primary's human-readable fields are what a wallet reads,
+        # and they must not be independently forgeable next to an
+        # honest params_b64
+        return {
+            "block_height": str(h),
+            "params_b64": res.get("params_b64"),
+            "consensus_params": cp.to_dict(),
+            "verified": True,
+        }
 
     async def _verified_block_results(self, height: Optional[int]):
         """Block results verified against the NEXT trusted header's

@@ -356,9 +356,14 @@ def dump_consensus_state(env) -> Dict[str, Any]:
 
 def consensus_params(env, height=None) -> Dict[str, Any]:
     h = _norm_height(env, height)
-    state = env.state_store.load()
-    cp = state.consensus_params
+    # per-HEIGHT params (reference env.ConsensusParams loads the
+    # params as of the requested height, not the tip): the light
+    # proxy verifies their hash against header(h).consensus_hash
+    cp = env.state_store.load_consensus_params(h)
+    if cp is None:
+        cp = env.state_store.load().consensus_params
     return {
+        "params_b64": enc.b64(cp.encode()),
         "block_height": str(h),
         "consensus_params": {
             "block": {

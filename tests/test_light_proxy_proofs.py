@@ -340,6 +340,19 @@ class _TamperingPrimary:
         if method == "block_results" and self.mode == "results":
             for tr in res.get("txs_results") or []:
                 tr["gas_used"] = str(int(tr.get("gas_used") or 0) + 7)
+        if method == "consensus_params" and self.mode == "params":
+            import base64
+
+            from cometbft_tpu.state.state_types import ConsensusParams
+
+            cp = ConsensusParams.decode(
+                base64.b64decode(res["params_b64"])
+            )
+            cp.block.max_bytes += 1  # forged limit
+            res["params_b64"] = base64.b64encode(cp.encode()).decode()
+        if method == "consensus_params" and self.mode == "params_dict":
+            # forge only the human-readable fields, keep bytes honest
+            res["consensus_params"]["block"]["max_bytes"] = "1"
         return res
 
     def __getattr__(self, name):
@@ -481,6 +494,27 @@ def test_proxy_verifies_queries_and_rejects_tampering():
         # 11. height-less block_results: serves latest-1, verified
         body = await get("/block_results")
         assert body["result"]["verified"] is True
+
+        # 12. verified consensus_params (hash vs the trusted header's
+        # consensus_hash, reference light/rpc/client.go:229-256)
+        body = await get(f"/consensus_params?height={tx_height}")
+        assert body["result"]["verified"] is True, body
+
+        # 13. forged params -> rejected
+        tamper.mode = "params"
+        body = await get(f"/consensus_params?height={tx_height}")
+        assert "error" in body and body["error"], body
+        tamper.mode = None
+
+        # 14. forged human-readable dict next to honest params_b64:
+        # the proxy serves the dict REBUILT from the verified bytes,
+        # so the forgery never reaches the caller
+        tamper.mode = "params_dict"
+        body = await get(f"/consensus_params?height={tx_height}")
+        r = body["result"]
+        assert r["verified"] is True
+        assert int(r["consensus_params"]["block"]["max_bytes"]) != 1
+        tamper.mode = None
 
         await proxy.stop()
         await n0.stop()

@@ -33,15 +33,26 @@ def test_pallas_block_divisor_fallback(monkeypatch):
     from cometbft_tpu.ops import pallas_ladder
 
     monkeypatch.setattr(pallas_ladder, "BLOCK_SUBLANES", 2)
-    # BLOCK_SUBLANES is read at TRACE time: a warm jit cache for this
-    # shape would silently reuse the default-block compilation and
-    # neutralize the regression coverage
+    # since round 5 the block height is a STATIC jit arg of
+    # _ladder_call, so the monkeypatched value keys its own cache
+    # entry — no clear_caches needed (kept as a cheap belt: the
+    # backend-key change is exactly what made this safe)
     jax.clear_caches()
     _ladder_equivalence(384)
 
 
 def test_pallas_ladder_matches_xla_ladder():
     _ladder_equivalence(128)
+
+
+def test_pallas_8_sublane_blocking_matches(monkeypatch):
+    """The bench sweep's s8 leg (GRAFT_PALLAS_SUBLANES=8) at a width
+    where 8-sublane blocking actually engages (1024 lanes = 8 rows =
+    one full block): bit-identical to the XLA ladder."""
+    from cometbft_tpu.ops import pallas_ladder
+
+    monkeypatch.setattr(pallas_ladder, "BLOCK_SUBLANES", 8)
+    _ladder_equivalence(1024)
 
 
 def test_in_process_backend_flip(monkeypatch):
