@@ -858,12 +858,13 @@ def main() -> None:
         parts.close_stores()
     if "batch64" in todo:
         configs["batch64"] = bench_batch64()
+    budget_skip = {
+        "skipped": f"host budget ({host_budget_s:.0f}s) "
+        "exhausted before this config"
+    }
     if "pipeline" in todo:
         if not budget_left():
-            configs["pipeline"] = {
-                "skipped": f"host budget ({host_budget_s:.0f}s) "
-                "exhausted before this config"
-            }
+            configs["pipeline"] = dict(budget_skip)
         elif _DEVICE_OK:
             configs["pipeline"] = bench_pipeline()
         else:
@@ -885,10 +886,7 @@ def main() -> None:
         if budget_left():
             configs["mixed"] = bench_mixed()
         else:
-            configs["mixed"] = {
-                "skipped": f"host budget ({host_budget_s:.0f}s) "
-                "exhausted before this config"
-            }
+            configs["mixed"] = dict(budget_skip)
     # the experimental kernel legs run LAST: each budgeted subprocess
     # may burn many minutes on a cold Mosaic compile, and the proven
     # configs above must be recorded before that risk is taken.

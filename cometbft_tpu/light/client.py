@@ -11,6 +11,7 @@ height bisection reverifies only new (validator, height) pairs.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from fractions import Fraction
@@ -21,6 +22,9 @@ from . import verifier
 from .provider import Provider, ProviderError
 from .store import LightStore
 from .types import LightBlock
+from ..utils.log import get_logger
+
+_log = get_logger("light")
 
 SEQUENTIAL = "sequential"
 SKIPPING = "skipping"
@@ -76,8 +80,6 @@ class Client:
         # runs them from multiple worker threads (background head
         # tracking + concurrent request handlers) against the one
         # unlocked LightStore
-        import threading
-
         self._lock = threading.RLock()
         self._init_trust()
 
@@ -103,15 +105,12 @@ class Client:
                 # to catch exactly that). An unreachable primary
                 # tolerates with a prominent warning (the daemon
                 # resumes from the store and re-dials).
-                from ..utils.log import get_logger
-
-                log = get_logger("light")
                 try:
                     fetched = self.primary.light_block(
                         self.trust.height
                     )
                 except Exception:
-                    log.error(
+                    _log.error(
                         "trust-root cross-check SKIPPED: primary "
                         "unreachable and persisted store does not "
                         "retain the trust height",
@@ -143,7 +142,7 @@ class Client:
                     OSError,
                     TimeoutError,
                 ):
-                    log.error(
+                    _log.error(
                         "trust-root cross-check SKIPPED: could not "
                         "anchor the primary's header to the stored "
                         "chain (provider error)",
@@ -244,9 +243,6 @@ class Client:
             primary_err, primary_not_found = e, True
         except Exception as e:
             primary_err, primary_not_found = e, False
-        from ..utils.log import get_logger
-
-        log = get_logger("light")
         bad = []
         for i, w in enumerate(self.witnesses):
             try:
@@ -264,7 +260,7 @@ class Client:
                 continue
             old = self.primary
             self.primary = w
-            log.error(
+            _log.error(
                 "replacing primary with a witness",
                 height=height,
                 reason=(
@@ -484,13 +480,10 @@ class Client:
         must be an explicit operator choice, never a silent decay."""
         if not indexes:
             return
-        from ..utils.log import get_logger
-
-        log = get_logger("light")
         for i in sorted(set(indexes), reverse=True):
             w = self.witnesses.pop(i)
             self._witness_strikes.pop(id(w), None)
-            log.error(
+            _log.error(
                 "removing witness from rotation",
                 witness=getattr(w, "name", repr(w)),
                 remaining=len(self.witnesses),

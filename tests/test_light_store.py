@@ -7,40 +7,18 @@ import os
 
 import pytest
 
-from cometbft_tpu import types as T
 from cometbft_tpu.light.client import LightClientError
-from cometbft_tpu.light import Client, TrustOptions
+from cometbft_tpu.light import Client, StoreBackedProvider, TrustOptions
 from cometbft_tpu.light.store import DBLightStore, LightStore
 from cometbft_tpu.node.inprocess import make_genesis
 from cometbft_tpu.utils.chaingen import make_chain
 from cometbft_tpu.utils.kv import open_kv
 
 
-class StoreBackedProvider:
-    """Provider over a generated chain's stores (test stand-in)."""
-
-    def __init__(self, node, chain_id):
-        self.node = node
-        self.chain_id = chain_id
-
-    def light_block(self, height: int):
-        from cometbft_tpu.light.types import LightBlock
-
-        bs = self.node.block_store
-        if height == 0:
-            height = bs.height() - 1
-        blk = bs.load_block(height)
-        commit = bs.load_seen_commit(height)
-        vs = self.node.state_store.load_validators(height)
-        return LightBlock(
-            header=blk.header, commit=commit, validator_set=vs
-        )
-
-
 def test_db_light_store_roundtrip_and_resume(tmp_path):
     gen, pvs = make_genesis(3, chain_id="light-db")
     src = make_chain(gen, [pv.priv_key for pv in pvs], 12)
-    provider = StoreBackedProvider(src, gen.chain_id)
+    provider = StoreBackedProvider(gen.chain_id, src.block_store, src.state_store)
     trust = src.block_store.load_block(1)
     path = str(tmp_path / "light.db")
 
@@ -132,7 +110,7 @@ def test_sparse_store_trust_check_anchors_to_chain(tmp_path):
 
     gen, pvs = make_genesis(3, chain_id="light-anchor")
     src = make_chain(gen, [pv.priv_key for pv in pvs], 12)
-    provider = StoreBackedProvider(src, gen.chain_id)
+    provider = StoreBackedProvider(gen.chain_id, src.block_store, src.state_store)
     trust = src.block_store.load_block(1)
 
     def sparse_client(primary, trust_hash):
