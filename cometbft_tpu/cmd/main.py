@@ -450,7 +450,7 @@ def cmd_inspect(args) -> int:
 def cmd_light(args) -> int:
     """Light client daemon: bisection-verify new headers from a
     primary against witnesses (reference cmd light + light/proxy)."""
-    from ..light import Client, TrustOptions
+    from ..light import SEQUENTIAL, SKIPPING, Client, TrustOptions
     from ..light.http_provider import HTTPProvider
 
     primary = HTTPProvider(args.chain_id, args.primary)
@@ -487,6 +487,9 @@ def cmd_light(args) -> int:
         primary=primary,
         witnesses=witnesses,
         store=store,
+        verification_mode=(
+            SEQUENTIAL if args.sequential else SKIPPING
+        ),
     )
     if args.laddr:
         # proxy mode (the reference command's primary role): serve
@@ -852,6 +855,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trust-hash", required=True)
     p.add_argument("--trust-period-h", type=float, default=168.0)
     p.add_argument("--interval-s", type=float, default=1.0)
+    p.add_argument(
+        "--sequential",
+        action="store_true",
+        help="verify every header in order instead of 9/16 skipping "
+        "bisection (reference cmd light --sequential)",
+    )
     p.add_argument(
         "--dir",
         default="",

@@ -178,6 +178,7 @@ def test_light_cli_proxy_mode():
                 "--trust-height", "1",
                 "--trust-hash", trust.hash().hex(),
                 "--laddr", f"tcp://127.0.0.1:{port}",
+                "--sequential",  # reference cmd light --sequential
             ],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
