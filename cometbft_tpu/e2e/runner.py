@@ -432,24 +432,40 @@ class Runner:
             for rn in self.nodes.values()
             for p in rn.spec.perturbations
         ):
-            self._check_evidence_committed()
+            # off-loop: the bounded wait inside must not stall
+            # cancelled tasks' cleanup
+            await asyncio.to_thread(self._check_evidence_committed)
         return not self.failures
 
     def _check_evidence_committed(self) -> None:
-        """An injected equivocation must end up inside a committed
-        block (reference e2e evidence assertion)."""
+        """Injected evidence must end up inside a committed block
+        (reference e2e evidence assertion). Bounded WAIT, not a
+        snapshot: a late injection (LCA retries until the chain is
+        tall enough) can leave the evidence pending at the target
+        height — consensus keeps producing blocks after the load
+        stops, so the next proposal from a pool-holding validator
+        commits it within a couple of heights."""
         if not getattr(self, "_evidence_injected", False):
             self.failures.append("evidence perturbation never injected")
             return
         rn = next(o for o in self.nodes.values() if o.started)
-        top = self._height(rn)
-        for h in range(1, top + 1):
-            try:
-                blk = self._rpc(rn, f"block?height={h}")
-            except Exception:
-                continue
-            if blk["block"]["evidence"]["evidence"]:
-                return
+        scanned = 0
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            top = self._height(rn)
+            ok_through = scanned
+            for h in range(scanned + 1, top + 1):
+                try:
+                    blk = self._rpc(rn, f"block?height={h}")
+                except Exception:
+                    # transient fetch failure: do NOT advance past h —
+                    # the next pass re-examines it
+                    break
+                if blk["block"]["evidence"]["evidence"]:
+                    return
+                ok_through = h
+            scanned = ok_through
+            time.sleep(1.0)
         self.failures.append("no committed block contains evidence")
 
     def _fill_trust(self, rn: RunnerNode) -> None:
