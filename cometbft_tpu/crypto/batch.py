@@ -161,7 +161,14 @@ class _PendingVerdicts:
     """In-flight device dispatch: host lanes already resolved in
     ``oks``; ``result()`` fills the ed25519 lanes from the device
     handle. Plain fields (not a closure) so the handle object holds
-    exactly what it needs."""
+    exactly what it needs.
+
+    The device wall for the calibration EWMA is observed by a
+    watcher thread blocking on device readiness (see verify_async),
+    NOT at result() time: a caller that overlaps long host work
+    before resolving would otherwise inflate the observed wall and
+    poison flat_s (the replay pipeline resolves a window's handle
+    ~1 s of apply-work after dispatch)."""
 
     __slots__ = ("_handle", "_ed_idx", "_oks")
 
@@ -297,9 +304,17 @@ class TpuBatchVerifier(BatchVerifier):
     def verify_async(self):
         """Enqueue the device dispatch WITHOUT blocking on verdicts.
         Host-routed lanes (small batches, non-ed25519 curves) are
-        verified eagerly — there is nothing to overlap for them. The
-        overlapped wall time is not a clean device observation, so the
-        async path does not feed the calibration EWMAs."""
+        verified eagerly — there is nothing to overlap for them.
+
+        A daemon watcher thread blocks on device READINESS and feeds
+        the true dispatch wall into the calibration EWMA. Without
+        this, the async seam — the one verify_commit_light actually
+        takes (types/validation.py) — never corrects the optimistic
+        flat-cost seed and small commits route to a ~120 ms tunnel
+        forever (BENCH_r05 first run: commit150 auto=device at 10x
+        the host wall). Observing at result() time instead would
+        over-state walls for callers that overlap host work (the
+        replay pipeline) and poison the estimate the other way."""
         ed_idx, ed_items, other_idx, use_device = self._route()
         oks = [False] * len(self.items)
         if not use_device:
@@ -307,7 +322,20 @@ class TpuBatchVerifier(BatchVerifier):
             return ResolvedVerdicts(all(oks) and bool(oks), oks)
         from ..ops import ed25519 as _ed
 
+        t0 = time.perf_counter()
         handle = _ed.verify_batch_async(ed_items)
+        n_ed = len(ed_items)
+
+        def _observe_ready():
+            try:
+                handle.wait()
+            except Exception:
+                return
+            calibration.observe_device(
+                n_ed, time.perf_counter() - t0
+            )
+
+        threading.Thread(target=_observe_ready, daemon=True).start()
         self._host_lanes(oks, ed_idx, other_idx, False)
         return _PendingVerdicts(handle, ed_idx, oks)
 

@@ -91,14 +91,17 @@ def _straus(ds, dh, A, shape):
     (docs/PERF.md "CPU-backend compile pathology").
 
     ds / dh: (64, N) int32 window digits, LSB-first."""
-    # backend precedence: an explicit GRAFT_PALLAS=1 opt-in wins (the
+    # backend precedence: GRAFT_PALLAS=1/0 forces pallas/XLA; unset =
+    # pallas by default on accelerator backends at bulk widths only
+    # (>= pallas_ladder.min_lanes(), the r5-measured win region — the
     # interpreter stands in off-TPU), else compact on the CPU backend,
     # else the tuple-form XLA ladder. Every branch condition here is
-    # part of _ladder_backend_key so a mid-process flip retraces.
+    # part of _ladder_backend_key so a mid-process flip retraces (the
+    # width itself re-keys via the per-shape jit trace).
     if len(shape) == 1 and shape[0] % 128 == 0:
         from .pallas_ladder import pallas_enabled, straus_pallas
 
-        if pallas_enabled():
+        if pallas_enabled(shape[0]):
             return straus_pallas(ds, dh, A, shape)
     if fe.compact_mode():
         return _straus_compact(ds, dh, A, shape)
@@ -293,13 +296,17 @@ def _ladder_backend_key() -> tuple:
     reusing a stale trace (VERDICT r4 weak #6 — the bench no longer
     needs a subprocess per backend for correctness, only for compile-
     hang isolation)."""
-    from .pallas_ladder import block_sublanes, pallas_enabled
+    from .pallas_ladder import block_sublanes, min_lanes, pallas_enabled
 
+    # pallas_enabled(None) here = "may pallas engage at SOME width";
+    # the actual per-width choice lives in _straus and re-keys via the
+    # per-shape jit trace, so min_lanes() must key the wrapper too
     pallas = pallas_enabled()
     return (
         "pallas" if pallas else "xla",
         fe.compact_mode(),
         block_sublanes() if pallas else 0,
+        min_lanes() if pallas else 0,
     )
 
 
@@ -482,6 +489,15 @@ class AsyncVerdicts:
         self._bad = bad
         self._n = n
 
+    def wait(self) -> "AsyncVerdicts":
+        """Block until the device computation is READY, without
+        fetching the verdicts to host (thread-safe; used by the
+        routing calibration's readiness watcher in crypto/batch)."""
+        bur = getattr(self._res, "block_until_ready", None)
+        if bur is not None:
+            bur()
+        return self
+
     def result(self) -> np.ndarray:
         out = np.array(self._res)[: self._n]
         out[self._bad[: self._n]] = False
@@ -542,6 +558,14 @@ def verify_batch_async(items) -> AsyncVerdicts:
         rs[:, i] = np.frombuffer(sig[:32], np.uint8)
         ss[:, i] = np.frombuffer(sig[32:], np.uint8)
 
+    # backend_key[0] reports the ladder the kernel ACTUALLY uses at
+    # this dispatch's per-device width (pallas engages by default only
+    # at bulk widths — pallas_ladder.min_lanes — and only on
+    # 128-multiple lanes), not merely whether pallas may engage
+    from .pallas_ladder import pallas_enabled as _pallas_on
+
+    lane_w = np_ // n_dev
+    eff_pallas = lane_w % 128 == 0 and _pallas_on(lane_w)
     LAST_DISPATCH.clear()
     LAST_DISPATCH.update(
         sharded=sharded is not None,
@@ -550,7 +574,8 @@ def verify_batch_async(items) -> AsyncVerdicts:
         cap=cap,
         precomp=use_precomp,
         mode=mode,
-        backend_key=_ladder_backend_key(),
+        backend_key=("pallas" if eff_pallas else "xla",)
+        + _ladder_backend_key()[1:],
     )
     if tuple_a:
         # pytree A: 80 separate (N,) arrays, preserving tuple-of-limbs

@@ -36,33 +36,89 @@ from jax.experimental.pallas import tpu as pltpu
 from . import curve25519 as curve
 from . import fe25519 as fe
 
-# lanes per grid step = block_sublanes() * 128. At 4 sublanes (512
-# lanes) the table slice is 2.6 MB — with Pallas's default
-# double-buffering of input/output blocks plus digit planes and the
-# working set that stays well inside the ~16 MB VMEM budget; 8
-# sublanes doubles table residency and may not (untested on silicon —
-# the platform was down all round 4), so the default is the safe one.
-# Bench-tunable via GRAFT_PALLAS_SUBLANES; tests may pin the module
-# attribute directly.
-BLOCK_SUBLANES = None  # None = read GRAFT_PALLAS_SUBLANES (default 4)
+# lanes per grid step = block_sublanes() * 128. Mosaic requires the
+# sublane (second-to-minor) block dim to be a multiple of 8 — or the
+# whole array dim — so 8 sublanes (1024 lanes) is the FLOOR at bulk
+# widths, not a tuning choice; the r5 first-contact sweep's 4-sublane
+# leg failed lowering on exactly that check
+# (jax/_src/pallas/mosaic/lowering.py:_check_block_mappings). At 8
+# sublanes the table slice is 5.2 MB; with Pallas's default
+# double-buffering plus digit planes the working set fits the ~16 MB
+# VMEM budget (compiles and runs on v5e silicon, r5). Bench-tunable
+# via GRAFT_PALLAS_SUBLANES; tests may pin the module attribute.
+BLOCK_SUBLANES = None  # None = read GRAFT_PALLAS_SUBLANES (default 8)
 
 
 def block_sublanes() -> int:
     if BLOCK_SUBLANES is not None:
         return BLOCK_SUBLANES
-    return int(os.environ.get("GRAFT_PALLAS_SUBLANES", "4"))
+    return int(os.environ.get("GRAFT_PALLAS_SUBLANES", "8"))
 
 
-def pallas_enabled() -> bool:
-    """Ladder backend selection: GRAFT_PALLAS=1 opts in; default off
-    until the Pallas path is driver-benchmarked faster (bench.py
-    measures both and records the ablation in docs/PERF.md). Read
-    dynamically AND safely flippable mid-process: the verify jit
-    wrappers are keyed by (ladder backend, field mode, sublanes) —
+def min_lanes() -> int:
+    """Width floor for the default-on pallas ladder (bulk widths
+    only). Measured on v5e silicon (r5 first contact, docs/PERF.md):
+    at 131072 lanes the VMEM ladder is 2.5x the XLA ladder (801k vs
+    320k verifies/s); at replay widths (<=32768 lanes) both are
+    dispatch/transfer-bound and indistinguishable in steady state,
+    while the Mosaic compile is ~10x costlier per lane bucket
+    (~7-9 min vs ~40 s) and the persistent compilation cache cannot
+    amortize it (nondeterministic program fingerprint, see PERF.md) —
+    so small widths stay on the XLA ladder by default."""
+    return int(os.environ.get("GRAFT_PALLAS_MIN_LANES", "65536"))
+
+
+@functools.lru_cache(maxsize=1)
+def _accelerator_backend() -> bool:
+    """Is the default jax backend a real accelerator? Memoized: the
+    backend identity cannot change once initialized in-process (the
+    env knobs that CAN flip mid-process are read dynamically and are
+    part of ops/ed25519._ladder_backend_key)."""
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:  # pragma: no cover - backend init failure
+        return False
+
+
+def pallas_enabled(n: "int | None" = None) -> bool:
+    """Ladder backend selection, r5-measured policy: GRAFT_PALLAS=1
+    forces pallas at every width (tests, A/B legs), GRAFT_PALLAS=0
+    forces the XLA ladder; otherwise pallas is the DEFAULT on
+    accelerator backends at bulk widths (n >= min_lanes(), where the
+    r5 silicon A/B measured 2.5x) and off elsewhere. Read dynamically
+    AND safely flippable mid-process: the verify jit wrappers are
+    keyed by (ladder backend, field mode, sublanes, min-lanes) —
     ops/ed25519._ladder_backend_key — so an env flip reaches the next
     verify_batch instead of silently hitting a stale cached trace
     (VERDICT r4 weak #6)."""
-    return os.environ.get("GRAFT_PALLAS") == "1"
+    v = os.environ.get("GRAFT_PALLAS")
+    if v == "1":
+        return True
+    if v == "0":
+        return False
+    if n is not None and n < min_lanes():
+        return False
+    return _accelerator_backend()
+
+
+def _tree_select16(digit, entries):
+    """16-way table lookup as a 4-level binary select tree.
+
+    Mosaic's select_n lowering only supports 2 cases
+    (jax/_src/pallas/mosaic/lowering.py:_select_n_lowering_rule — the
+    bench's first silicon contact failed exactly there), so the
+    window-digit lookup selects on one digit bit per level: entry
+    index d = b0 + 2*b1 + 4*b2 + 8*b3. Same function as
+    lax.select_n(digit, *entries); 15 two-way selects per limb."""
+    lvl = list(entries)
+    for k in range(4):
+        bit = lax.shift_right_logical(digit, k) & 1
+        pred = bit != 0
+        lvl = [
+            lax.select_n(pred, lvl[2 * i], lvl[2 * i + 1])
+            for i in range(len(lvl) // 2)
+        ]
+    return lvl[0]
 
 
 def _ladder_kernel(ds_ref, dh_ref, table_ref, out_ref):
@@ -90,8 +146,8 @@ def _ladder_kernel(ds_ref, dh_ref, table_ref, out_ref):
         )
         addend_a = tuple(
             tuple(
-                lax.select_n(
-                    d_h, *[table_ref[d, k, lj] for d in range(16)]
+                _tree_select16(
+                    d_h, [table_ref[d, k, lj] for d in range(16)]
                 )
                 for lj in range(fe.NLIMBS)
             )
@@ -100,9 +156,9 @@ def _ladder_kernel(ds_ref, dh_ref, table_ref, out_ref):
         q = curve.add_cached(q, addend_a)
         addend_b = tuple(
             tuple(
-                lax.select_n(
+                _tree_select16(
                     d_s,
-                    *[
+                    [
                         jnp.full(shape, int(bt[d, k, lj]), jnp.int32)
                         for d in range(16)
                     ],
@@ -119,24 +175,47 @@ def _ladder_kernel(ds_ref, dh_ref, table_ref, out_ref):
             out_ref[k, lj] = q[k][lj]
 
 
+def effective_block(block: int, r: int) -> "int | None":
+    """The sublane-block height the kernel will actually run for a
+    configured ``block`` over ``r`` sublane rows, or None when no
+    VMEM-safe Mosaic-valid blocking exists (caller falls back to the
+    XLA ladder).
+
+    Constraints (r5 silicon contact): the height must DIVIDE r (a
+    remainder block would silently drop rows — uninitialized verdict
+    lanes, code-review r4), and Mosaic requires it to be a multiple
+    of 8 OR the whole dim. The fallback never grows past
+    max(block, 8): the whole-dim escape at large odd r would build an
+    unbounded VMEM block (r=513 -> a ~333 MB table slice) — an
+    explicitly configured larger block is honored (the operator is
+    sweeping), but the automatic fallback stays at proven sizes."""
+    cap = max(block, 8)
+    best = None
+    for d in range(8, min(r, cap) + 1, 8):
+        if r % d == 0:
+            best = d
+    if best is not None:
+        return best
+    if r <= cap:
+        return r  # whole dim (== r) is Mosaic-valid and small
+    return None
+
+
 @functools.partial(
     jax.jit, static_argnames=("block", "interpret")
 )
-def _ladder_call(ds, dh, table, block=4, interpret=False):
+def _ladder_call(ds, dh, table, block=8, interpret=False):
     """ds/dh (64, R, 128) int32; table (16, 4, 20, R, 128) int32 ->
     (3, 20, R, 128) int32 (X, Y, Z tuple-of-limbs, carried).
 
-    ``block`` (the configured sublane-block height) is a STATIC arg:
-    it shapes the grid, so it must key this function's own jit cache —
-    a mid-process GRAFT_PALLAS_SUBLANES change then retraces instead
-    of silently reusing the old blocking."""
+    ``block`` is the EFFECTIVE sublane-block height (the caller runs
+    effective_block() first) and is a STATIC arg: it shapes the grid,
+    so it must key this function's own jit cache — a mid-process
+    GRAFT_PALLAS_SUBLANES change then retraces instead of silently
+    reusing the old blocking."""
     r = ds.shape[1]
-    # block height must DIVIDE the sublane-row count or the grid would
-    # silently drop the remainder rows (uninitialized verdict lanes):
-    # take the largest divisor of r that fits the configured block
-    s = min(block, r)
-    while r % s:
-        s -= 1
+    s = block
+    assert r % s == 0 and (s % 8 == 0 or s == r), (s, r)
     grid = (r // s,)
     return pl.pallas_call(
         _ladder_kernel,
@@ -181,10 +260,17 @@ def straus_pallas(ds, dh, A, shape, interpret=None):
     interpret=None auto-selects: the Pallas interpreter on the CPU
     backend (Mosaic needs real hardware), compiled Mosaic elsewhere —
     so the GRAFT_PALLAS backend flip is exercisable on any platform.
+
+    Returns None when no VMEM-safe blocking exists for this width
+    (effective_block) — the caller (ops/ed25519._straus) falls back
+    to the XLA ladder rather than building an unbounded VMEM block.
     """
     (n,) = shape
     assert n % 128 == 0, n
     r = n // 128
+    s = effective_block(block_sublanes(), r)
+    if s is None:
+        return None
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
 

@@ -123,3 +123,100 @@ def test_exploration_heals_poisoned_flat_cost():
     # device traffic resets the streak
     c.note_device_used()
     assert not c.should_explore()
+
+
+def test_async_seam_feeds_calibration(monkeypatch):
+    """BENCH_r05 first run: commit150's auto leg routed a 150-sig
+    commit to the device at 10x the host wall — the async seam (the
+    one verify_commit_light actually takes) never fed the EWMA, so
+    the optimistic flat-cost seed was never corrected. verify_async's
+    readiness watcher must observe the dispatch wall."""
+    import time
+
+    monkeypatch.setattr(crypto_batch, "calibration", _Calibration())
+    cal = crypto_batch.calibration
+
+    class FakeHandle:
+        def wait(self):
+            return self
+
+        def result(self):
+            return [True] * 150
+
+    from cometbft_tpu.ops import ed25519 as ed
+
+    monkeypatch.setattr(
+        ed, "verify_batch_async", lambda items: FakeHandle()
+    )
+    old = crypto_batch._default_backend
+    old_min = crypto_batch._MIN_TPU_BATCH
+    crypto_batch.set_default_backend("tpu")
+    crypto_batch.set_min_tpu_batch(1)  # force the device route
+    try:
+        v = crypto_batch.create_batch_verifier()
+        privs = [Ed25519PrivKey.generate() for _ in range(150)]
+        for i, p in enumerate(privs):
+            m = b"async|%d" % i
+            v.add(p.pub_key(), m, p.sign(m))
+        pending = v.verify_async()
+        ok, verdicts = pending.result()
+        assert ok and len(verdicts) == 150
+        # the watcher thread races result(); poll briefly
+        deadline = time.time() + 2.0
+        while cal.device_samples == 0 and time.time() < deadline:
+            time.sleep(0.005)
+        assert cal.device_samples == 1, (
+            "readiness watcher never fed the device EWMA"
+        )
+    finally:
+        crypto_batch.set_min_tpu_batch(old_min)
+        crypto_batch.set_default_backend(old)
+
+
+def test_result_time_overlap_does_not_poison_flat_cost(monkeypatch):
+    """The watcher observes READINESS, not result() latency: a caller
+    that sits on the handle for seconds of host work (the replay
+    pipeline) must not inflate the EWMA and flip bulk windows to
+    host."""
+    import time
+
+    monkeypatch.setattr(crypto_batch, "calibration", _Calibration())
+    cal = crypto_batch.calibration
+
+    class FakeHandle:
+        def wait(self):
+            return self  # device ready ~instantly
+
+        def result(self):
+            return [True] * 150
+
+    from cometbft_tpu.ops import ed25519 as ed
+
+    monkeypatch.setattr(
+        ed, "verify_batch_async", lambda items: FakeHandle()
+    )
+    old = crypto_batch._default_backend
+    old_min = crypto_batch._MIN_TPU_BATCH
+    crypto_batch.set_default_backend("tpu")
+    crypto_batch.set_min_tpu_batch(1)
+    try:
+        v = crypto_batch.create_batch_verifier()
+        privs = [Ed25519PrivKey.generate() for _ in range(150)]
+        for i, p in enumerate(privs):
+            m = b"late|%d" % i
+            v.add(p.pub_key(), m, p.sign(m))
+        pending = v.verify_async()
+        deadline = time.time() + 2.0
+        while cal.device_samples == 0 and time.time() < deadline:
+            time.sleep(0.005)
+        assert cal.device_samples == 1
+        flat_after_ready = cal.flat_s
+        time.sleep(0.2)  # caller overlaps host work before resolving
+        pending.result()
+        assert cal.device_samples == 1, "result() must not re-observe"
+        assert cal.flat_s == flat_after_ready, (
+            "overlapped resolution leaked into the EWMA"
+        )
+    finally:
+        crypto_batch.set_min_tpu_batch(old_min)
+        crypto_batch.set_default_backend(old)

@@ -24,10 +24,13 @@ pytestmark = pytest.mark.tpu
 
 def test_pallas_block_divisor_fallback(monkeypatch):
     """A configured block height that does not divide the sublane-row
-    count must fall back to the largest divisor — NOT silently drop
-    remainder rows (code-review r4 finding). N=384 (3 rows) with
-    blocks of 2 forces the fallback to 1-row blocks; verdict lanes
-    must still be bit-identical across the whole width."""
+    count must fall back to a valid divisor — NOT silently drop
+    remainder rows (code-review r4 finding) — and since the r5
+    silicon contact the chosen height must ALSO satisfy Mosaic's
+    sublane constraint (multiple of 8, or the whole dim). N=384
+    (3 rows) with blocks of 2: the largest divisor <= 2 is 1, which
+    Mosaic rejects, so the block grows to the whole dim (3 rows, one
+    grid step). Verdicts must stay bit-identical across the width."""
     import jax
 
     from cometbft_tpu.ops import pallas_ladder
@@ -39,6 +42,21 @@ def test_pallas_block_divisor_fallback(monkeypatch):
     # backend-key change is exactly what made this safe)
     jax.clear_caches()
     _ladder_equivalence(384)
+
+
+def test_pallas_divisor_fallback_respects_mosaic_floor(monkeypatch):
+    """The live fallback case on silicon: N=2048 (16 rows) with a
+    configured block of 12. 12 does not divide 16; the largest
+    divisor <= 12 is 8, which is also a multiple of 8 — so the
+    kernel runs a 2-step grid of 8-row blocks (no remainder rows
+    dropped, Mosaic constraint honored) and must be bit-identical."""
+    import jax
+
+    from cometbft_tpu.ops import pallas_ladder
+
+    monkeypatch.setattr(pallas_ladder, "BLOCK_SUBLANES", 12)
+    jax.clear_caches()
+    _ladder_equivalence(2048)
 
 
 def test_pallas_ladder_matches_xla_ladder():
@@ -60,7 +78,15 @@ def test_in_process_backend_flip(monkeypatch):
     the NEXT verify_batch — the verify jit cache is keyed by ladder
     backend, so this cannot silently reuse the pre-flip trace — and
     both backends must return bit-identical verdicts (including a
-    corrupted signature)."""
+    corrupted signature).
+
+    Since r5, LAST_DISPATCH's backend_key[0] reports the ladder the
+    kernel ACTUALLY used at the dispatch's per-device width (the
+    pallas kernel needs 128-multiple per-device lanes). Under the
+    conftest's 8-device virtual mesh the default 128-lane pad leaves
+    16 lanes/device — pallas genuinely cannot engage there — so pad
+    to 1024 lanes (128/device) to exercise the real flip."""
+    monkeypatch.setattr(ed, "PAD_MIN", 1024)
     items = []
     rng = np.random.default_rng(5)
     for _ in range(9):
