@@ -1,0 +1,19 @@
+"""bftlint: stdlib-ast static analysis for cometbft_tpu.
+
+Two rule families guard the two failure classes that silently kill
+BFT throughput: async-safety (a blocked or starved event loop stalls
+every reactor at once) and JAX hot-path hygiene (a host sync or
+recompile inside the Ed25519 verify path collapses batch throughput).
+See docs/LINT.md for the rule catalogue.
+
+Public API:
+    analyze_source(src, path) -> [Finding]   (unit-test entry point)
+    run(paths)               -> [Finding]    (filesystem walk)
+    main(argv)               -> exit code    (CLI)
+"""
+from .cli import main
+from .engine import analyze_source, run
+from .findings import Finding
+from .registry import all_rules
+
+__all__ = ["Finding", "all_rules", "analyze_source", "main", "run"]

@@ -1,0 +1,115 @@
+"""Shared AST helpers for bftlint rules (stdlib ``ast`` only)."""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of an Attribute/Subscript/Starred/Call chain."""
+    while True:
+        if isinstance(node, (ast.Attribute, ast.Starred)):
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Name):
+            return node.id
+        else:
+            return None
+
+
+def walk_in_function(node: ast.AST) -> Iterator[ast.AST]:
+    """Like ast.walk over a function body, but does not descend into
+    nested function/class definitions (their bodies run in a different
+    execution context)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if isinstance(
+            child,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+             ast.Lambda),
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def body_awaits(node: ast.AST) -> bool:
+    """True if executing this node can hit an await / async-for /
+    async-with in the *same* function (nested defs excluded)."""
+    return any(
+        isinstance(n, (ast.Await, ast.AsyncFor, ast.AsyncWith))
+        for n in walk_in_function(node)
+    )
+
+
+def functions_with_async_context(
+    tree: ast.Module,
+) -> Iterator[ast.AsyncFunctionDef]:
+    """Every async def in the module, at any nesting depth."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            yield node
+
+
+def _decorator_is_jit(dec: ast.AST) -> bool:
+    name = dotted(dec)
+    if name is not None:
+        return name == "jit" or name.endswith(".jit")
+    if isinstance(dec, ast.Call):
+        fname = dotted(dec.func)
+        if fname is None:
+            return False
+        if fname == "jit" or fname.endswith(".jit"):
+            return True  # @jax.jit(...) / @partial-free call form
+        if fname in ("partial", "functools.partial") and dec.args:
+            return _decorator_is_jit(dec.args[0])
+    return False
+
+
+def jitted_functions(tree: ast.Module) -> List[ast.FunctionDef]:
+    """Functions that run under jit: either decorated with (a partial
+    of) ``jit``, or later wrapped via ``jax.jit(fn)`` anywhere in the
+    module (the ``return jax.jit(core)`` factory idiom)."""
+    wrapped: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fname = dotted(node.func)
+            if fname and (fname == "jit" or fname.endswith(".jit")):
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Name):
+                        wrapped.add(arg.id)
+    out: List[ast.FunctionDef] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if node.name in wrapped or any(
+            _decorator_is_jit(d) for d in node.decorator_list
+        ):
+            out.append(node)
+    return out
+
+
+def param_names(fn: ast.FunctionDef) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)

@@ -1,0 +1,125 @@
+"""bftlint CLI.
+
+    python -m cometbft_tpu.analysis [paths...]
+
+Exit codes: 0 clean (baselined violations allowed), 1 new violations
+(or stale baseline under --fail-on-stale), 2 usage/internal error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from . import baseline as baseline_mod
+from .engine import REPO_ROOT, run
+from .registry import all_rules
+
+DEFAULT_BASELINE = REPO_ROOT / "tools" / "bftlint_baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m cometbft_tpu.analysis",
+        description="bftlint: async-safety + JAX hot-path static "
+        "analysis for cometbft_tpu",
+    )
+    p.add_argument(
+        "paths", nargs="*", default=["cometbft_tpu"],
+        help="files or directories to scan (default: cometbft_tpu)",
+    )
+    p.add_argument(
+        "--baseline", default=str(DEFAULT_BASELINE),
+        help="baseline file of pre-existing violations "
+        f"(default: {DEFAULT_BASELINE})",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every violation, ignoring the baseline",
+    )
+    p.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline to the current violation set",
+    )
+    p.add_argument(
+        "--fail-on-stale", action="store_true",
+        help="exit 1 when baseline entries no longer match anything",
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule table and exit",
+    )
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    out = sys.stdout
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.rule_id}  {r.name}\n    {r.doc}", file=out)
+        return 0
+
+    try:
+        findings = run(args.paths)
+    except FileNotFoundError as e:
+        print(f"bftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        entries = baseline_mod.build(findings)
+        Path(args.baseline).parent.mkdir(parents=True, exist_ok=True)
+        baseline_mod.save(args.baseline, entries)
+        n = sum(sum(r.values()) for r in entries.values())
+        print(
+            f"bftlint: baseline written to {args.baseline} "
+            f"({n} violations across {len(entries)} files)",
+            file=out,
+        )
+        return 0
+
+    stale: List[baseline_mod.StaleEntry] = []
+    if not args.no_baseline:
+        try:
+            bl = (
+                baseline_mod.load(args.baseline)
+                if Path(args.baseline).exists()
+                else {}
+            )
+        except (ValueError, OSError, json.JSONDecodeError) as e:
+            print(f"bftlint: bad baseline: {e}", file=sys.stderr)
+            return 2
+        findings, stale = baseline_mod.apply(findings, bl)
+
+    if args.format == "json":
+        json.dump(
+            {
+                "findings": [f.to_json() for f in findings],
+                "stale_baseline": [s._asdict() for s in stale],
+            },
+            out, indent=1,
+        )
+        out.write("\n")
+    else:
+        for f in findings:
+            print(f.render(), file=out)
+        for s in stale:
+            print(s.render(), file=out)
+        if findings:
+            print(
+                f"bftlint: {len(findings)} new violation(s)", file=out
+            )
+        else:
+            print("bftlint: clean", file=out)
+
+    if findings:
+        return 1
+    if stale and args.fail_on_stale:
+        return 1
+    return 0

@@ -1,0 +1,85 @@
+"""bftlint engine: walk paths, parse, run every rule, apply
+suppressions.  Pure stdlib — importing this package must never pull
+in jax (the linter runs in CI lanes with no accelerator deps)."""
+from __future__ import annotations
+
+import ast
+import os
+from pathlib import Path
+from typing import Iterable, List
+
+from .findings import Finding
+from .registry import FileContext, all_rules
+from .suppress import parse_suppressions
+
+# repo root = parents[2] of this file (analysis/ -> cometbft_tpu/ -> .)
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+_SKIP_DIRS = {"__pycache__", ".git", ".hypothesis", "node_modules"}
+
+
+def iter_py_files(paths: Iterable[str]) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        path = Path(p)
+        if not path.exists():
+            # a typo'd path must be a hard error, not a "clean" run
+            # (and never an accidental --update-baseline wipe)
+            raise FileNotFoundError(f"no such path: {p}")
+        if path.is_dir():
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in _SKIP_DIRS
+                )
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(Path(dirpath) / fn)
+        elif path.suffix == ".py":
+            out.append(path)
+    return out
+
+
+def rel_key(path: Path, root: Path = REPO_ROOT) -> str:
+    """Stable posix-style key for findings and baseline entries."""
+    try:
+        return path.resolve().relative_to(root).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def analyze_source(source: str, path: str) -> List[Finding]:
+    """Run every rule over one in-memory file (test entry point)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [
+            Finding(
+                path, e.lineno or 1, (e.offset or 1) - 1,
+                "SYN000", "syntax-error",
+                f"file does not parse: {e.msg}",
+            )
+        ]
+    sup = parse_suppressions(path, source)
+    ctx = FileContext(path, tree, source, source.splitlines())
+    findings: List[Finding] = list(sup.errors)
+    for r in all_rules():
+        for f in r.check(ctx):
+            if not sup.is_suppressed(f.line, f.rule_id):
+                findings.append(f)
+    return sorted(findings)
+
+
+def run(paths: Iterable[str], root: Path = REPO_ROOT) -> List[Finding]:
+    findings: List[Finding] = []
+    for file in iter_py_files(paths):
+        key = rel_key(file, root)
+        try:
+            source = file.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(
+                Finding(key, 1, 0, "SYN000", "syntax-error",
+                        f"unreadable: {e}")
+            )
+            continue
+        findings.extend(analyze_source(source, key))
+    return sorted(findings)

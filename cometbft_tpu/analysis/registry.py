@@ -1,0 +1,68 @@
+"""Rule registry.
+
+A rule is a callable ``check(ctx) -> Iterable[Finding]`` registered
+under a stable id (``ASY101``) and a human name
+(``blocking-call-in-async``).  Suppression comments and the baseline
+refer to rules by either spelling.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, Iterable, List, NamedTuple
+
+from .findings import Finding
+
+
+class FileContext(NamedTuple):
+    """Everything a rule gets to look at for one file."""
+
+    path: str  # relative posix path used in findings
+    tree: ast.Module
+    source: str
+    lines: List[str]
+
+
+class Rule(NamedTuple):
+    rule_id: str
+    name: str
+    doc: str
+    check: Callable[[FileContext], Iterable[Finding]]
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, name: str, doc: str):
+    """Decorator registering ``check`` under ``rule_id``/``name``."""
+
+    def deco(fn: Callable[[FileContext], Iterable[Finding]]):
+        if rule_id in _RULES or any(
+            r.name == name for r in _RULES.values()
+        ):
+            raise ValueError(f"duplicate rule {rule_id}/{name}")
+        _RULES[rule_id] = Rule(rule_id, name, fn.__doc__ or doc, fn)
+        return fn
+
+    return deco
+
+
+def all_rules() -> List[Rule]:
+    _load_builtin()
+    return [r for _, r in sorted(_RULES.items())]
+
+
+def resolve(spec: str) -> str | None:
+    """Map an id or name (as written in a suppression) to a rule id."""
+    _load_builtin()
+    spec = spec.strip()
+    if spec in _RULES:
+        return spec
+    for r in _RULES.values():
+        if r.name == spec:
+            return r.rule_id
+    return None
+
+
+def _load_builtin() -> None:
+    # Import for side effect of registration; idempotent.
+    from . import rules  # noqa: F401

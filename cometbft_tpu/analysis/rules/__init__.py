@@ -1,0 +1,2 @@
+"""Built-in bftlint rules; importing this package registers them."""
+from . import async_rules, jax_rules  # noqa: F401

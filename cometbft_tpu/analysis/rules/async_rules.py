@@ -1,0 +1,337 @@
+"""Async-safety rules (ASY1xx).
+
+These target the reactor/p2p/rpc layers: a single blocked event loop
+stalls every peer connection at once, and a swallowed CancelledError
+turns clean shutdown into a hang.  They are the Python analogue of
+the `go vet` + race-detector discipline upstream CometBFT relies on.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from ..astutil import body_awaits, dotted, walk_in_function
+from ..findings import Finding
+from ..registry import FileContext, rule
+
+# Call targets that block the calling thread.  Name-based: we cannot
+# type-infer, but these dotted spellings are unambiguous in practice.
+_BLOCKING_CALLS = {
+    "time.sleep": "time.sleep blocks the event loop; use "
+    "`await asyncio.sleep` or `asyncio.to_thread`",
+    "os.system": "os.system blocks; use asyncio.create_subprocess_*",
+    "os.wait": "os.wait blocks the loop",
+    "os.waitpid": "os.waitpid blocks the loop",
+    "subprocess.run": "subprocess.run blocks; use "
+    "asyncio.create_subprocess_exec",
+    "subprocess.call": "subprocess.call blocks the loop",
+    "subprocess.check_call": "subprocess.check_call blocks the loop",
+    "subprocess.check_output": "subprocess.check_output blocks the loop",
+    "urllib.request.urlopen": "sync HTTP inside async code; use an "
+    "async client or asyncio.to_thread",
+    "requests.get": "sync HTTP inside async code",
+    "requests.post": "sync HTTP inside async code",
+    "requests.put": "sync HTTP inside async code",
+    "requests.delete": "sync HTTP inside async code",
+    "requests.request": "sync HTTP inside async code",
+    "socket.create_connection": "sync connect inside async code; use "
+    "asyncio.open_connection",
+    "socket.getaddrinfo": "sync DNS resolution inside async code; use "
+    "loop.getaddrinfo",
+    "select.select": "select.select blocks the loop",
+}
+
+# asyncio coroutine functions whose bare call is always a lost await
+_ASYNCIO_COROS = {
+    "asyncio.sleep",
+    "asyncio.gather",
+    "asyncio.wait",
+    "asyncio.wait_for",
+    "asyncio.to_thread",
+    "asyncio.open_connection",
+    "asyncio.start_server",
+}
+
+_TASK_SPAWNERS = ("asyncio.create_task", "asyncio.ensure_future")
+
+
+def _async_defs(tree: ast.Module) -> Iterator[ast.AsyncFunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            yield node
+
+
+@rule(
+    "ASY101",
+    "blocking-call-in-async",
+    "blocking call (time.sleep, sync I/O, subprocess) directly inside "
+    "an async def starves the event loop",
+)
+def blocking_call_in_async(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _async_defs(ctx.tree):
+        for node in walk_in_function(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name in _BLOCKING_CALLS:
+                out.append(
+                    Finding(
+                        ctx.path, node.lineno, node.col_offset,
+                        "ASY101", "blocking-call-in-async",
+                        f"`{name}` inside `async def {fn.name}`: "
+                        + _BLOCKING_CALLS[name],
+                    )
+                )
+    return out
+
+
+@rule(
+    "ASY102",
+    "unawaited-coroutine",
+    "calling a coroutine function as a bare statement never runs it",
+)
+def unawaited_coroutine(ctx: FileContext) -> List[Finding]:
+    async_names = {fn.name for fn in _async_defs(ctx.tree)}
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Expr)
+            and isinstance(node.value, ast.Call)
+        ):
+            continue
+        call = node.value
+        name = dotted(call.func)
+        if name is None:
+            continue
+        hit = None
+        if name in _ASYNCIO_COROS:
+            hit = name
+        elif name in async_names:
+            hit = name
+        elif name.count(".") == 1 and name.split(".")[0] in (
+            "self", "cls"
+        ):
+            # exactly `self.x()` — a deeper chain (`self.pool.stop()`)
+            # targets another object whose `stop` we cannot see
+            attr = name.split(".")[1]
+            if attr in async_names:
+                hit = name
+        if hit is not None:
+            out.append(
+                Finding(
+                    ctx.path, node.lineno, node.col_offset,
+                    "ASY102", "unawaited-coroutine",
+                    f"`{hit}(...)` is a coroutine call whose result is "
+                    "discarded — it never runs; await it or wrap it in "
+                    "asyncio.create_task",
+                )
+            )
+    return out
+
+
+@rule(
+    "ASY103",
+    "dropped-task",
+    "asyncio.create_task result discarded: the task can be "
+    "garbage-collected mid-flight and its exceptions are lost",
+)
+def dropped_task(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Expr)
+            and isinstance(node.value, ast.Call)
+        ):
+            continue
+        name = dotted(node.value.func)
+        if name is None:
+            continue
+        if name in _TASK_SPAWNERS or name.endswith(".create_task"):
+            out.append(
+                Finding(
+                    ctx.path, node.lineno, node.col_offset,
+                    "ASY103", "dropped-task",
+                    f"result of `{name}` dropped: the event loop keeps "
+                    "only a weak reference — retain the task (registry "
+                    "or add_done_callback) so it cannot be GC'd "
+                    "mid-flight",
+                )
+            )
+    return out
+
+
+def _is_broad(handler_type: ast.AST | None) -> str | None:
+    """Return the offending spelling if the except clause is broad."""
+    if handler_type is None:
+        return "bare except"
+    name = dotted(handler_type)
+    if name in ("Exception", "BaseException", "builtins.Exception",
+                "builtins.BaseException"):
+        return f"except {name}"
+    if isinstance(handler_type, ast.Tuple):
+        for el in handler_type.elts:
+            broad = _is_broad(el)
+            if broad is not None:
+                return broad
+    return None
+
+
+def _mentions_cancelled(handler_type: ast.AST | None) -> bool:
+    if handler_type is None:
+        return False
+    if isinstance(handler_type, ast.Tuple):
+        return any(_mentions_cancelled(e) for e in handler_type.elts)
+    name = dotted(handler_type) or ""
+    return name.endswith("CancelledError")
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    return any(
+        isinstance(n, ast.Raise) for n in walk_in_function(handler)
+    )
+
+
+@rule(
+    "ASY104",
+    "broad-except-in-async",
+    "broad except around awaited code can swallow cancellation and "
+    "shutdown errors; catch narrowly or re-raise CancelledError first",
+)
+def broad_except_in_async(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _async_defs(ctx.tree):
+        for node in walk_in_function(fn):
+            if not isinstance(node, ast.Try):
+                continue
+            if not any(body_awaits(stmt) for stmt in node.body):
+                continue
+            cancelled_handled = False
+            for handler in node.handlers:
+                # A NARROW CancelledError handler means cancellation
+                # was explicitly considered (re-raise, or the
+                # sanctioned `except CancelledError: pass` after a
+                # self-cancel); a broad handler whose tuple merely
+                # names CancelledError still swallows it and stays
+                # flagged.
+                broad = _is_broad(handler.type)
+                if _mentions_cancelled(handler.type) and broad is None:
+                    cancelled_handled = True
+                if (
+                    broad is None
+                    or cancelled_handled
+                    or _reraises(handler)
+                ):
+                    continue
+                # bare / BaseException / a tuple naming CancelledError
+                # literally swallow cancellation; `except Exception`
+                # does NOT on py3.8+ (CancelledError is BaseException)
+                # but still hides every shutdown-adjacent error
+                swallows_cancel = broad != "except Exception" or (
+                    _mentions_cancelled(handler.type)
+                )
+                if swallows_cancel:
+                    why = (
+                        "swallows asyncio.CancelledError — shutdown "
+                        "hangs while this handler eats the cancel"
+                    )
+                else:
+                    why = (
+                        "hides every error indiscriminately (the task "
+                        "keeps running on state the failed await left "
+                        "behind); catch narrowly, or add `except "
+                        "asyncio.CancelledError: raise` above it to "
+                        "record cancellation intent"
+                    )
+                out.append(
+                    Finding(
+                        ctx.path, handler.lineno, handler.col_offset,
+                        "ASY104", "broad-except-in-async",
+                        f"{broad} around awaited code in `async def "
+                        f"{fn.name}` {why}",
+                    )
+                )
+    return out
+
+
+def _lockish(expr: ast.AST) -> str | None:
+    name = dotted(expr)
+    if name is None and isinstance(expr, ast.Call):
+        name = dotted(expr.func)
+    if name is None:
+        return None
+    low = name.lower()
+    # segment match, not substring: `block_store`/`unblock` must not
+    # read as locks in a blockchain codebase
+    segments = [s for part in low.split(".") for s in part.split("_")]
+    if (
+        "lock" in segments
+        or "rlock" in segments
+        or "mutex" in segments
+        or low.endswith(".acquire")
+    ):
+        return name
+    return None
+
+
+@rule(
+    "ASY105",
+    "sync-lock-across-await",
+    "a threading lock held across an await point deadlocks the loop "
+    "the moment a second task contends for it",
+)
+def sync_lock_across_await(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _async_defs(ctx.tree):
+        for node in walk_in_function(fn):
+            if not isinstance(node, ast.With):
+                continue
+            held = [
+                n
+                for item in node.items
+                if (n := _lockish(item.context_expr)) is not None
+            ]
+            if not held:
+                continue
+            if any(body_awaits(stmt) for stmt in node.body):
+                out.append(
+                    Finding(
+                        ctx.path, node.lineno, node.col_offset,
+                        "ASY105", "sync-lock-across-await",
+                        f"`with {held[0]}` spans an await in `async def "
+                        f"{fn.name}`: the loop thread parks inside the "
+                        "critical section — use asyncio.Lock with "
+                        "`async with`",
+                    )
+                )
+    return out
+
+
+@rule(
+    "ASY106",
+    "nested-event-loop",
+    "asyncio.run / run_until_complete inside an async def always "
+    "raises or deadlocks: a loop is already running on this thread",
+)
+def nested_event_loop(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _async_defs(ctx.tree):
+        for node in walk_in_function(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name is None:
+                continue
+            if name == "asyncio.run" or name.endswith(
+                ".run_until_complete"
+            ):
+                out.append(
+                    Finding(
+                        ctx.path, node.lineno, node.col_offset,
+                        "ASY106", "nested-event-loop",
+                        f"`{name}` inside `async def {fn.name}`: a "
+                        "loop is already running — await the coroutine "
+                        "directly",
+                    )
+                )
+    return out

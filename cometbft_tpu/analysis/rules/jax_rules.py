@@ -1,0 +1,218 @@
+"""JAX hot-path hygiene rules (JAX2xx).
+
+Scoped to code that runs under `jax.jit` (detected via decorator or
+the `return jax.jit(core)` factory idiom).  The failure class is
+silent: a stray `.item()` or per-call `jax.jit(...)` wrapper doesn't
+crash, it just turns a 60k-sig/s Ed25519 verify batch into a
+host-synced crawl (cf. arxiv 2302.00418 on EdDSA batch verification
+throughput in committee-based consensus).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..astutil import (
+    dotted,
+    jitted_functions,
+    param_names,
+    root_name,
+)
+from ..findings import Finding
+from ..registry import FileContext, rule
+
+_HOST_MATERIALIZERS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array", "jax.device_get",
+}
+
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+
+def _is_static_operand(node: ast.AST) -> bool:
+    """int(x.shape[0])-style casts touch static metadata, not traced
+    values — they are jit-safe."""
+    if isinstance(node, ast.Constant):
+        return True
+    return any(
+        isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS
+        for n in ast.walk(node)
+    )
+
+
+@rule(
+    "JAX201",
+    "host-sync-in-jit",
+    ".item()/float()/np.asarray on a traced value forces a device→host "
+    "sync (or a trace error) inside a jitted function",
+)
+def host_sync_in_jit(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in jitted_functions(ctx.tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = None
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item"
+                and not node.args
+            ):
+                msg = "`.item()` forces a device→host sync"
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and len(node.args) == 1
+                and not _is_static_operand(node.args[0])
+            ):
+                msg = (
+                    f"`{node.func.id}()` on a traced value syncs to "
+                    "host (or raises TracerConversionError)"
+                )
+            else:
+                name = dotted(node.func)
+                if name in _HOST_MATERIALIZERS:
+                    msg = f"`{name}` materializes on host"
+            if msg is not None:
+                out.append(
+                    Finding(
+                        ctx.path, node.lineno, node.col_offset,
+                        "JAX201", "host-sync-in-jit",
+                        f"{msg} inside jitted `{fn.name}` — keep the "
+                        "hot path on-device (jnp ops) and sync only at "
+                        "designated points",
+                    )
+                )
+    return out
+
+
+@rule(
+    "JAX202",
+    "stray-block-until-ready",
+    "block_until_ready outside a designated sync point serializes "
+    "dispatch against the device",
+)
+def stray_block_until_ready(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "block_until_ready"
+        ):
+            out.append(
+                Finding(
+                    ctx.path, node.lineno, node.col_offset,
+                    "JAX202", "stray-block-until-ready",
+                    "`.block_until_ready()` stalls the dispatch "
+                    "pipeline; restrict to designated sync points and "
+                    "mark those `# bftlint: disable=JAX202` with a "
+                    "justification",
+                )
+            )
+    return out
+
+
+_STATIC_ITERATORS = {"range", "reversed"}
+_WRAPPING_ITERATORS = {"enumerate", "zip"}
+
+
+@rule(
+    "JAX203",
+    "traced-loop",
+    "a Python for-loop over a traced array unrolls at trace time or "
+    "raises; use jax.lax.scan / fori_loop or vectorize",
+)
+def traced_loop(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in jitted_functions(ctx.tree):
+        params = param_names(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.For):
+                continue
+            it = node.iter
+            hit = None
+            if isinstance(it, ast.Name) and it.id in params:
+                hit = it.id
+            elif isinstance(it, ast.Call):
+                fname = dotted(it.func)
+                if fname in _STATIC_ITERATORS:
+                    continue
+                if fname in _WRAPPING_ITERATORS:
+                    for arg in it.args:
+                        if (
+                            isinstance(arg, ast.Name)
+                            and arg.id in params
+                        ):
+                            hit = arg.id
+                            break
+            if hit is not None:
+                out.append(
+                    Finding(
+                        ctx.path, node.lineno, node.col_offset,
+                        "JAX203", "traced-loop",
+                        f"Python loop over parameter `{hit}` of jitted "
+                        f"`{fn.name}`: unrolls per-element at trace "
+                        "time — use jax.lax.scan/fori_loop or jnp "
+                        "vector ops",
+                    )
+                )
+    return out
+
+
+@rule(
+    "JAX204",
+    "per-call-jit",
+    "jax.jit applied per call (immediately invoked or inside a loop) "
+    "defeats the compile cache and recompiles every time",
+)
+def per_call_jit(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    # jit-calls already reported by the wrap-and-invoke branch on
+    # their enclosing Call: skip them in the loop branch so
+    # `for ...: jax.jit(g)(x)` reports once, not twice
+    invoked: set = set()
+
+    def visit(node: ast.AST, loop_depth: int) -> None:
+        if isinstance(node, ast.Call):
+            fname = dotted(node.func)
+            if fname and (fname == "jit" or fname.endswith(".jit")):
+                why = None
+                if loop_depth > 0 and id(node) not in invoked:
+                    why = (
+                        "called inside a loop: each iteration builds a "
+                        "fresh wrapper with an empty compile cache"
+                    )
+                if why is not None:
+                    out.append(
+                        Finding(
+                            ctx.path, node.lineno, node.col_offset,
+                            "JAX204", "per-call-jit",
+                            f"`{fname}(...)` {why} — hoist the jitted "
+                            "callable out of the hot path",
+                        )
+                    )
+            # jax.jit(f)(x): the jit call is the func of an outer call
+            inner = node.func
+            if isinstance(inner, ast.Call):
+                iname = dotted(inner.func)
+                if iname and (
+                    iname == "jit" or iname.endswith(".jit")
+                ):
+                    invoked.add(id(inner))
+                    out.append(
+                        Finding(
+                            ctx.path, node.lineno, node.col_offset,
+                            "JAX204", "per-call-jit",
+                            f"`{iname}(f)(...)` wraps and invokes in "
+                            "one expression: the wrapper (and its "
+                            "compile cache) dies with the statement — "
+                            "bind the jitted callable once",
+                        )
+                    )
+        entering_loop = isinstance(node, (ast.For, ast.While))
+        for child in ast.iter_child_nodes(node):
+            visit(child, loop_depth + (1 if entering_loop else 0))
+
+    visit(ctx.tree, 0)
+    return out

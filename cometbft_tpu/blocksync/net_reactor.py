@@ -19,6 +19,7 @@ from typing import Callable, Dict, Optional
 from ..p2p.node_info import ChannelDescriptor
 from ..p2p.reactor import Reactor
 from ..utils import codec, proto
+from ..utils.tasks import spawn
 from .reactor import BlockSyncReactor
 
 BLOCKSYNC_CHANNEL = 0x40
@@ -187,11 +188,12 @@ class BlockSyncNetReactor(Reactor):
             ec = self.block_store.load_extended_commit(height)
             if ec:
                 payload += proto.field_bytes(2, ec)
-            asyncio.ensure_future(
+            spawn(
                 peer.send(
                     BLOCKSYNC_CHANNEL,
                     bytes([MSG_BLOCK_RESPONSE]) + payload,
-                )
+                ),
+                name="blocksync-block-response",
             )
         elif mtype == MSG_BLOCK_RESPONSE:
             m = proto.parse(body)

@@ -100,8 +100,11 @@ class BlockSyncReactor:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            except asyncio.CancelledError:
+                if not self._task.cancelled():
+                    raise  # outer cancel of stop() itself: propagate
+            except Exception:
+                traceback.print_exc()
 
     # --- the verify/apply loop ----------------------------------------
 

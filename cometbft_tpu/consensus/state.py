@@ -27,6 +27,7 @@ from ..state.state_types import State
 from ..types import events as ev
 from ..utils import codec
 from ..utils.fail import fail_point
+from ..utils.tasks import spawn
 from ..utils.log import Lazy, get_logger
 from . import wal as walmod
 from .types import HeightVoteSet, RoundState, Step
@@ -131,8 +132,11 @@ class ConsensusState:
             self._routine_task.cancel()
             try:
                 await self._routine_task
-            except (asyncio.CancelledError, Exception):
-                pass
+            except asyncio.CancelledError:
+                if not self._routine_task.cancelled():
+                    raise  # outer cancel of stop() itself: propagate
+            except Exception:
+                traceback.print_exc()
         if self._timeout_task:
             self._timeout_task.cancel()
         if self.wal:
@@ -642,7 +646,7 @@ class ConsensusState:
                     return  # propose timeout moves the round along
                 self.enqueue_nowait("signed_proposal", (prop, parts), "")
 
-            asyncio.create_task(sign_off_loop())
+            spawn(sign_off_loop(), name="privval-sign-off")
             return
         try:
             self.privval.sign_proposal(self.state.chain_id, prop)
@@ -994,7 +998,7 @@ class ConsensusState:
                     return
                 self.enqueue_nowait("signed_vote", VoteMessage(vote), "")
 
-            asyncio.create_task(sign_off_loop())
+            spawn(sign_off_loop(), name="privval-sign-off")
             return
         try:
             self.privval.sign_vote(self.state.chain_id, vote)
@@ -1083,7 +1087,7 @@ class ConsensusState:
             except asyncio.QueueFull:
                 pass
 
-        asyncio.create_task(retry())
+        spawn(retry(), name="sign-retry")
 
     def _handle_sign_retry(self, payload) -> None:
         type_, block_hash, psh, height, round_ = payload

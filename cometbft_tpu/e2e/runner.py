@@ -465,6 +465,9 @@ class Runner:
                     return
                 ok_through = h
             scanned = ok_through
+            # sync-only call path: this method runs in a worker thread
+            # via asyncio.to_thread (see the caller) — a blocking
+            # sleep here parks the worker, not the event loop
             time.sleep(1.0)
         self.failures.append("no committed block contains evidence")
 
@@ -556,6 +559,9 @@ class Runner:
                             f"benchmark: blockchain RPC failed: {e!r}"
                         )
                         return
+                    # sync-only call path: _benchmark_intervals runs
+                    # in a worker thread via asyncio.to_thread — this
+                    # retry backoff never touches the event loop
                     time.sleep(0.2)
             metas = res.get("block_metas") or []
             if not metas:

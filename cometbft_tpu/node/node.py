@@ -24,6 +24,7 @@ from ..mempool.reactor import MempoolReactor
 from ..p2p import MemoryTransport, NodeInfo, NodeKey, Switch, TCPTransport
 from ..types.genesis import GenesisDoc
 from ..utils.log import get_logger
+from ..utils.tasks import spawn
 from .inprocess import NodeParts, build_node
 
 _log = get_logger("node")
@@ -239,10 +240,10 @@ class Node:
             self.statesync_error = e
             traceback.print_exc()
             _log.error("statesync failed, stopping node", err=repr(e))
-            asyncio.ensure_future(self.stop())
+            spawn(self.stop(), name="node-stop")
 
     def _on_caught_up(self, state) -> None:
-        asyncio.ensure_future(self._switch_to_consensus(state))
+        spawn(self._switch_to_consensus(state), name="switch-to-consensus")
 
     async def _switch_to_consensus(self, state) -> None:
         _log.info(

@@ -133,8 +133,11 @@ class MConnection:
         for t in self._tasks:
             try:
                 await t
-            except (asyncio.CancelledError, Exception):
-                pass
+            except asyncio.CancelledError:
+                if not t.cancelled():
+                    raise  # outer cancel of stop() itself: propagate
+            except Exception:
+                pass  # routine already reported via _die
         self.sconn.close()
 
     def _die(self, exc: Exception) -> None:

@@ -12,6 +12,8 @@ target. Seed mode answers one request then disconnects the peer
 from __future__ import annotations
 
 import asyncio
+
+from ..utils.tasks import spawn
 import json
 import os
 import random
@@ -248,9 +250,7 @@ class PexReactor(Reactor):
             if self.seed_mode:
                 # seeds serve addresses then hang up (reference
                 # pex_reactor.go:~seed mode)
-                asyncio.ensure_future(
-                    self.switch.stop_peer_gracefully(peer)
-                )
+                spawn(self.switch.stop_peer_gracefully(peer))
         elif mtype == MSG_PEX_RESPONSE:
             if peer.peer_id not in self._requested:
                 # unsolicited response is a protocol violation
@@ -298,8 +298,10 @@ class PexReactor(Reactor):
                     try:
                         await sw.dial_peer(addr)
                         self.book.mark_good(pid, addr)
+                    except asyncio.CancelledError:
+                        raise
                     except Exception:
-                        pass
+                        pass  # crawl dials fail routinely
                 self.book.save()
         except asyncio.CancelledError:
             raise

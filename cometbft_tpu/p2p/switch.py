@@ -19,6 +19,7 @@ import traceback
 from typing import Dict, List, Optional
 
 from ..utils.log import get_logger
+from ..utils.tasks import spawn
 from .node_info import ChannelDescriptor, NodeInfo
 from .peer import Peer
 from .reactor import Reactor
@@ -100,6 +101,8 @@ class Switch:
         for r in self.reactors.values():
             try:
                 await r.stop()
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 traceback.print_exc()
         for p in list(self.peers.values()):
@@ -167,8 +170,10 @@ class Switch:
     async def _dial_ignore_err(self, addr: str, persistent: bool):
         try:
             await self.dial_peer(addr, persistent=persistent)
+        except asyncio.CancelledError:
+            raise
         except Exception:
-            pass
+            pass  # dial errors are expected; reconnect logic retries
 
     # --- peer management ----------------------------------------------
 
@@ -251,7 +256,7 @@ class Switch:
         self.stop_peer_for_error(peer, exc)
 
     def stop_peer_for_error(self, peer: Peer, exc: Optional[Exception]):
-        asyncio.ensure_future(self._remove_peer(peer, exc, reconnect=True))
+        spawn(self._remove_peer(peer, exc, reconnect=True))
 
     async def stop_peer_gracefully(self, peer: Peer):
         await self._remove_peer(peer, None, reconnect=False)
@@ -280,7 +285,7 @@ class Switch:
         self.banned.add(peer_id)
         p = self.peers.get(peer_id)
         if p:
-            asyncio.ensure_future(self._remove_peer(p, None))
+            spawn(self._remove_peer(p, None))
 
     def _schedule_reconnect(self, peer_id: str) -> None:
         if peer_id in self._reconnect_tasks or self._stopped:
@@ -299,6 +304,8 @@ class Switch:
                     try:
                         await self.dial_peer(addr, peer_id)
                         return
+                    except asyncio.CancelledError:
+                        raise
                     except Exception:
                         delay = min(delay * 2, RECONNECT_MAX_S)
             finally:

@@ -107,6 +107,9 @@ class TCPTransport:
             sconn, their_info = await upgrade(
                 reader, writer, self.node_key, self.node_info
             )
+        except asyncio.CancelledError:
+            writer.close()
+            raise
         except Exception:
             try:
                 writer.close()
@@ -195,6 +198,9 @@ class MemoryTransport:
                 await hub.accept_queue.put(
                     (sconn, info, f"mem://{self.node_key.node_id}")
                 )
+            except asyncio.CancelledError:
+                w2.close()
+                raise
             except Exception:
                 try:
                     w2.close()

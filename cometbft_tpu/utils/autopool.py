@@ -51,8 +51,11 @@ class AutoPool:
         for w in self._workers:
             try:
                 await w
-            except (asyncio.CancelledError, Exception):
-                pass
+            except asyncio.CancelledError:
+                if not w.cancelled():
+                    raise  # outer cancel of stop() itself: propagate
+            except Exception:
+                pass  # worker exceptions already logged in _worker
         self._workers.clear()
 
     # --- submission ---------------------------------------------------

@@ -1,0 +1,533 @@
+"""bftlint (cometbft_tpu.analysis) tier-1 gate + unit fixtures.
+
+Three layers:
+  1. per-rule positive/negative fixtures (pure-ast, no jax import);
+  2. the suppression / baseline / CLI machinery contracts;
+  3. the repo gate: the full pass over cometbft_tpu/ must be clean
+     against the checked-in baseline, and tools/lint.sh must pass —
+     this is what ratchets every future PR.
+"""
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from cometbft_tpu.analysis import analyze_source
+from cometbft_tpu.analysis import baseline as baseline_mod
+from cometbft_tpu.analysis.cli import main
+from cometbft_tpu.analysis.findings import Finding
+from cometbft_tpu.analysis.registry import all_rules, resolve
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def ids_of(src: str):
+    return sorted(
+        {f.rule_id for f in analyze_source(textwrap.dedent(src), "x.py")}
+    )
+
+
+# --- 1. rule fixtures -------------------------------------------------
+#
+# (rule_id, positive fixture that MUST flag, negative fixture that
+# MUST stay clean for that rule)
+
+FIXTURES = [
+    (
+        "ASY101",  # blocking-call-in-async
+        """
+        import time
+        async def f():
+            time.sleep(1.0)
+        """,
+        """
+        import asyncio, time
+        async def f():
+            await asyncio.sleep(1.0)
+            await asyncio.to_thread(time.sleep, 1.0)
+        def g():
+            time.sleep(1.0)  # sync context: fine
+        """,
+    ),
+    (
+        "ASY102",  # unawaited-coroutine
+        """
+        import asyncio
+        async def f():
+            asyncio.sleep(1.0)
+        """,
+        """
+        import asyncio
+        async def f():
+            await asyncio.sleep(1.0)
+            t = asyncio.sleep(1.0)
+            await t
+        """,
+    ),
+    (
+        "ASY102",  # unawaited self-method coroutine
+        """
+        class R:
+            async def pump(self):
+                pass
+            async def run(self):
+                self.pump()
+        """,
+        """
+        class R:
+            async def pump(self):
+                pass
+            async def run(self):
+                await self.pump()
+                # chained receiver: target object unknown, not flagged
+                self.pool.pump()
+        """,
+    ),
+    (
+        "ASY103",  # dropped-task
+        """
+        import asyncio
+        async def f(coro):
+            asyncio.create_task(coro)
+        """,
+        """
+        import asyncio
+        from cometbft_tpu.utils.tasks import spawn
+        async def f(coro):
+            t = asyncio.create_task(coro)
+            spawn(coro)
+            return t
+        """,
+    ),
+    (
+        "ASY104",  # broad-except-in-async: bare except over await
+        """
+        async def f(x):
+            try:
+                await x()
+            except Exception:
+                pass
+        """,
+        """
+        import asyncio
+        async def f(x):
+            try:
+                await x()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+        """,
+    ),
+    (
+        "ASY104",  # tuple spelling still swallows CancelledError
+        """
+        import asyncio
+        async def f(t):
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        """,
+        """
+        async def f(x):
+            try:
+                y = x + 1   # no await in try body: not our concern
+            except Exception:
+                y = 0
+            return y
+        """,
+    ),
+    (
+        "ASY105",  # sync-lock-across-await
+        """
+        import asyncio
+        async def f(self):
+            with self._lock:
+                await asyncio.sleep(0)
+        """,
+        """
+        import asyncio
+        async def f(self):
+            async with self._lock:
+                await asyncio.sleep(0)
+            with self._lock:
+                self.n += 1   # no await while held: fine
+        """,
+    ),
+    (
+        "ASY106",  # nested-event-loop
+        """
+        import asyncio
+        async def f(coro):
+            asyncio.run(coro)
+        """,
+        """
+        import asyncio
+        def cli(coro):
+            asyncio.run(coro)   # sync entry point: fine
+        """,
+    ),
+    (
+        "JAX201",  # host-sync-in-jit
+        """
+        import jax
+        @jax.jit
+        def f(x):
+            return x.sum().item()
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            n = int(x.shape[0])   # static metadata: fine
+            return jnp.sum(x) + n
+        def host(x):
+            return x.sum().item()  # not jitted: fine
+        """,
+    ),
+    (
+        "JAX201",  # the `return jax.jit(core)` factory idiom is seen
+        """
+        import jax, numpy as np
+        def make():
+            def core(x):
+                return np.asarray(x)
+            return jax.jit(core)
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+        def make():
+            def core(x):
+                return jnp.asarray(x)   # device-side: fine
+            return jax.jit(core)
+        """,
+    ),
+    (
+        "JAX202",  # stray-block-until-ready
+        """
+        def f(res):
+            res.block_until_ready()
+        """,
+        """
+        def f(res):
+            return res
+        """,
+    ),
+    (
+        "JAX203",  # traced-loop
+        """
+        import jax
+        @jax.jit
+        def f(x):
+            s = 0.0
+            for v in x:
+                s = s + v
+            return s
+        """,
+        """
+        import jax
+        @jax.jit
+        def f(x, n):
+            s = 0.0
+            for i in range(4):      # static trip count: fine
+                s = s + x[i]
+            for j, w in enumerate((1, 2)):   # static pytree: fine
+                s = s + w
+            return s
+        """,
+    ),
+    (
+        "JAX204",  # per-call-jit
+        """
+        import jax
+        def f(xs, g):
+            out = []
+            for x in xs:
+                out.append(jax.jit(g)(x))
+            return out
+        """,
+        """
+        import jax
+        def make(g):
+            return jax.jit(g)   # bound once by the caller: fine
+        """,
+    ),
+    (
+        "SYN000",  # syntax errors are findings, not crashes
+        """
+        def f(:
+        """,
+        """
+        def f():
+            return 1
+        """,
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "rule_id,bad,good",
+    FIXTURES,
+    ids=[f"{r}-{i}" for i, (r, _, _) in enumerate(FIXTURES)],
+)
+def test_rule_fixture(rule_id, bad, good):
+    assert rule_id in ids_of(bad), f"{rule_id} missed its positive"
+    assert rule_id not in ids_of(good), (
+        f"{rule_id} false-positived on its negative"
+    )
+
+
+def test_at_least_eight_distinct_rules_have_fixtures():
+    covered = {r for r, _, _ in FIXTURES if r != "SYN000"}
+    assert len(covered) >= 8, covered
+
+
+def test_every_registered_rule_has_a_fixture():
+    registered = {r.rule_id for r in all_rules()}
+    covered = {r for r, _, _ in FIXTURES}
+    assert registered <= covered, registered - covered
+
+
+# --- 2a. suppression machinery ---------------------------------------
+
+TWO_RULES_ONE_LINE = """
+import time
+async def f(loop):
+    loop.run_until_complete(time.sleep(1)){}
+"""
+
+
+def test_disable_silences_only_named_rule_on_that_line():
+    # the line triggers BOTH ASY101 (time.sleep in async) and ASY106
+    # (run_until_complete in async)
+    base = ids_of(TWO_RULES_ONE_LINE.format(""))
+    assert {"ASY101", "ASY106"} <= set(base)
+    got = ids_of(
+        TWO_RULES_ONE_LINE.format("  # bftlint: disable=ASY106")
+    )
+    assert "ASY106" not in got and "ASY101" in got
+
+
+def test_disable_does_not_leak_to_other_lines():
+    src = """
+    import time
+    async def f():
+        time.sleep(1)  # bftlint: disable=ASY101
+        time.sleep(2)
+    """
+    found = analyze_source(textwrap.dedent(src), "x.py")
+    lines = [f.line for f in found if f.rule_id == "ASY101"]
+    assert lines == [5]
+
+
+def test_disable_by_rule_name_and_disable_next():
+    src = """
+    import time
+    async def f():
+        # bftlint: disable-next=blocking-call-in-async
+        time.sleep(1)
+    """
+    assert "ASY101" not in ids_of(src)
+
+
+def test_disable_file_silences_whole_file_one_rule_only():
+    src = """
+    # bftlint: disable-file=ASY101
+    import asyncio, time
+    async def f():
+        time.sleep(1)
+        asyncio.sleep(2)
+    """
+    got = ids_of(src)
+    assert "ASY101" not in got and "ASY102" in got
+
+
+def test_unknown_suppression_is_reported():
+    src = """
+    def f():
+        return 1  # bftlint: disable=NOPE999
+    """
+    assert "SUP001" in ids_of(src)
+
+
+def test_resolve_accepts_id_and_name():
+    assert resolve("ASY101") == "ASY101"
+    assert resolve("blocking-call-in-async") == "ASY101"
+    assert resolve("nope") is None
+
+
+# --- 2b. baseline machinery ------------------------------------------
+
+
+def _f(path, line, rule="ASY104"):
+    return Finding(path, line, 0, rule, "broad-except-in-async", "m")
+
+
+def test_baseline_roundtrip(tmp_path):
+    entries = baseline_mod.build([_f("a.py", 1), _f("a.py", 9)])
+    p = tmp_path / "b.json"
+    baseline_mod.save(str(p), entries)
+    assert baseline_mod.load(str(p)) == {"a.py": {"ASY104": 2}}
+
+
+def test_baseline_exact_count_is_clean_and_over_is_new():
+    bl = {"a.py": {"ASY104": 2}}
+    new, stale = baseline_mod.apply([_f("a.py", 1), _f("a.py", 9)], bl)
+    assert new == [] and stale == []
+    new, stale = baseline_mod.apply(
+        [_f("a.py", 1), _f("a.py", 9), _f("a.py", 30)], bl
+    )
+    assert len(new) == 3  # count exceeded: all reported (can't tell
+    assert stale == []    # old from new by line)
+
+
+def test_stale_baseline_entries_are_reported():
+    bl = {"a.py": {"ASY104": 2}, "gone.py": {"ASY101": 1}}
+    new, stale = baseline_mod.apply([_f("a.py", 1)], bl)
+    assert new == []
+    got = {(s.path, s.rule_id, s.allowed, s.current) for s in stale}
+    assert got == {("a.py", "ASY104", 2, 1), ("gone.py", "ASY101", 1, 0)}
+
+
+# --- 2c. CLI exit-code contract --------------------------------------
+
+CLEAN = "def f():\n    return 1\n"
+DIRTY = "import time\nasync def f():\n    time.sleep(1)\n"
+
+
+def test_cli_exit_zero_on_clean(tmp_path, capsys):
+    p = tmp_path / "ok.py"
+    p.write_text(CLEAN)
+    assert main([str(p), "--no-baseline"]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_exit_one_on_violation(tmp_path, capsys):
+    p = tmp_path / "bad.py"
+    p.write_text(DIRTY)
+    assert main([str(p), "--no-baseline"]) == 1
+    assert "ASY101" in capsys.readouterr().out
+
+
+def test_cli_baseline_covers_violation(tmp_path, capsys):
+    p = tmp_path / "bad.py"
+    p.write_text(DIRTY)
+    bl = tmp_path / "bl.json"
+    assert main([str(p), "--baseline", str(bl),
+                 "--update-baseline"]) == 0
+    capsys.readouterr()
+    assert main([str(p), "--baseline", str(bl)]) == 0
+
+
+def test_cli_stale_reported_and_fail_on_stale(tmp_path, capsys):
+    p = tmp_path / "ok.py"
+    p.write_text(CLEAN)
+    bl = tmp_path / "bl.json"
+    baseline_mod.save(str(bl), {"nothere.py": {"ASY101": 1}})
+    assert main([str(p), "--baseline", str(bl)]) == 0
+    assert "stale baseline" in capsys.readouterr().out
+    assert main([str(p), "--baseline", str(bl),
+                 "--fail-on-stale"]) == 1
+
+
+def test_cli_json_format(tmp_path, capsys):
+    p = tmp_path / "bad.py"
+    p.write_text(DIRTY)
+    assert main([str(p), "--no-baseline", "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["findings"][0]["rule_id"] == "ASY101"
+
+
+def test_cli_syntax_error_fails(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    assert main([str(p), "--no-baseline"]) == 1
+
+
+def test_cli_nonexistent_path_is_usage_error(tmp_path, capsys):
+    """A typo'd path must not report 'clean' (exit 0) — and must
+    never reach --update-baseline, which would wipe the baseline."""
+    ghost = str(tmp_path / "no_such_dir")
+    assert main([ghost, "--no-baseline"]) == 2
+    bl = tmp_path / "bl.json"
+    baseline_mod.save(str(bl), {"a.py": {"ASY104": 1}})
+    assert main([ghost, "--baseline", str(bl),
+                 "--update-baseline"]) == 2
+    assert baseline_mod.load(str(bl)) == {"a.py": {"ASY104": 1}}
+
+
+def test_lockish_does_not_match_block_identifiers():
+    """'lock' must be a name segment, not a substring: block_store /
+    unblock are not locks (regression: blockchain codebase!)."""
+    src = """
+    import asyncio
+    async def f(self):
+        with self.block_writer():
+            await asyncio.sleep(0)
+    """
+    assert "ASY105" not in ids_of(src)
+    src2 = """
+    import asyncio
+    async def f(self):
+        with self.state_lock:
+            await asyncio.sleep(0)
+    """
+    assert "ASY105" in ids_of(src2)
+
+
+def test_jit_wrap_invoke_in_loop_reports_once():
+    src = """
+    import jax
+    def f(xs, g):
+        for x in xs:
+            y = jax.jit(g)(x)
+        return y
+    """
+    found = [
+        f for f in analyze_source(textwrap.dedent(src), "x.py")
+        if f.rule_id == "JAX204"
+    ]
+    assert len(found) == 1, found
+
+
+# --- 3. the repo gate -------------------------------------------------
+
+
+def test_full_tree_is_clean_against_checked_in_baseline(capsys):
+    """Every future PR runs this: the shipped tree must lint clean
+    (new violations either fixed or explicitly baselined)."""
+    rc = main([str(REPO_ROOT / "cometbft_tpu")])
+    out = capsys.readouterr().out
+    assert rc == 0, f"bftlint regressions:\n{out}"
+
+
+def test_seeded_violation_fixture_fails_the_gate(tmp_path):
+    """End-to-end: a fresh violation exits non-zero via the real CLI."""
+    bad = tmp_path / "seeded.py"
+    bad.write_text(
+        "import asyncio, time\n"
+        "async def reactor():\n"
+        "    time.sleep(0.5)\n"
+        "    asyncio.create_task(reactor())\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "cometbft_tpu.analysis", str(bad)],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "ASY101" in proc.stdout and "ASY103" in proc.stdout
+
+
+def test_lint_sh_entry_point():
+    """tools/lint.sh = compileall syntax gate + the analysis pass."""
+    proc = subprocess.run(
+        ["bash", str(REPO_ROOT / "tools" / "lint.sh")],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

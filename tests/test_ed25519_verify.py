@@ -15,7 +15,7 @@ from cometbft_tpu.ops import sha512 as dsha
 
 import pytest
 
-pytestmark = pytest.mark.tpu  # compiles the full kernel; see pytest.ini
+pytestmark = [pytest.mark.tpu, pytest.mark.slow]  # tpu implies slow: keeps the `-m 'not slow'` fast lane kernel-free
 
 rng = random.Random(7)
 

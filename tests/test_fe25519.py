@@ -13,7 +13,7 @@ from cometbft_tpu.ops import fe25519 as fe
 
 import pytest
 
-pytestmark = pytest.mark.tpu  # compiles the full kernel; see pytest.ini
+pytestmark = [pytest.mark.tpu, pytest.mark.slow]  # tpu implies slow: keeps the `-m 'not slow'` fast lane kernel-free
 
 P = fe.P
 rng = random.Random(1234)

@@ -19,7 +19,7 @@ from cometbft_tpu.ops import fe25519 as fe
 from cometbft_tpu.ops import sc25519 as sc
 from cometbft_tpu.ops.pallas_ladder import straus_pallas
 
-pytestmark = pytest.mark.tpu
+pytestmark = [pytest.mark.tpu, pytest.mark.slow]  # tpu implies slow: keeps the `-m 'not slow'` fast lane kernel-free
 
 
 def test_pallas_block_divisor_fallback(monkeypatch):

@@ -16,7 +16,7 @@ from cometbft_tpu.ops import sc25519 as sc
 
 import pytest
 
-pytestmark = pytest.mark.tpu  # compiles the full kernel; see pytest.ini
+pytestmark = [pytest.mark.tpu, pytest.mark.slow]  # tpu implies slow: keeps the `-m 'not slow'` fast lane kernel-free
 
 rng = random.Random(99)
 L, P = sc.L, fe.P

@@ -60,6 +60,7 @@ def test_verify_batch_shards_over_all_devices():
 
 
 @pytest.mark.tpu
+@pytest.mark.slow
 def test_plain_kernel_branch_at_bulk_widths(monkeypatch):
     """Above PRECOMP_MAX_LANES per device, verify_batch switches to the
     plain kernel (device-side pubkey validation included). Exercised at
@@ -87,6 +88,7 @@ def test_plain_kernel_branch_at_bulk_widths(monkeypatch):
 
 
 @pytest.mark.tpu
+@pytest.mark.slow
 def test_precomp_tuple_mode_matches_stacked(monkeypatch):
     """docs/PERF.md lever #6 (round 5): GRAFT_PRECOMP_TUPLE=1 hands A
     to the kernel as a pytree of 80 (N,) arrays instead of one stacked
@@ -118,6 +120,7 @@ def test_precomp_tuple_mode_matches_stacked(monkeypatch):
 
 
 @pytest.mark.tpu
+@pytest.mark.slow
 def test_verify_commits_coalesced_sharded_matches_host():
     """Same commits, sharded TPU path vs host path: identical verdicts
     (including the bad-signature job)."""

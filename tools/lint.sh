@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# bftlint entry point: syntax gate + static analysis.
+#
+# Runs from any cwd; invoked by tests/test_bftlint.py so it executes
+# under the existing tier-1 verify command with no extra CI plumbing.
+#
+#   1. python -m compileall  — every file must at least parse/compile
+#   2. python -m cometbft_tpu.analysis — async-safety + JAX hot-path
+#      rules against the checked-in baseline (tools/bftlint_baseline.json)
+#
+# Regenerate the baseline after deliberately accepting a violation:
+#   python -m cometbft_tpu.analysis --update-baseline
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m compileall -q cometbft_tpu tests
+python -m cometbft_tpu.analysis cometbft_tpu
