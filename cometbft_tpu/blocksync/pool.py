@@ -21,6 +21,12 @@ MAX_PENDING = 64
 BAN_DURATION_S = 60.0
 
 
+def _now() -> float:
+    """Monotonic clock, module-level so tests can fake ban expiry
+    without touching the event loop's time.monotonic."""
+    return time.monotonic()
+
+
 class PeerError(Exception):
     def __init__(self, peer_id: str, msg: str):
         super().__init__(msg)
@@ -34,14 +40,10 @@ class PoolPeer:
     base: int = 0
     height: int = 0
     latency_ewma: float = 1.0
-    banned_until: float = 0.0
     pending: int = 0
 
-    def available(self, height: int, now: float) -> bool:
-        return (
-            self.banned_until <= now
-            and self.base <= height <= self.height
-        )
+    def serves(self, height: int) -> bool:
+        return self.base <= height <= self.height
 
 
 class BlockPool:
@@ -54,6 +56,10 @@ class BlockPool:
         self.height = start_height  # next height to hand to verify loop
         self.max_pending = MAX_PENDING  # see start_requesters note
         self.peers: Dict[str, PoolPeer] = {}
+        # bans live on the POOL, not the PoolPeer: a banned peer that
+        # disconnects and re-dials (peer churn) must still be banned,
+        # or a byzantine feeder can launder its ban with a reconnect
+        self.banned_until: Dict[str, float] = {}
         self.blocks: Dict[int, Tuple[object, str]] = {}  # h -> (block, peer)
         # soft per-height exclusions (e.g. "peer lacks the extended
         # commit for h"): skipped when alternatives exist, ignored
@@ -62,7 +68,7 @@ class BlockPool:
         self._tasks: Dict[int, asyncio.Task] = {}
         self._new_block = asyncio.Event()
         self._stopped = False
-        self.start_time = time.monotonic()
+        self.start_time = _now()
 
     # --- peers --------------------------------------------------------
 
@@ -86,9 +92,20 @@ class BlockPool:
                 self._maybe_spawn(h)
 
     def ban_peer(self, peer_id: str, reason: str = "") -> None:
-        p = self.peers.get(peer_id)
-        if p:
-            p.banned_until = time.monotonic() + BAN_DURATION_S
+        self.banned_until[peer_id] = _now() + BAN_DURATION_S
+
+    def _prune_bans(self, now: float) -> None:
+        """Expired bans are deleted, not just ignored — long syncs churn
+        through many one-shot peer ids and the dict must not grow with
+        every peer ever banned."""
+        for pid in [p for p, t in self.banned_until.items() if t <= now]:
+            del self.banned_until[pid]
+
+    def banned_peers(self) -> List[str]:
+        """Currently-banned peer ids (introspection for checkers)."""
+        now = _now()
+        self._prune_bans(now)
+        return list(self.banned_until)
 
     def max_peer_height(self) -> int:
         return max((p.height for p in self.peers.values()), default=0)
@@ -101,13 +118,36 @@ class BlockPool:
         self.excluded.pop(height, None)
 
     def _pick_peer(self, height: int) -> Optional[PoolPeer]:
-        now = time.monotonic()
+        now = _now()
+        self._prune_bans(now)
+        in_range = [p for p in self.peers.values() if p.serves(height)]
         candidates = [
-            p for p in self.peers.values() if p.available(height, now)
+            p
+            for p in in_range
+            if p.peer_id not in self.banned_until
         ]
-        if not candidates:
-            return None
         excl = self.excluded.get(height)
+        if not candidates:
+            # starvation guard: when EVERY peer serving this height is
+            # banned, fetching from the least-loaded, least-recently-
+            # banned one beats stalling the sync until a ban expires
+            # (the liveness counterpart of the soft exclusions above);
+            # the requester's failure-path sleep paces the retries.
+            # Soft exclusions still steer here — a peer structurally
+            # unable to serve this height (e.g. no extended commit)
+            # yields to a banned-but-capable alternative
+            if not in_range:
+                return None
+            pool = in_range
+            if excl:
+                pool = [p for p in in_range if p.peer_id not in excl] or in_range
+            return min(
+                pool,
+                key=lambda p: (
+                    p.pending,
+                    self.banned_until.get(p.peer_id, 0.0),
+                ),
+            )
         if excl:
             preferred = [p for p in candidates if p.peer_id not in excl]
             if preferred:
@@ -154,12 +194,12 @@ class BlockPool:
                     await asyncio.sleep(0.05)
                     continue
                 peer.pending += 1
-                t0 = time.monotonic()
+                t0 = _now()
                 try:
                     block = await asyncio.wait_for(
                         peer.client.request_block(height), REQUEST_TIMEOUT_S
                     )
-                    dt = time.monotonic() - t0
+                    dt = _now() - t0
                     peer.latency_ewma = 0.8 * peer.latency_ewma + 0.2 * dt
                     if block is None:
                         raise PeerError(peer.peer_id, f"no block {height}")
@@ -171,9 +211,12 @@ class BlockPool:
                 except Exception:
                     # any client failure (timeout, missing block, broken
                     # transport) bans the peer and retries elsewhere;
-                    # the requester itself must never die silently
+                    # the requester itself must never die silently. The
+                    # sleep paces retries when the starvation guard
+                    # keeps handing back a banned, fast-failing peer
                     traceback.print_exc()
                     self.ban_peer(peer.peer_id)
+                    await asyncio.sleep(0.05)
                 finally:
                     peer.pending -= 1
         finally:

@@ -1,0 +1,38 @@
+"""Deterministic chaos harness: seeded network fault plane + nemesis
+scheduler + BFT invariant checkers.
+
+Quick start (see docs/CHAOS.md for the full story)::
+
+    from cometbft_tpu.chaos import default_schedule, run_schedule
+    report = await run_schedule(default_schedule(), seed=1337,
+                                base_dir=tmpdir)
+    assert report.ok, report.format()
+
+CLI: ``python -m cometbft_tpu.chaos --seed 1337`` (tools/chaos_smoke.sh).
+"""
+
+from .invariants import (
+    AgreementChecker,
+    InvariantViolation,
+    WALReplayChecker,
+)
+from .links import ChaosConnection, LinkState, LinkTable
+from .nemesis import Nemesis
+from .net import ChaosNet, ChaosReport, run_schedule
+from .schedule import FaultEvent, FaultSchedule, default_schedule
+
+__all__ = [
+    "AgreementChecker",
+    "ChaosConnection",
+    "ChaosNet",
+    "ChaosReport",
+    "FaultEvent",
+    "FaultSchedule",
+    "InvariantViolation",
+    "LinkState",
+    "LinkTable",
+    "Nemesis",
+    "WALReplayChecker",
+    "default_schedule",
+    "run_schedule",
+]

@@ -1,0 +1,85 @@
+"""CLI: one seeded chaos run.
+
+    python -m cometbft_tpu.chaos --seed 1337 [--nodes 4]
+        [--schedule sched.json] [--byzantine N] [--json out.json]
+
+Exit code 0 when every invariant holds, 1 on any violation (the
+report — seed, fault trace, per-link decisions — prints either way).
+With --byzantine the run is EXPECTED to be flagged: exit codes invert
+so CI can assert the checker actually fires.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import tempfile
+
+from .net import run_schedule
+from .schedule import FaultSchedule, default_schedule
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m cometbft_tpu.chaos")
+    ap.add_argument("--seed", type=int, default=1337)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--schedule", help="fault schedule JSON file")
+    ap.add_argument(
+        "--byzantine",
+        type=int,
+        default=None,
+        metavar="N",
+        help="inject a commit corruption at node N (detection check: "
+        "exit 0 iff the agreement checker FLAGS the run)",
+    )
+    ap.add_argument("--liveness-bound", type=float, default=60.0)
+    ap.add_argument("--json", help="write the report as JSON here")
+    args = ap.parse_args(argv)
+
+    if args.schedule:
+        with open(args.schedule) as f:
+            schedule = FaultSchedule.from_json(f.read())
+    else:
+        schedule = default_schedule(byzantine_node=args.byzantine)
+
+    with tempfile.TemporaryDirectory(prefix="chaos_") as tmp:
+        report = asyncio.run(
+            run_schedule(
+                schedule,
+                seed=args.seed,
+                base_dir=tmp,
+                n_nodes=args.nodes,
+                liveness_bound_s=args.liveness_bound,
+            )
+        )
+    print(report.format())
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(
+                {
+                    "seed": report.seed,
+                    "ok": report.ok,
+                    "violations": report.violations,
+                    "trace": report.trace,
+                    "final_heights": report.final_heights,
+                    "link_decisions": report.link_decisions,
+                    "wal_checks": report.wal_checks,
+                    "schedule": json.loads(report.schedule_json),
+                },
+                f,
+                indent=2,
+            )
+    if args.byzantine is not None:
+        detected = any("agreement" in v for v in report.violations)
+        print(
+            "byzantine detection:",
+            "DETECTED" if detected else "MISSED",
+        )
+        return 0 if detected else 1
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,112 @@
+"""Nemesis: executes a declarative fault schedule against a ChaosNet.
+
+Events run strictly in order. Height triggers poll the network's max
+committed height over running nodes; time triggers are relative to the
+previous event's execution. Every executed event is appended to
+``trace`` with its CONFIGURED trigger plus any seed-derived parameters
+(e.g. the byzantine tamper bytes, drawn from the LinkTable's master
+rng in schedule order) — so two runs with the same seed + schedule
+produce byte-identical traces, and per-link message-level decisions
+are separately deterministic by (seed, link, op index)
+(chaos/links.py). That pair is the replay contract printed on any
+invariant violation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List
+
+from ..utils.log import get_logger
+from .invariants import InvariantViolation
+from .schedule import FaultEvent, FaultSchedule
+
+_log = get_logger("chaos.nemesis")
+
+_POLL_S = 0.05
+
+
+class Nemesis:
+    def __init__(self, net, schedule: FaultSchedule):
+        self.net = net
+        self.schedule = schedule
+        self.trace: List[dict] = []
+
+    async def run(self) -> None:
+        for i, ev in enumerate(self.schedule.events):
+            await self._wait_trigger(ev)
+            record = await self._execute(ev)
+            record.update(
+                index=i,
+                action=ev.action,
+                at_height=ev.at_height,
+                after_s=ev.after_s,
+            )
+            self.trace.append(record)
+            _log.info("nemesis event", **{
+                k: v for k, v in record.items() if v is not None
+            })
+
+    async def _wait_trigger(self, ev: FaultEvent) -> None:
+        if ev.after_s is not None:
+            await asyncio.sleep(ev.after_s)
+            return
+        while self.net.max_height() < ev.at_height:
+            if not self.net.running_nodes():
+                # a dead network can never commit: waiting would hang
+                # the run forever — surface it as a liveness violation
+                raise InvariantViolation(
+                    "liveness",
+                    f"{ev.action} trigger at_height={ev.at_height} "
+                    "unreachable: no nodes running",
+                )
+            await asyncio.sleep(_POLL_S)
+
+    async def _execute(self, ev: FaultEvent) -> dict:
+        net = self.net
+        if ev.action == "partition":
+            groups = [
+                [net.nodes[i].node_id for i in g] for g in ev.groups
+            ]
+            net.table.partition(groups)
+            return {
+                "groups": [
+                    [net.nodes[i].name for i in g] for g in ev.groups
+                ]
+            }
+        if ev.action == "heal":
+            net.table.heal()
+            return {}
+        if ev.action == "set_link":
+            src = net.nodes[ev.src]
+            dst = net.nodes[ev.dst]
+            if ev.symmetric:
+                net.table.set_symmetric(
+                    src.node_id, dst.node_id, **ev.link
+                )
+            else:
+                net.table.set_link(src.node_id, dst.node_id, **ev.link)
+            return {
+                "src": src.name,
+                "dst": dst.name,
+                "link": dict(ev.link),
+                "symmetric": ev.symmetric,
+            }
+        if ev.action == "crash":
+            await net.crash(ev.node)
+            return {"node": net.nodes[ev.node].name}
+        if ev.action == "restart":
+            await net.restart(ev.node)
+            return {"node": net.nodes[ev.node].name}
+        if ev.action == "byzantine":
+            # tamper bytes come from the MASTER rng: schedule execution
+            # is sequential, so the draw is deterministic per run
+            tamper = bytes(
+                net.table.rng.getrandbits(8) for _ in range(32)
+            )
+            net.inject_commit_corruption(ev.node, tamper)
+            return {
+                "node": net.nodes[ev.node].name,
+                "tamper": tamper.hex()[:16],
+            }
+        raise ValueError(f"unknown action {ev.action!r}")

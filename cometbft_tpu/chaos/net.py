@@ -1,0 +1,360 @@
+"""ChaosNet: an N-node in-process network under a seeded fault plane.
+
+Builds full Nodes (node/node.py) over MemoryTransport with a LinkTable
+installed as the transport's link hook, runs a declarative fault
+schedule through the Nemesis, and checks the BFT invariants
+(chaos/invariants.py) continuously and at end-of-run. Nodes get real
+home directories (sqlite stores + consensus WAL) so in-process
+crash/restart recovers through the same WAL-replay + ABCI
+handshake-replay path a real power cut exercises.
+
+Entry point: ``run_schedule`` (awaitable) -> ChaosReport. On any
+violation the report carries the seed, the executed fault trace and
+the per-link decision counts — everything needed to replay the run.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .. import types as T
+from ..config.config import test_config
+from ..node.inprocess import make_genesis
+from ..node.node import Node
+from ..p2p import MemoryTransport, NodeInfo, NodeKey
+from ..store.block_store import _hkey
+from ..utils.log import get_logger
+from ..utils.tasks import spawn
+from .invariants import (
+    AgreementChecker,
+    InvariantViolation,
+    WALReplayChecker,
+    liveness_violation,
+)
+from .links import LinkTable
+from .nemesis import Nemesis
+from .schedule import FaultSchedule
+
+_log = get_logger("chaos")
+
+POLL_S = 0.05
+
+
+@dataclass
+class ChaosNode:
+    idx: int
+    name: str
+    node_key: NodeKey
+    privval: object
+    home: str
+    node: Optional[Node] = None  # None while crashed
+
+    @property
+    def node_id(self) -> str:
+        return self.node_key.node_id
+
+    @property
+    def running(self) -> bool:
+        return self.node is not None
+
+
+@dataclass
+class ChaosReport:
+    seed: int
+    schedule_json: str
+    trace: List[dict] = field(default_factory=list)
+    violations: List[str] = field(default_factory=list)
+    final_heights: Dict[str, int] = field(default_factory=dict)
+    link_decisions: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    wal_checks: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def format(self) -> str:
+        lines = [
+            f"chaos run seed={self.seed}: "
+            + ("OK" if self.ok else "INVARIANT VIOLATIONS"),
+            f"final heights: {self.final_heights}",
+            f"wal replay checks: {self.wal_checks}",
+            "fault trace:",
+        ]
+        for t in self.trace:
+            lines.append(f"  {t}")
+        if self.link_decisions:
+            lines.append("link decisions (P=partition-drop L=loss "
+                         "2=dup R=reorder .=pass):")
+            for link, counts in self.link_decisions.items():
+                lines.append(f"  {link}: {counts}")
+        for v in self.violations:
+            lines.append(f"VIOLATION: {v}")
+        if not self.ok:
+            lines.append(
+                "replay: python -m cometbft_tpu.chaos --seed "
+                f"{self.seed} --schedule <saved schedule json>"
+            )
+        return "\n".join(lines)
+
+
+class ChaosNet:
+    def __init__(
+        self,
+        n_nodes: int,
+        seed: int,
+        base_dir: str,
+        table: Optional[LinkTable] = None,
+    ):
+        self.seed = seed
+        self.base_dir = base_dir
+        self.table = table or LinkTable(seed)
+        self.genesis, pvs = make_genesis(
+            n_nodes, chain_id=f"chaos-{seed}"
+        )
+        self.nodes: List[ChaosNode] = []
+        for i, pv in enumerate(pvs):
+            home = os.path.join(base_dir, f"n{i}")
+            os.makedirs(home, exist_ok=True)
+            self.nodes.append(
+                ChaosNode(i, f"n{i}", NodeKey.generate(), pv, home)
+            )
+        self.agreement = AgreementChecker()
+        self.wal_checker = WALReplayChecker()
+        self._snapshots: Dict[int, Dict[int, bytes]] = {}
+        self._byz_tasks: List[asyncio.Future] = []
+
+    # --- node lifecycle -----------------------------------------------
+
+    def _build(self, cn: ChaosNode) -> Node:
+        cfg = test_config(cn.home)
+        cfg.base.moniker = cn.name
+        cfg.base.db_backend = "sqlite"  # restart needs persistence
+        cfg.rpc.laddr = ""  # invariants read stores directly
+        cfg.blocksync.enable = False
+        cfg.p2p.pex = False
+        info = NodeInfo(
+            node_id=cn.node_id,
+            network=self.genesis.chain_id,
+            moniker=cn.name,
+        )
+        transport = MemoryTransport(
+            cn.node_key, info, link_hook=self.table
+        )
+        return Node(
+            cfg,
+            self.genesis,
+            privval=cn.privval,
+            node_key=cn.node_key,
+            transport=transport,
+            home=cn.home,
+        )
+
+    async def start(self) -> None:
+        for cn in self.nodes:
+            cn.node = self._build(cn)
+            await cn.node.start()
+        for i, a in enumerate(self.nodes):
+            for b in self.nodes[i + 1 :]:
+                await self._dial(a, b)
+        # wait for the full mesh
+        for cn in self.nodes:
+            for _ in range(200):
+                if cn.node.switch.num_peers() >= len(self.nodes) - 1:
+                    break
+                await asyncio.sleep(POLL_S)
+
+    @staticmethod
+    async def _dial(a: ChaosNode, b: ChaosNode) -> None:
+        try:
+            await a.node.dial(
+                f"{b.node_id}@mem://{b.node_id}", persistent=True
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass  # partitioned/crashed target: persistent reconnect retries
+
+    async def crash(self, idx: int) -> None:
+        cn = self.nodes[idx]
+        if cn.node is None:
+            return
+        self._snapshots[idx] = self.wal_checker.pre_crash(cn.node)
+        _log.info("chaos: crashing node", node=cn.name, height=cn.node.height)
+        await cn.node.kill()
+        cn.node = None
+
+    async def restart(self, idx: int) -> None:
+        cn = self.nodes[idx]
+        if cn.node is not None:
+            return
+        cn.node = self._build(cn)
+        await cn.node.start()
+        # WAL-replay consistency right after recovery, before the node
+        # re-joins gossip
+        self.wal_checker.post_restart(
+            cn.name, cn.node, self._snapshots.get(idx, {})
+        )
+        _log.info(
+            "chaos: restarted node", node=cn.name, height=cn.node.height
+        )
+        for other in self.nodes:
+            if other.idx != idx and other.running:
+                await self._dial(cn, other)
+
+    async def stop(self) -> None:
+        for t in self._byz_tasks:
+            t.cancel()
+        for cn in self.nodes:
+            if cn.node is not None:
+                await cn.node.stop()
+                cn.node = None
+
+    # --- byzantine commit corruption ----------------------------------
+
+    def inject_commit_corruption(self, idx: int, tamper: bytes) -> None:
+        """Rewrite the designated node's NEXT committed block ID in its
+        own store — the observable footprint of a byzantine commit,
+        used to prove the agreement checker actually fires."""
+        cn = self.nodes[idx]
+
+        async def corrupt():
+            target_h = (cn.node.height if cn.node else 0) + 1
+            while cn.node is None or cn.node.height < target_h:
+                await asyncio.sleep(POLL_S)
+            store = cn.node.parts.block_store
+            meta = store.load_block_meta(target_h)
+            meta.block_id = T.BlockID(
+                tamper, meta.block_id.part_set_header
+            )
+            store.db.set(_hkey(b"H:", target_h), meta.encode())
+            _log.info(
+                "chaos: corrupted commit", node=cn.name, height=target_h
+            )
+
+        self._byz_tasks.append(spawn(corrupt(), name="chaos-byzantine"))
+
+    # --- introspection -------------------------------------------------
+
+    def running_nodes(self):
+        return [
+            (cn.name, cn.node) for cn in self.nodes if cn.node is not None
+        ]
+
+    def max_height(self) -> int:
+        return max(
+            (cn.node.height for cn in self.nodes if cn.node is not None),
+            default=0,
+        )
+
+    def heights(self) -> Dict[str, int]:
+        return {
+            cn.name: (cn.node.height if cn.node else -1)
+            for cn in self.nodes
+        }
+
+
+async def run_schedule(
+    schedule: FaultSchedule,
+    seed: int,
+    base_dir: str,
+    n_nodes: int = 4,
+    settle_heights: int = 2,
+    liveness_bound_s: float = 60.0,
+    fuzz_config=None,
+) -> ChaosReport:
+    """Execute one seeded chaos run end-to-end and return its report
+    (violations recorded, not raised — callers assert on report.ok)."""
+    table = LinkTable(seed, fuzz_config=fuzz_config)
+    net = ChaosNet(n_nodes, seed, base_dir, table=table)
+    report = ChaosReport(seed=seed, schedule_json=schedule.to_json())
+    nemesis = Nemesis(net, schedule)
+
+    stop_polling = asyncio.Event()
+
+    async def agreement_poll():
+        while not stop_polling.is_set():
+            try:
+                net.agreement.check(net.running_nodes())
+            except asyncio.CancelledError:
+                raise
+            except InvariantViolation as v:
+                report.violations.append(str(v))
+                return
+            except Exception:
+                # a crash landed mid-scan and closed the node's stores
+                # under the reader; the next pass re-reads (and the
+                # end-of-run final_check is authoritative regardless)
+                pass
+            await asyncio.sleep(2 * POLL_S)
+
+    try:
+        await net.start()
+        poller = asyncio.create_task(agreement_poll())
+        try:
+            # schedule execution itself can surface violations (a
+            # WAL-replay check on restart, an unreachable trigger on a
+            # dead net) — they belong in the report, not a traceback
+            try:
+                await nemesis.run()
+            except InvariantViolation as v:
+                report.violations.append(str(v))
+            # let pending byzantine corruptions land before judging
+            if net._byz_tasks:
+                await asyncio.wait(net._byz_tasks, timeout=30.0)
+            # liveness: every running node must advance past the
+            # post-schedule height within the bound — and SOME node
+            # must be running (an empty net is the ultimate liveness
+            # failure, not a vacuous pass)
+            target = net.max_height() + settle_heights
+            if not net.running_nodes():
+                # the schedule ended with every node down; nothing can
+                # restart them now, so don't burn the bound waiting
+                report.violations.append(
+                    str(liveness_violation(net.heights(), target, 0.0))
+                )
+            else:
+                deadline = (
+                    asyncio.get_running_loop().time() + liveness_bound_s
+                )
+                while asyncio.get_running_loop().time() < deadline:
+                    running = net.running_nodes()
+                    if running and all(
+                        node.height >= target for _, node in running
+                    ):
+                        break
+                    await asyncio.sleep(POLL_S)
+                else:
+                    report.violations.append(
+                        str(
+                            liveness_violation(
+                                net.heights(), target, liveness_bound_s
+                            )
+                        )
+                    )
+        finally:
+            stop_polling.set()
+            try:
+                await asyncio.wait_for(poller, 5.0)
+            except asyncio.TimeoutError:
+                poller.cancel()
+        # authoritative end-of-run agreement re-scan
+        try:
+            net.agreement.final_check(net.running_nodes())
+        except InvariantViolation as v:
+            if str(v) not in report.violations:
+                report.violations.append(str(v))
+    finally:
+        report.final_heights = net.heights()
+        await net.stop()
+
+    report.trace = nemesis.trace
+    report.link_decisions = table.decision_counts()
+    report.wal_checks = net.wal_checker.checks
+    if not report.ok:
+        # the replay contract: seed + schedule + trace on any failure
+        _log.error("chaos invariants violated", seed=seed)
+        print(report.format())
+    return report

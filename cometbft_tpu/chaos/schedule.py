@@ -1,0 +1,116 @@
+"""Declarative fault schedules for the nemesis scheduler.
+
+A schedule is an ordered list of FaultEvents. Events execute strictly
+in order; each one waits for its trigger first:
+
+- ``at_height=N`` — fire once the network's max committed height
+  (over running nodes) reaches N. Use for events downstream of
+  progress (a majority-side partition keeps committing, so its heal
+  can be height-triggered).
+- ``after_s=T`` — fire T seconds after the previous event executed
+  (or after run start for the first event). Use when the trigger side
+  cannot make progress (e.g. healing a 2-2 split that halts the
+  chain).
+
+Actions (mirroring the e2e runner's perturbations, but in-process,
+deterministic and fast):
+
+====================  =================================================
+``partition``         ``groups=[[0,1],[2,3]]`` node-index groups; links
+                      across groups go down (silent blackhole)
+``heal``              all links back up
+``set_link``          ``src``/``dst`` node indexes + ``link`` dict of
+                      LinkState fields (loss, latency_s, jitter_s,
+                      duplicate, reorder, up); ``symmetric`` (default
+                      True) applies both directions
+``crash``             ``node=i``: in-process power cut (Node.kill)
+``restart``           ``node=i``: rebuild from the same home dir —
+                      recovery runs WAL replay + ABCI handshake replay
+``byzantine``         ``node=i``: corrupt the node's NEXT commit (its
+                      stored block ID at that height is rewritten with
+                      seeded tamper bytes). This simulates the
+                      observable effect of a byzantine commit so the
+                      AGREEMENT CHECKER ITSELF is validated — a
+                      checker that cannot flag an injected fork proves
+                      nothing (the same discipline Jepsen applies to
+                      its checkers).
+====================  =================================================
+
+Schedules round-trip through JSON so failing runs can be archived and
+replayed byte-for-byte alongside their seed.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+ACTIONS = ("partition", "heal", "set_link", "crash", "restart", "byzantine")
+
+
+@dataclass
+class FaultEvent:
+    action: str
+    at_height: Optional[int] = None
+    after_s: Optional[float] = None
+    groups: Optional[List[List[int]]] = None  # partition
+    node: Optional[int] = None  # crash / restart / byzantine
+    src: Optional[int] = None  # set_link
+    dst: Optional[int] = None  # set_link
+    link: Optional[Dict[str, float]] = None  # set_link LinkState fields
+    symmetric: bool = True  # set_link: apply both directions
+
+    def __post_init__(self):
+        if self.action not in ACTIONS:
+            raise ValueError(f"unknown fault action {self.action!r}")
+        if (self.at_height is None) == (self.after_s is None):
+            raise ValueError(
+                f"{self.action}: exactly one of at_height/after_s required"
+            )
+        if self.action == "partition" and not self.groups:
+            raise ValueError("partition: groups required")
+        if self.action in ("crash", "restart", "byzantine") and (
+            self.node is None
+        ):
+            raise ValueError(f"{self.action}: node required")
+        if self.action == "set_link" and (
+            self.src is None or self.dst is None or not self.link
+        ):
+            raise ValueError("set_link: src, dst and link required")
+
+
+@dataclass
+class FaultSchedule:
+    events: List[FaultEvent] = field(default_factory=list)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            [
+                {k: v for k, v in asdict(e).items() if v is not None}
+                for e in self.events
+            ],
+            indent=2,
+        )
+
+    @classmethod
+    def from_json(cls, raw: str) -> "FaultSchedule":
+        return cls([FaultEvent(**d) for d in json.loads(raw)])
+
+
+def default_schedule(byzantine_node: Optional[int] = None) -> FaultSchedule:
+    """The canonical 4-node smoke schedule: majority partition at h2,
+    heal at h4, crash node 1 at h5, restart it shortly after. With
+    ``byzantine_node`` set, a commit corruption is injected after the
+    heal — a run the agreement checker MUST flag."""
+    events = [
+        FaultEvent("partition", at_height=2, groups=[[0, 1, 2], [3]]),
+        FaultEvent("heal", at_height=4),
+    ]
+    if byzantine_node is not None:
+        events.append(FaultEvent("byzantine", at_height=4, node=byzantine_node))
+    events += [
+        FaultEvent("crash", at_height=5, node=1),
+        FaultEvent("restart", after_s=0.5, node=1),
+    ]
+    return FaultSchedule(events)

@@ -13,8 +13,11 @@ from typing import List, Optional
 
 try:
     import tomllib
-except ImportError:  # pragma: no cover
-    tomllib = None
+except ImportError:  # pragma: no cover - py<3.11: same-API backport
+    try:
+        import tomli as tomllib
+    except ImportError:
+        tomllib = None
 
 
 @dataclass

@@ -128,19 +128,32 @@ class ConsensusState:
         )
 
     async def stop(self) -> None:
+        await self._halt(graceful=True)
+
+    async def crash(self) -> None:
+        """Abrupt in-process stop (chaos harness): cancel the routines
+        and abandon the WAL without flushing buffered records — the
+        power-cut analog of stop(). Recovery must come exclusively
+        from fsync'd WAL prefixes + persisted stores."""
+        await self._halt(graceful=False)
+
+    async def _halt(self, graceful: bool) -> None:
         if self._routine_task:
             self._routine_task.cancel()
             try:
                 await self._routine_task
             except asyncio.CancelledError:
                 if not self._routine_task.cancelled():
-                    raise  # outer cancel of stop() itself: propagate
+                    raise  # outer cancel of stop()/crash(): propagate
             except Exception:
                 traceback.print_exc()
         if self._timeout_task:
             self._timeout_task.cancel()
         if self.wal:
-            self.wal.close()
+            if graceful:
+                self.wal.close()
+            else:
+                self.wal.crash_close()
 
     # --- state transitions --------------------------------------------
 

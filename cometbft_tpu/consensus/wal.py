@@ -156,6 +156,22 @@ class WAL:
             pass
         self._f.close()
 
+    def crash_close(self) -> None:
+        """Power-cut close (chaos harness): release the file WITHOUT
+        flushing Python's userspace buffer — records written since the
+        last fsync barrier are lost, exactly like a real crash. The fd
+        is redirected to /dev/null first so the buffered tail drains
+        harmlessly instead of reaching the WAL on GC."""
+        try:
+            devnull = os.open(os.devnull, os.O_WRONLY)
+            try:
+                os.dup2(devnull, self._f.fileno())
+            finally:
+                os.close(devnull)
+        except OSError:
+            pass
+        self._f.close()
+
     # --- rotation -----------------------------------------------------
 
     def _next_index(self) -> int:

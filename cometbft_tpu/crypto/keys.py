@@ -32,6 +32,12 @@ try:
 except Exception:  # pragma: no cover
     _HAVE_OSSL = False
 
+# middle tier: the system libcrypto through ctypes (crypto/_ossl.py)
+# when the `cryptography` wheel is absent; pure python is last resort
+from . import _ossl as _ctossl
+
+_HAVE_CTYPES_OSSL = (not _HAVE_OSSL) and _ctossl.available()
+
 ED25519_KEY_TYPE = "ed25519"
 SECP256K1_KEY_TYPE = "secp256k1"
 
@@ -115,6 +121,12 @@ class Ed25519PubKey(PubKey):
                 return True
             except Exception:
                 pass  # fall through to the liberal ZIP-215 check
+        elif _HAVE_CTYPES_OSSL:
+            try:
+                if _ctossl.ed25519_verify(self.key_bytes, msg, sig):
+                    return True
+            except Exception:
+                pass  # fall through to the liberal ZIP-215 check
         return _ref.verify_zip215(self.key_bytes, msg, sig)
 
 
@@ -137,6 +149,8 @@ class Ed25519PrivKey:
             raw = pk.public_bytes(
                 _ser.Encoding.Raw, _ser.PublicFormat.Raw
             )
+        elif _HAVE_CTYPES_OSSL:
+            raw = _ctossl.ed25519_public(self.seed)
         else:  # pragma: no cover
             raw = _ref.public_from_seed(self.seed)
         return Ed25519PubKey(raw)
@@ -144,6 +158,8 @@ class Ed25519PrivKey:
     def sign(self, msg: bytes) -> bytes:
         if _HAVE_OSSL:
             return _OsslPriv.from_private_bytes(self.seed).sign(msg)
+        if _HAVE_CTYPES_OSSL:
+            return _ctossl.ed25519_sign(self.seed, msg)
         return _ref.sign(self.seed, msg)  # pragma: no cover
 
     def __bytes__(self) -> bytes:

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import struct
 
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from .chacha20poly1305 import ChaCha20Poly1305, InvalidTag
 
 KEY_SIZE = 32
 NONCE_SIZE = 24
@@ -86,8 +86,6 @@ class XChaCha20Poly1305:
 
     def open(self, nonce: bytes, ciphertext: bytes, aad: bytes = b"") -> bytes:
         """Raises ValueError on authentication failure."""
-        from cryptography.exceptions import InvalidTag
-
         aead, n12 = self._inner(nonce)
         try:
             return aead.decrypt(n12, ciphertext, aad or None)

@@ -24,7 +24,10 @@ TOML schema:
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib
+except ImportError:  # pragma: no cover - py<3.11: same-API backport
+    import tomli as tomllib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 

@@ -12,7 +12,10 @@ ban, reactor registry, broadcast) is shared with the native Switch by
 subclassing — both stacks satisfy the same Switcher contract and only
 differ in what is layered over the encrypted connection (per-channel
 mux streams here, a single MConnection there) and in admission (Host
-gater + resource manager here).
+gater + resource manager here). In particular the persistent-peer
+reconnect path backs off through the one shared policy in
+utils/backoff.py (exponential + full jitter + cap) rather than a
+second hand-rolled schedule.
 """
 
 from __future__ import annotations

@@ -343,11 +343,27 @@ class Node:
                 persistent=True,
             )
 
+    async def kill(self) -> None:
+        """Simulated process crash for in-process chaos tests: tear
+        every task down abruptly — consensus abandons its WAL without
+        flushing (ConsensusState.crash), nothing performs a graceful
+        handoff — then release store handles so a restarted Node on
+        the same home recovers exclusively through WAL replay + ABCI
+        handshake replay (consensus/replay.py), the same path a real
+        power cut exercises via utils/fail.py."""
+        await self._shutdown(graceful=False)
+
     async def stop(self) -> None:
+        await self._shutdown(graceful=True)
+
+    async def _shutdown(self, graceful: bool) -> None:
         if getattr(self, "watchdog", None) is not None:
             self.watchdog.stop()
         if self._statesync_task is not None:
             self._statesync_task.cancel()
+        # kill(): servers still close (an in-process restart must be
+        # able to rebind, and dead stores must stop being served) —
+        # the crash/graceful split is consensus' WAL handling only
         if self.metrics_server is not None:
             await self.metrics_server.stop()
         if self.debug_server is not None:
@@ -357,7 +373,10 @@ class Node:
         if self.rpc_server is not None:
             await self.rpc_server.stop()
         if self._cs_started:
-            await self.parts.cs.stop()
+            if graceful:
+                await self.parts.cs.stop()
+            else:
+                await self.parts.cs.crash()
         await self.switch.stop()
         # release store handles (psql sink flush+close; logdb flocks;
         # sqlite fds) — a restart in the same process must be able to
@@ -372,3 +391,10 @@ class Node:
     @property
     def height(self) -> int:
         return self.parts.block_store.height()
+
+    def block_id_hash_at(self, height: int) -> Optional[bytes]:
+        """Committed block ID hash at a height, or None — the
+        commit-introspection surface the chaos invariant checkers
+        compare across nodes (chaos/invariants.py)."""
+        meta = self.parts.block_store.load_block_meta(height)
+        return None if meta is None else bytes(meta.block_id.hash)

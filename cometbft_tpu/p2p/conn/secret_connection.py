@@ -22,16 +22,10 @@ import hashlib
 import struct
 from typing import Optional, Tuple
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.serialization import (
-    Encoding,
-    PublicFormat,
-)
-
+# both primitives are dependency-gated: OpenSSL when the
+# `cryptography` package exists, pure-Python/numpy fallback otherwise
+from ...crypto import x25519 as _x25519
+from ...crypto.chacha20poly1305 import ChaCha20Poly1305
 from ...crypto.keys import Ed25519PrivKey, Ed25519PubKey
 
 DATA_LEN_SIZE = 2
@@ -94,10 +88,8 @@ class SecretConnection:
 
     @classmethod
     async def _handshake(cls, reader, writer, priv_key):
-        eph_priv = X25519PrivateKey.generate()
-        eph_pub = eph_priv.public_key().public_bytes(
-            Encoding.Raw, PublicFormat.Raw
-        )
+        eph_priv = _x25519.generate_private()
+        eph_pub = _x25519.public(eph_priv)
         writer.write(eph_pub)
         await writer.drain()
         their_eph = await reader.readexactly(32)
@@ -108,7 +100,7 @@ class SecretConnection:
         transcript = hashlib.sha256(
             TRANSCRIPT_DOMAIN + lo + hi
         ).digest()
-        shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(their_eph))
+        shared = _x25519.shared(eph_priv, their_eph)
         key_lo, key_hi, challenge = _kdf(shared, transcript)
         # the party whose ephemeral key sorts lower sends with key_lo
         if eph_pub == lo:

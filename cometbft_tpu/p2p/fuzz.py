@@ -38,10 +38,13 @@ class FuzzedConnection:
     dropped (write reports success, bytes vanish), delayed, or the
     whole connection torn down, per config probabilities."""
 
-    def __init__(self, sconn, config: FuzzConnConfig):
+    def __init__(self, sconn, config: FuzzConnConfig, rng=None):
         self._sconn = sconn
         self._cfg = config
-        self._rng = random.Random(getattr(config, "seed", None))
+        # rng injection: the chaos link plane (chaos/links.LinkTable)
+        # composes a per-link seeded stream so fuzz decisions replay
+        # deterministically alongside link faults
+        self._rng = rng or random.Random(getattr(config, "seed", None))
         self._dead = False
 
     # counters for tests/metrics

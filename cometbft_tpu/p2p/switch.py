@@ -14,10 +14,10 @@ Responsibilities (mirroring the reference):
 from __future__ import annotations
 
 import asyncio
-import random
 import traceback
 from typing import Dict, List, Optional
 
+from ..utils.backoff import Backoff
 from ..utils.log import get_logger
 from ..utils.tasks import spawn
 from .node_info import ChannelDescriptor, NodeInfo
@@ -296,9 +296,14 @@ class Switch:
 
         async def routine():
             try:
-                delay = RECONNECT_BASE_S
+                # shared backoff policy (utils/backoff.py): exponential
+                # with full jitter, capped — also the Lp2pSwitch
+                # reconnect path, which inherits this routine
+                backoff = Backoff(
+                    base_s=RECONNECT_BASE_S, cap_s=RECONNECT_MAX_S
+                )
                 for _ in range(MAX_RECONNECT_ATTEMPTS):
-                    await asyncio.sleep(delay * (0.8 + 0.4 * random.random()))
+                    await asyncio.sleep(backoff.next_delay())
                     if self._stopped or peer_id in self.peers:
                         return
                     try:
@@ -307,7 +312,7 @@ class Switch:
                     except asyncio.CancelledError:
                         raise
                     except Exception:
-                        delay = min(delay * 2, RECONNECT_MAX_S)
+                        pass  # dial failed; next attempt backs off further
             finally:
                 self._reconnect_tasks.pop(peer_id, None)
 

@@ -15,6 +15,7 @@ import socket
 import struct
 from typing import Callable, Dict, Optional, Tuple
 
+from ..utils.tasks import spawn
 from .conn.secret_connection import SecretConnection
 from .key import NodeKey, node_id_from_pubkey
 from .node_info import NodeInfo
@@ -157,16 +158,30 @@ class TCPTransport:
 
 class MemoryTransport:
     """In-process transport hub: dial by node ID, backed by OS
-    socketpairs so the full secret-connection path runs."""
+    socketpairs so the full secret-connection path runs.
+
+    ``link_hook`` is the pluggable fault plane (chaos/links.LinkTable
+    satisfies it): an object with ``allow_dial(src_id, dst_id) ->
+    bool`` consulted before a dial, and ``wrap(sconn, src_id, dst_id)
+    -> conn`` applied to each side of an established connection so
+    per-(src, dst) faults (partition, loss, latency, duplication,
+    reordering) land on live links. ``None`` = passthrough."""
 
     _hubs: Dict[str, "MemoryTransport"] = {}
 
-    def __init__(self, node_key: NodeKey, node_info: NodeInfo, network: str = "mem"):
+    def __init__(
+        self,
+        node_key: NodeKey,
+        node_info: NodeInfo,
+        network: str = "mem",
+        link_hook=None,
+    ):
         self.node_key = node_key
         self.node_info = node_info
         self.accept_queue: asyncio.Queue = asyncio.Queue(64)
         self._network = network
         self._addr = f"mem://{node_key.node_id}"
+        self.link_hook = link_hook
         MemoryTransport._hubs[node_key.node_id] = self
 
     @property
@@ -181,9 +196,16 @@ class MemoryTransport:
 
     async def dial(self, addr: str, expected_id: Optional[str] = None):
         target_id = addr.replace("mem://", "")
+        our_id = self.node_key.node_id
         hub = MemoryTransport._hubs.get(target_id)
         if hub is None:
             raise TransportError(f"no in-memory node {target_id}")
+        if self.link_hook is not None and not self.link_hook.allow_dial(
+            our_id, target_id
+        ):
+            raise TransportError(
+                f"link {our_id[:8]}->{target_id[:8]} partitioned"
+            )
         a, b = socket.socketpair()
         a.setblocking(False)
         b.setblocking(False)
@@ -195,8 +217,11 @@ class MemoryTransport:
                 sconn, info = await upgrade(
                     r2, w2, hub.node_key, hub.node_info
                 )
+                if hub.link_hook is not None:
+                    # the hub's writes traverse the target->us link
+                    sconn = hub.link_hook.wrap(sconn, target_id, our_id)
                 await hub.accept_queue.put(
-                    (sconn, info, f"mem://{self.node_key.node_id}")
+                    (sconn, info, f"mem://{our_id}")
                 )
             except asyncio.CancelledError:
                 w2.close()
@@ -207,7 +232,7 @@ class MemoryTransport:
                 except Exception:
                     pass
 
-        task = asyncio.create_task(remote_side())
+        task = spawn(remote_side(), name="mem-transport-accept")
         try:
             sconn, their_info = await upgrade(
                 r1, w1, self.node_key, self.node_info, expected_id or target_id
@@ -216,7 +241,14 @@ class MemoryTransport:
             task.cancel()
             raise
         await task
+        if self.link_hook is not None:
+            sconn = self.link_hook.wrap(sconn, our_id, target_id)
         return sconn, their_info, addr
 
     async def close(self) -> None:
         MemoryTransport._hubs.pop(self.node_key.node_id, None)
+        # drain conns nobody consumed: an in-process restart must not
+        # inherit stale half-open connections from its previous life
+        while not self.accept_queue.empty():
+            sconn, _, _ = self.accept_queue.get_nowait()
+            sconn.close()

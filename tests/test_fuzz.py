@@ -3,8 +3,12 @@ SecretConnection, rpc jsonrpc server — here via hypothesis)."""
 
 import asyncio
 
-from hypothesis import HealthCheck, given, settings
-from hypothesis import strategies as st
+import pytest
+
+pytest.importorskip("hypothesis")
+
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 FAST = settings(
     max_examples=40,

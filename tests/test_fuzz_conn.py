@@ -1,0 +1,179 @@
+"""FuzzedConnection determinism + teardown (ISSUE 2 satellite): same
+seed => identical drop/delay decisions, prob_drop_conn actually tears
+the connection down, and the injected-rng composition hook."""
+
+import asyncio
+import random
+
+import pytest
+
+from cometbft_tpu.p2p.fuzz import (
+    MODE_DELAY,
+    FuzzConnConfig,
+    FuzzedConnection,
+    maybe_fuzz,
+)
+
+
+class FakeSconn:
+    def __init__(self, chunks=()):
+        self.writes = []
+        self.chunks = list(chunks)
+        self.closed = False
+
+    async def write_msg(self, data):
+        self.writes.append(bytes(data))
+        return len(data)
+
+    async def read_chunk(self):
+        if not self.chunks:
+            raise ConnectionError("out of chunks")
+        return self.chunks.pop(0)
+
+    def close(self):
+        self.closed = True
+
+
+def run(coro, timeout=30):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def _drive_writes(cfg, n=200, rng=None):
+    async def main():
+        inner = FakeSconn()
+        fc = FuzzedConnection(inner, cfg, rng=rng)
+        delivered = []
+        for i in range(n):
+            await fc.write_msg(bytes([i & 0xFF]))
+            delivered.append(len(inner.writes))
+        return delivered, fc.dropped_writes
+
+    return run(main())
+
+
+def test_same_seed_identical_drop_decisions():
+    runs = [
+        _drive_writes(FuzzConnConfig(enable=True, prob_drop_rw=0.4, seed=9))
+        for _ in range(2)
+    ]
+    assert runs[0] == runs[1]
+    delivered, dropped = runs[0]
+    assert dropped > 0 and delivered[-1] + dropped == 200
+    # a different seed must (overwhelmingly) diverge
+    other = _drive_writes(
+        FuzzConnConfig(enable=True, prob_drop_rw=0.4, seed=10)
+    )
+    assert other != runs[0]
+
+
+def test_same_seed_identical_read_decisions():
+    async def drive():
+        cfg = FuzzConnConfig(enable=True, prob_drop_rw=0.3, seed=4)
+        inner = FakeSconn(chunks=[bytes([i]) for i in range(100)])
+        fc = FuzzedConnection(inner, cfg)
+        got = []
+        try:
+            while True:
+                got.append(await fc.read_chunk())
+        except ConnectionError:
+            pass  # out of chunks
+        return got, fc.dropped_reads
+
+    a = run(drive())
+    b = run(drive())
+    assert a == b
+    got, dropped = a
+    assert dropped > 0 and len(got) + dropped == 100
+
+
+def test_delay_mode_draws_deterministic():
+    async def drive():
+        cfg = FuzzConnConfig(
+            enable=True,
+            mode=MODE_DELAY,
+            prob_sleep=0.5,
+            max_delay_ms=100,
+            seed=21,
+        )
+        inner = FakeSconn()
+        fc = FuzzedConnection(inner, cfg)
+        sleeps = []
+        real_sleep = asyncio.sleep
+
+        async def spy(d):
+            sleeps.append(round(d, 9))
+            await real_sleep(0)
+
+        asyncio.sleep = spy
+        try:
+            for i in range(100):
+                await fc.write_msg(b"x")
+        finally:
+            asyncio.sleep = real_sleep
+        # delay mode never drops
+        assert len(inner.writes) == 100
+        return sleeps
+
+    a = run(drive())
+    b = run(drive())
+    assert a == b and a
+    assert all(0 <= d <= 0.1 for d in a)
+
+
+def test_prob_drop_conn_tears_connection_down():
+    async def main():
+        cfg = FuzzConnConfig(enable=True, prob_drop_conn=1.0, seed=1)
+        inner = FakeSconn(chunks=[b"x"])
+        fc = FuzzedConnection(inner, cfg)
+        with pytest.raises(ConnectionError):
+            await fc.write_msg(b"dead")
+        # the underlying connection was CLOSED, not just refused
+        assert inner.closed
+        assert not inner.writes
+        # and the connection stays dead for every later op
+        with pytest.raises(ConnectionError):
+            await fc.read_chunk()
+        with pytest.raises(ConnectionError):
+            await fc.write_msg(b"still dead")
+
+    run(main())
+
+
+def test_drop_conn_probability_is_seed_deterministic():
+    async def drive():
+        cfg = FuzzConnConfig(
+            enable=True, prob_drop_conn=0.02, prob_drop_rw=0.1, seed=77
+        )
+        inner = FakeSconn()
+        fc = FuzzedConnection(inner, cfg)
+        for i in range(1000):
+            try:
+                await fc.write_msg(b"y")
+            except ConnectionError:
+                return i  # the op index the connection died at
+        return None
+
+    assert run(drive()) == run(drive()) is not None
+
+
+def test_injected_rng_overrides_config_seed():
+    """The chaos link plane injects its own per-link stream; the
+    config seed must then be ignored."""
+    a = _drive_writes(
+        FuzzConnConfig(enable=True, prob_drop_rw=0.4, seed=1),
+        rng=random.Random(123),
+    )
+    b = _drive_writes(
+        FuzzConnConfig(enable=True, prob_drop_rw=0.4, seed=2),
+        rng=random.Random(123),
+    )
+    assert a == b
+
+
+def test_maybe_fuzz_passthrough():
+    inner = FakeSconn()
+    assert maybe_fuzz(inner, None) is inner
+    assert maybe_fuzz(inner, FuzzConnConfig(enable=False)) is inner
+    assert isinstance(
+        maybe_fuzz(inner, FuzzConnConfig(enable=True)), FuzzedConnection
+    )

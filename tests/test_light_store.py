@@ -26,7 +26,7 @@ def test_db_light_store_roundtrip_and_resume(tmp_path):
     cli = Client(
         "light-db",
         TrustOptions(
-            period_ns=3600 * 10**9, height=1, hash=trust.hash()
+            period_ns=7200 * 10**9, height=1, hash=trust.hash()
         ),
         primary=provider,
         store=store,
@@ -45,7 +45,7 @@ def test_db_light_store_roundtrip_and_resume(tmp_path):
     cli2 = Client(
         "light-db",
         TrustOptions(
-            period_ns=3600 * 10**9, height=1, hash=trust.hash()
+            period_ns=7200 * 10**9, height=1, hash=trust.hash()
         ),
         primary=provider,
         store=store2,
@@ -60,7 +60,7 @@ def test_db_light_store_roundtrip_and_resume(tmp_path):
         Client(
             "light-db",
             TrustOptions(
-                period_ns=3600 * 10**9, height=1, hash=b"\x00" * 32
+                period_ns=7200 * 10**9, height=1, hash=b"\x00" * 32
             ),
             primary=provider,
             store=store2,
@@ -79,7 +79,7 @@ def test_db_light_store_roundtrip_and_resume(tmp_path):
         Client(
             "light-db",
             TrustOptions(
-                period_ns=3600 * 10**9, height=1, hash=b"\x11" * 32
+                period_ns=7200 * 10**9, height=1, hash=b"\x11" * 32
             ),
             primary=provider,
             store=store3,
@@ -87,7 +87,7 @@ def test_db_light_store_roundtrip_and_resume(tmp_path):
     Client(
         "light-db",
         TrustOptions(
-            period_ns=3600 * 10**9, height=1, hash=trust.hash()
+            period_ns=7200 * 10**9, height=1, hash=trust.hash()
         ),
         primary=provider,
         store=store3,
@@ -119,7 +119,7 @@ def test_sparse_store_trust_check_anchors_to_chain(tmp_path):
         cli = Client(
             "light-anchor",
             TrustOptions(
-                period_ns=3600 * 10**9, height=1, hash=trust.hash()
+                period_ns=7200 * 10**9, height=1, hash=trust.hash()
             ),
             primary=provider,
             store=store,
@@ -129,7 +129,7 @@ def test_sparse_store_trust_check_anchors_to_chain(tmp_path):
         return Client(
             "light-anchor",
             TrustOptions(
-                period_ns=3600 * 10**9, height=1, hash=trust_hash
+                period_ns=7200 * 10**9, height=1, hash=trust_hash
             ),
             primary=primary,
             store=store,
@@ -193,7 +193,7 @@ def test_sparse_store_trust_check_anchors_to_chain(tmp_path):
         Client(
             "light-anchor",
             TrustOptions(
-                period_ns=3600 * 10**9, height=5,
+                period_ns=7200 * 10**9, height=5,
                 hash=bytes(forged5.hash()),
             ),
             primary=MidForger(),

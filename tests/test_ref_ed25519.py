@@ -3,7 +3,15 @@
 import os
 
 import pytest
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+pytest.importorskip(
+    "cryptography",
+    reason="differential oracle needs the OpenSSL wheel; the ctypes-"
+    "libcrypto tier is covered by tests/test_crypto_fallback.py",
+)
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (  # noqa: E402
+    Ed25519PrivateKey,
+)
 
 from cometbft_tpu.crypto import ref_ed25519 as ref
 

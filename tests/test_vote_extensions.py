@@ -247,9 +247,7 @@ def test_blocksync_tolerates_peers_lacking_extended_commits():
         # the highest height blocksync can verify) deliberately NOT
         assert fresh.block_store.height() == src.block_store.height() - 2
         # the peer was never banned for lacking ECs
-        assert all(
-            p.banned_until == 0.0 for p in reactor.pool.peers.values()
-        )
+        assert not reactor.pool.banned_peers()
 
     run(main())
 
