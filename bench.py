@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -33,6 +34,166 @@ N_VALS = 150
 # set False by main() when the accelerator probe fails: device
 # measurements return None and configs report host numbers only
 _DEVICE_OK = True
+
+# --- budgets + incremental checkpointing --------------------------------
+# BENCH_r05 failure mode: one wedged leg ate the driver's whole bench
+# window and the round recorded rc=124 with parsed: null — every
+# number measured before the wedge was lost. Three defenses:
+#   1. every config runs under a per-config time budget (daemon
+#      thread; a leg that blows it is abandoned and recorded as such);
+#   2. the result JSON is checkpointed after EVERY config, so the
+#      final line can always be assembled from partial results;
+#   3. SIGTERM/SIGINT (the driver's `timeout` sends TERM first) print
+#      the checkpointed line and exit 0 — partial results always land
+#      on stdout's final line.
+
+_CKPT = {"configs": {}, "t_start": None, "emitted": False}
+_WEDGED: list = []
+
+_DEFAULT_BUDGETS_S = {
+    "corpus": 3600.0,
+    "kernel": 1500.0,
+    "replay": 5400.0,
+    "bisect": 1500.0,
+    "commit150": 600.0,
+    "batch64": 600.0,
+    "mixed": 600.0,
+    "pipeline": 900.0,
+}
+
+
+def _config_budget_s(name: str) -> float:
+    v = os.environ.get(f"BENCH_BUDGET_{name.upper()}")
+    if v is None:
+        v = os.environ.get("BENCH_CONFIG_BUDGET_S")
+    if v is not None:
+        return float(v)
+    return _DEFAULT_BUDGETS_S.get(name, 900.0)
+
+
+def _checkpoint_path() -> str:
+    return os.environ.get(
+        "BENCH_CHECKPOINT_PATH",
+        os.path.join(REPO, ".bench_checkpoint.json"),
+    )
+
+
+def _final_payload() -> dict:
+    """Assemble the headline JSON from whatever configs have landed —
+    callable at ANY point (checkpoint after each config, signal
+    handler, normal end of run)."""
+    configs = _CKPT["configs"]
+    headline = configs.get("kernel") or {}
+    for leg_name in ("kernel_pallas_default", "kernel_precomp_tuple"):
+        leg = configs.get(leg_name) or {}
+        if (leg.get("rate") or 0) > (headline.get("rate") or 0):
+            headline = leg
+    metric = "ed25519_batch_verify_throughput"
+    value = headline.get("rate")
+    unit = "verifies/sec"
+    vs_baseline = headline.get("vs_cpu")
+    rep = configs.get("replay") or {}
+    if (
+        value is None
+        and rep.get("wall_s")
+        and rep.get("mode") == "host-only"
+    ):
+        # device headline unavailable: the HOST replay throughput is
+        # the round's measured number — record it as the headline
+        # rather than a null (VERDICT r4 weak #2); detail carries the
+        # device outage note. Gated on mode so a device-path replay is
+        # never mislabeled as host
+        metric = "blocksync_replay_throughput_host"
+        value = rep.get("blocks_per_s")
+        unit = "blocks/sec (10k-block x 150-val replay, host pipeline)"
+        vs_baseline = rep.get("parallel_vs_serial") or rep.get(
+            "vs_sequential"
+        )
+    t0 = _CKPT["t_start"] or time.time()
+    return {
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "vs_baseline": vs_baseline,
+        "detail": {
+            "configs": configs,
+            "total_bench_s": round(time.time() - t0, 1),
+        },
+    }
+
+
+def _record(name: str, entry: dict) -> None:
+    """Land one config's numbers and re-checkpoint the full line."""
+    _CKPT["configs"][name] = entry
+    if os.environ.get("BENCH_CHILD") == "1":
+        return  # children report via stdout; never clobber the
+        # parent's checkpoint file
+    try:
+        tmp = _checkpoint_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(_final_payload(), f)
+        os.replace(tmp, _checkpoint_path())
+    except OSError:
+        pass  # checkpointing is best-effort; stdout is authoritative
+
+
+def _emit_final(note: "str | None" = None) -> None:
+    if _CKPT["emitted"]:
+        return
+    _CKPT["emitted"] = True
+    payload = _final_payload()
+    if note:
+        payload["detail"]["note"] = note
+    print(json.dumps(payload), flush=True)
+
+
+def _install_signal_handlers() -> None:
+    import signal
+
+    def _handler(signum, frame):
+        _emit_final(
+            note=f"interrupted by signal {signum}; every config "
+            "recorded before the interrupt is present, the one in "
+            f"flight is not (wedged so far: {_WEDGED or 'none'})"
+        )
+        os._exit(0)
+
+    for s in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(s, _handler)
+        except (ValueError, OSError):  # pragma: no cover - non-main thread
+            pass
+
+
+def _run_budgeted(name: str, fn):
+    """Run one config under its time budget on a daemon thread. On
+    overrun the leg is ABANDONED (the thread cannot be killed — it
+    may be wedged inside a jit) and an honest entry records the
+    budget; _WEDGED makes the caller skip the remaining in-process
+    configs, since they would contend with the zombie leg."""
+    budget = _config_budget_s(name)
+    box: dict = {}
+
+    def run():
+        try:
+            box["out"] = fn()
+        except BaseException as e:  # report, never crash the bench
+            box["err"] = repr(e)[:400]
+
+    t = threading.Thread(target=run, daemon=True, name=f"bench-{name}")
+    t.start()
+    t.join(budget)
+    if t.is_alive():
+        _WEDGED.append(name)
+        return {
+            "rate": None,
+            "note": f"leg killed by its {budget:.0f}s budget "
+            "(abandoned on a daemon thread); later in-process "
+            "configs skipped to avoid contending with it",
+        }
+    if "err" in box:
+        return {"rate": None, "note": f"config failed: {box['err']}"}
+    return box["out"]
 
 
 def _ms(x):
@@ -226,14 +387,20 @@ def bench_kernel() -> dict:
     # code-review r5: a duplicated BENCH_N literal could mislabel)
     from cometbft_tpu.ops.pallas_ladder import (
         block_sublanes,
+        effective_block,
         pallas_enabled,
     )
 
-    ladder = (
-        f"pallas-s{block_sublanes()}"
+    # label with the EFFECTIVE sublane block the kernel actually runs
+    # (effective_block adjusts a non-dividing configured value, and
+    # returns None when no VMEM-safe blocking exists — the kernel then
+    # fell back to the XLA ladder; ADVICE r5 low)
+    eff = (
+        effective_block(block_sublanes(), N // 128)
         if (N % 128 == 0 and pallas_enabled(N))
-        else "xla"
+        else None
     )
+    ladder = f"pallas-s{eff}" if eff is not None else "xla"
     if ed.precomp_tuple_enabled() and N <= ed._precomp_max_lanes():
         ladder += "+precomp-tuple"
     return {
@@ -375,9 +542,10 @@ def _timed_with_backend(backend: str, fn, repeats: int = 5):
     benchmark).
 
     Backends: "tpu" FORCES the device path (min batch 1), "cpu" is the
-    host baseline, "auto" is the PRODUCTION policy — tpu backend with
-    the measured dispatch-crossover calibration deciding per batch
-    (crypto/batch._Calibration; VERDICT r2 weak #3)."""
+    SERIAL host baseline, "cpu-parallel" is the multi-core host plane
+    (crypto/parallel_verify), "auto" is the PRODUCTION policy — tpu
+    backend with the measured dispatch-crossover calibration deciding
+    per batch (crypto/batch._Calibration; VERDICT r2 weak #3)."""
     from cometbft_tpu.crypto import batch as crypto_batch
 
     if backend in ("tpu", "auto") and not _DEVICE_OK:
@@ -385,7 +553,7 @@ def _timed_with_backend(backend: str, fn, repeats: int = 5):
     old_backend = crypto_batch._default_backend
     old_min = crypto_batch._MIN_TPU_BATCH
     crypto_batch.set_default_backend(
-        "cpu" if backend == "cpu" else "tpu"
+        backend if backend in ("cpu", "cpu-parallel") else "tpu"
     )
     if backend == "tpu":
         crypto_batch.set_min_tpu_batch(1)
@@ -437,10 +605,12 @@ def bench_batch64() -> dict:
 
     tpu, _ = _timed_with_backend("tpu", once)
     cpu, _ = _timed_with_backend("cpu", once)
+    cpu_par, _ = _timed_with_backend("cpu-parallel", once)
     auto, _ = _timed_with_backend("auto", once)
     return {
         "tpu_ms": _ms(tpu),
         "cpu_ms": _ms(cpu),
+        "cpu_parallel_ms": _ms(cpu_par),
         "auto_ms": _ms(auto),
         "auto_path": _timed_with_backend.last_route,
         "vs_cpu": _ratio(cpu, auto),
@@ -460,10 +630,12 @@ def bench_commit150(gen, parts) -> dict:
 
     tpu, _ = _timed_with_backend("tpu", once)
     cpu, _ = _timed_with_backend("cpu", once)
+    cpu_par, _ = _timed_with_backend("cpu-parallel", once)
     auto, _ = _timed_with_backend("auto", once)
     return {
         "tpu_ms": _ms(tpu),
         "cpu_ms": _ms(cpu),
+        "cpu_parallel_ms": _ms(cpu_par),
         "auto_ms": _ms(auto),
         "auto_path": _timed_with_backend.last_route,
         "vs_cpu": _ratio(cpu, auto),
@@ -471,6 +643,53 @@ def bench_commit150(gen, parts) -> dict:
 
 
 # --- 4. 10k-block blocksync replay -------------------------------------
+
+
+def _verdict_parity() -> dict:
+    """Bit-identical-verdicts check for the ablation: the SAME lane
+    set (valid + forged + mutated lanes) through the serial cpu
+    backend and the parallel plane at several chunk sizes — verdict
+    lists must match element-for-element, with failures landing on
+    the exact forged indices."""
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.crypto.parallel_verify import ParallelVerifyEngine
+
+    rng = np.random.default_rng(23)
+    privs = [Ed25519PrivKey.from_seed(rng.bytes(32)) for _ in range(16)]
+    items = []
+    for i in range(600):
+        p = privs[i % len(privs)]
+        m = bytes(rng.bytes(110))
+        items.append((p.pub_key(), m, p.sign(m)))
+    forged = [3, 171, 599]
+    items[forged[0]] = (
+        items[forged[0]][0], items[forged[0]][1], bytes(64),
+    )
+    items[forged[1]] = (
+        items[forged[1]][0], b"mutated", items[forged[1]][2],
+    )
+    items[forged[2]] = (
+        privs[0].pub_key(), items[forged[2]][1], items[forged[2]][2],
+    )
+    serial = crypto_batch.CpuBatchVerifier()
+    for it in items:
+        serial.add(*it)
+    _, want = serial.verify()
+    chunk_targets_ms = (0.5, 4.0, 50.0)
+    for tgt in chunk_targets_ms:
+        eng = ParallelVerifyEngine(chunk_target_s=tgt / 1e3)
+        got = eng.verify(items)
+        eng.close()
+        if got != want:
+            return {"identical": False, "chunk_target_ms": tgt}
+    failed_indices = [i for i, v in enumerate(want) if not v]
+    return {
+        "identical": True,
+        "lanes": len(items),
+        "forged_lanes_flagged": failed_indices == forged,
+        "chunk_targets_ms": list(chunk_targets_ms),
+    }
 
 
 def bench_replay(gen, parts, n_blocks: int) -> dict:
@@ -517,43 +736,57 @@ def bench_replay(gen, parts, n_blocks: int) -> dict:
         return asyncio.run(main())
 
     if not _DEVICE_OK:
-        # HOST-ONLY mode (device wedged): the full-corpus replay on the
-        # production host pipeline is still the round's most load-
-        # bearing number — capture it rather than dropping the config
-        # (VERDICT r4 weak #2). Baseline = a window=2 slice,
-        # extrapolated: ONE block verified per pass (window-1 jobs),
-        # i.e. per-block commit verification with no coalescing — what
-        # the reference replay loop does (pool hands the executor one
-        # block at a time).
-        crypto_batch.set_default_backend("cpu")
+        # HOST-ONLY mode (device wedged): the full-corpus replay on
+        # the production host pipeline is still the round's most
+        # load-bearing number — capture it rather than dropping the
+        # config (VERDICT r4 weak #2). The ablation the host plane
+        # demands (docs/PERF.md): the SAME windowed pipeline under
+        # cpu-parallel (production) vs serial cpu, both full-length.
+        # The old window=2 per-block sequential baseline is implied by
+        # the serial leg — window coalescing is host-cost-neutral
+        # (169.5 s vs 170.0 s, r5 measurement), so serial windowed ≈
+        # per-block sequential; BENCH_SEQ_FULL=1 still measures it
+        # explicitly when the budget allows.
+        from cometbft_tpu.crypto.parallel_verify import engine
+
+        crypto_batch.set_default_backend("cpu-parallel")
         replay(min(129, n_blocks), 128)  # warm stores/caches
-        host_dt, pipe_stats = replay(n_blocks, 128)
-        # honest baseline = the FULL corpus at window=2 (measured r5:
-        # a 300-block slice extrapolates to 139 s where the real full
-        # run is 169.5 s — late-chain costs grow, so slices flatter
-        # the baseline). BENCH_SEQ_FULL=0 restores the cheap slice
-        # (with its bias named) when the budget is tight.
-        if os.environ.get("BENCH_SEQ_FULL", "1") == "1":
+        par_dt, pipe_stats = replay(n_blocks, 128)
+        crypto_batch.set_default_backend("cpu")
+        ser_dt, _ = replay(n_blocks, 128)
+        seq = {}
+        if os.environ.get("BENCH_SEQ_FULL", "0") == "1":
             seq_dt = replay(n_blocks, 2)[0]
-            seq_note = "full-length window=2 (per-block verify)"
-        else:
-            seq_slice = min(300, n_blocks)
-            seq_dt = replay(seq_slice, 2)[0] * (n_blocks / seq_slice)
-            seq_note = (
-                "300-block window=2 slice extrapolated — "
-                "UNDERSTATES late-chain costs by ~20% (r5 measurement)"
-            )
+            seq = {
+                "sequential_wall_s": round(seq_dt, 2),
+                "sequential_note": (
+                    "full-length window=2 per-block serial verify"
+                ),
+            }
+        # production host default stays the parallel plane
+        crypto_batch.set_default_backend("cpu-parallel")
         return {
             "blocks": n_blocks,
             "validators": N_VALS,
             "mode": "host-only",
-            "wall_s": round(host_dt, 2),
-            "blocks_per_s": round(n_blocks / host_dt, 1),
-            "sigs_per_s": round(n_sigs / host_dt, 1),
-            "sequential_wall_s": round(seq_dt, 2),
-            "sequential_note": seq_note,
-            "vs_sequential": round(seq_dt / host_dt, 2),
+            "backend": "cpu-parallel",
+            "wall_s": round(par_dt, 2),
+            "blocks_per_s": round(n_blocks / par_dt, 1),
+            "sigs_per_s": round(n_sigs / par_dt, 1),
+            "serial_cpu_wall_s": round(ser_dt, 2),
+            "serial_cpu_blocks_per_s": round(n_blocks / ser_dt, 1),
+            "parallel_vs_serial": round(ser_dt / par_dt, 2),
+            "verdict_parity": _verdict_parity(),
+            "cores": os.cpu_count(),
+            "verify_plane": engine().stats(),
             "pipeline": pipe_stats,
+            "note": (
+                "serial baseline = the same windowed pipeline on the "
+                "serial cpu backend (window coalescing is host-cost-"
+                "neutral, PERF.md r5, so this also stands in for the "
+                "per-block sequential baseline)"
+            ),
+            **seq,
         }
 
     # TPU path: full corpus, wide windows (128 blocks x 150 sigs per
@@ -816,6 +1049,8 @@ def bench_mixed() -> dict:
 
 def main() -> None:
     t_start = time.time()
+    _CKPT["t_start"] = t_start
+    _install_signal_handlers()
     _setup_jax()
 
     which = os.environ.get("BENCH_CONFIGS", "all")
@@ -832,7 +1067,21 @@ def main() -> None:
         if which == "all"
         else set(which.split(","))
     )
-    configs = {}
+    configs = _CKPT["configs"]
+
+    def run_config(name: str, fn) -> None:
+        """One budgeted, checkpointed config (see _run_budgeted)."""
+        if _WEDGED:
+            _record(
+                name,
+                {
+                    "rate": None,
+                    "note": "skipped: earlier wedged leg(s) "
+                    f"{_WEDGED} still hold the process",
+                },
+            )
+            return
+        _record(name, _run_budgeted(name, fn))
 
     global _DEVICE_OK
     _DEVICE_OK = _probe_device()
@@ -842,16 +1091,21 @@ def main() -> None:
         # degraded line than a driver-timeout blank. Only the kernel
         # configs are device-only (VERDICT r4 weak #2: the host replay
         # and pipeline numbers must be driver-captured even when the
-        # platform is down).
-        configs["device"] = {
-            "available": False,
-            "note": f"device probe (tiny jit) exceeded "
-            f"{_probe_timeout_s():.0f}s — platform "
-            "wedged/unreachable; device configs skipped",
-        }
+        # platform is down). The host default is the PARALLEL plane —
+        # the production policy this round (docs/PERF.md host plane);
+        # the serial cpu backend stays the ablation baseline.
+        _record(
+            "device",
+            {
+                "available": False,
+                "note": f"device probe (tiny jit) exceeded "
+                f"{_probe_timeout_s():.0f}s — platform "
+                "wedged/unreachable; device configs skipped",
+            },
+        )
         from cometbft_tpu.crypto import batch as crypto_batch
 
-        crypto_batch.set_default_backend("cpu")
+        crypto_batch.set_default_backend("cpu-parallel")
         todo -= {"kernel"}
 
     # soft budget for the OPTIONAL host configs in degraded mode: the
@@ -877,54 +1131,87 @@ def main() -> None:
             prev = os.environ.get("GRAFT_PALLAS")
             os.environ["GRAFT_PALLAS"] = "0"
             try:
-                configs["kernel"] = bench_kernel()
+                run_config("kernel", bench_kernel)
             finally:
                 if prev is None:
                     os.environ.pop("GRAFT_PALLAS", None)
                 else:
                     os.environ["GRAFT_PALLAS"] = prev
     need_corpus = todo & {"commit150", "replay", "bisect"}
+    corpus_parts = None
+    if need_corpus and _WEDGED:
+        # same skip policy run_config applies: a corpus build would
+        # contend with the zombie leg for up to an hour and its
+        # consumers below would be skipped anyway
+        for name in sorted(need_corpus):
+            _record(
+                name,
+                {
+                    "rate": None,
+                    "note": "skipped: earlier wedged leg(s) "
+                    f"{_WEDGED} still hold the process",
+                },
+            )
+        need_corpus = set()
     if need_corpus:
         n_blocks = int(os.environ.get("BENCH_REPLAY_BLOCKS", "10000"))
-        gen, privs, parts = _corpus(n_blocks)
-        if "commit150" in todo:
-            configs["commit150"] = bench_commit150(gen, parts)
-        if "replay" in todo:
-            configs["replay"] = bench_replay(gen, parts, n_blocks)
-        if "bisect" in todo:
-            configs["bisect"] = bench_bisect(gen, privs)
-        parts.close_stores()
+        corpus_box = _run_budgeted(
+            "corpus", lambda: _corpus(n_blocks)
+        )
+        if not isinstance(corpus_box, tuple):
+            # budget overrun / failure: the corpus configs cannot run
+            for name in sorted(need_corpus):
+                _record(name, dict(corpus_box))
+        else:
+            gen, privs, corpus_parts = corpus_box
+            if "commit150" in todo:
+                run_config(
+                    "commit150",
+                    lambda: bench_commit150(gen, corpus_parts),
+                )
+            if "replay" in todo:
+                run_config(
+                    "replay",
+                    lambda: bench_replay(
+                        gen, corpus_parts, n_blocks
+                    ),
+                )
+            if "bisect" in todo:
+                run_config("bisect", lambda: bench_bisect(gen, privs))
+            if not _WEDGED:
+                corpus_parts.close_stores()
     if "batch64" in todo:
-        configs["batch64"] = bench_batch64()
+        run_config("batch64", bench_batch64)
     budget_skip = {
         "skipped": f"host budget ({host_budget_s:.0f}s) "
         "exhausted before this config"
     }
     if "pipeline" in todo:
         if not budget_left():
-            configs["pipeline"] = dict(budget_skip)
+            _record("pipeline", dict(budget_skip))
         elif _DEVICE_OK:
-            configs["pipeline"] = bench_pipeline()
+            run_config("pipeline", bench_pipeline)
         else:
             # the in-process jax platform is the WEDGED axon backend;
             # the XLA-CPU kernel leg must run in a cpu-pinned child
-            configs["pipeline"] = _subprocess_config(
+            entry = _subprocess_config(
                 "pipeline",
                 {"BENCH_FORCE_CPU": "1"},
                 int(os.environ.get("BENCH_PIPELINE_BUDGET_S", "900")),
                 "host pipeline leg (XLA-CPU compact kernel)",
             )
-            configs["pipeline"].setdefault(
+            entry.setdefault(
                 "note",
                 "XLA-CPU compact-kernel leg (device down): overlap "
                 "measures async-dispatch amortization on host, not "
                 "the device link",
             )
+            _record("pipeline", entry)
     if "mixed" in todo:
         if budget_left():
-            configs["mixed"] = bench_mixed()
+            run_config("mixed", bench_mixed)
         else:
-            configs["mixed"] = dict(budget_skip)
+            _record("mixed", dict(budget_skip))
     # the experimental kernel legs run LAST: each budgeted subprocess
     # may burn many minutes on a cold Mosaic compile, and the proven
     # configs above must be recorded before that risk is taken. The
@@ -966,65 +1253,36 @@ def main() -> None:
         ]
         for name, envx, what, gated_off in legs:
             if gated_off:
-                configs[name] = {
-                    "rate": None,
-                    "note": f"leg gated off by env: {what}",
-                }
+                _record(
+                    name,
+                    {
+                        "rate": None,
+                        "note": f"leg gated off by env: {what}",
+                    },
+                )
                 continue
             if time.time() - t_extra > extra_wall:
-                configs[name] = {
-                    "rate": None,
-                    "note": f"extra-legs wall budget "
-                    f"({extra_wall:.0f}s) exhausted before: {what}",
-                }
+                _record(
+                    name,
+                    {
+                        "rate": None,
+                        "note": f"extra-legs wall budget "
+                        f"({extra_wall:.0f}s) exhausted before: "
+                        f"{what}",
+                    },
+                )
                 continue
             inner = _subprocess_config("kernel", envx, leg_budget, what)
             if inner.get("rate") is not None or "note" not in inner:
                 inner["note"] = what
-            configs[name] = inner
+            _record(name, inner)
 
-    # headline = the best of every measured kernel leg (all recorded:
-    # detail.configs carries the full ablation either way; each leg
-    # self-reports the ladder it actually measured via bench_kernel's
-    # ladder_backend field)
-    headline = configs.get("kernel", {})
-    for leg_name in ("kernel_pallas_default", "kernel_precomp_tuple"):
-        leg = configs.get(leg_name) or {}
-        if (leg.get("rate") or 0) > (headline.get("rate") or 0):
-            headline = leg
-    metric = "ed25519_batch_verify_throughput"
-    value = headline.get("rate")
-    unit = "verifies/sec"
-    vs_baseline = headline.get("vs_cpu")
-    rep = configs.get("replay") or {}
-    if (
-        value is None
-        and rep.get("wall_s")
-        and rep.get("mode") == "host-only"
-    ):
-        # device headline unavailable: the HOST replay throughput is
-        # the round's measured number — record it as the headline
-        # rather than a null (VERDICT r4 weak #2); detail carries the
-        # device outage note. Gated on mode so a device-path replay is
-        # never mislabeled as host
-        metric = "blocksync_replay_throughput_host"
-        value = rep.get("blocks_per_s")
-        unit = "blocks/sec (10k-block x 150-val replay, host pipeline)"
-        vs_baseline = rep.get("vs_sequential")
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": value,
-                "unit": unit,
-                "vs_baseline": vs_baseline,
-                "detail": {
-                    "configs": configs,
-                    "total_bench_s": round(time.time() - t_start, 1),
-                },
-            }
-        )
-    )
+    # headline = the best of every measured kernel leg, falling back
+    # to the host replay throughput in degraded mode (assembled by
+    # _final_payload — the same function the checkpoint and the
+    # signal handler use, so a killed run prints the identical line
+    # shape with whatever landed)
+    _emit_final()
 
 
 if __name__ == "__main__":

@@ -43,6 +43,36 @@ class MissingExtendedCommit(ValueError):
     height: possibly an honest gap, never a verification failure."""
 
 
+class _PrefixErrors:
+    """First ``n`` per-job errors of a wider coalesced handle (the
+    lookahead covered more heights than this pass applies)."""
+
+    __slots__ = ("_h", "_n")
+
+    def __init__(self, handle, n: int) -> None:
+        self._h = handle
+        self._n = n
+
+    def result(self):
+        return self._h.result()[: self._n]
+
+
+class _SplicedErrors:
+    """Lookahead verdicts for the first ``n`` jobs + a fresh dispatch
+    for the remainder, in job order (the pool refilled after the
+    lookahead was sized)."""
+
+    __slots__ = ("_a", "_b", "_n")
+
+    def __init__(self, pre, rest, n: int) -> None:
+        self._a = pre
+        self._b = rest
+        self._n = n
+
+    def result(self):
+        return self._a.result()[: self._n] + self._b.result()
+
+
 class BlockSyncReactor:
     def __init__(
         self,
@@ -137,7 +167,23 @@ class BlockSyncReactor:
                 await self.pool.wait_for_block()
                 continue
             try:
-                applied = self._process_window(window)
+                if self.ingestor is None:
+                    # overlapped path: the blocking verify wait runs
+                    # in an executor, so the loop stays responsive
+                    # (and window K's host apply overlaps window
+                    # K+1's pool verification — docs/PERF.md host
+                    # plane)
+                    applied = await self._process_window_overlapped(
+                        window
+                    )
+                else:
+                    # adaptive mode: consensus shares this loop, and
+                    # the blocking pass serializes against it — an
+                    # await inside the pass would let consensus
+                    # commit mid-window against the pass's state view
+                    applied = self._process_window(window)
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 traceback.print_exc()
                 applied = 0
@@ -148,6 +194,43 @@ class BlockSyncReactor:
     def _process_window(self, window) -> int:
         """Verify all verifiable heights in the window with ONE batch
         dispatch, then apply them in order. Returns #applied.
+
+        Blocking form (tests, adaptive/ingestor mode); the pool
+        routine's plain path goes through _process_window_overlapped,
+        which parks the verify wait in an executor instead."""
+        prep = self._prepare_window(window)
+        if prep is None:
+            return 0
+        window, jobs, handle = prep
+        errors = handle.result()
+        pre = self._predispatch_lookahead(len(jobs))
+        return self._apply_window(window, jobs, errors, pre)
+
+    async def _process_window_overlapped(self, window) -> int:
+        """Same pass as _process_window, but the blocking verify wait
+        runs in the default executor: the event loop keeps serving
+        peer fetches/heartbeats while the parallel host plane (or the
+        device) chews on the window's signatures, and the lookahead
+        window pre-dispatched by _prepare_window verifies on pool
+        threads WHILE this pass's host apply runs — overlap with no
+        device required."""
+        prep = self._prepare_window(window)
+        if prep is None:
+            return 0
+        window, jobs, handle = prep
+        errors = await asyncio.get_running_loop().run_in_executor(
+            None, handle.result
+        )
+        pre = self._predispatch_lookahead(len(jobs))
+        return self._apply_window(window, jobs, errors, pre)
+
+    def _prepare_window(self, window):
+        """Dispatch (or reuse) the window's coalesced signature batch.
+        Returns None when nothing is verifiable this pass, else
+        (window, jobs, handle). The lookahead is NOT dispatched here:
+        the caller issues _predispatch_lookahead after this handle's
+        verdicts resolve, when the pool reflects the refill that
+        happened during the wait.
 
         The batch uses the CURRENT state's validator set, so it must
         stop at the first height whose header advertises a different
@@ -166,7 +249,7 @@ class BlockSyncReactor:
                 self.blocks_applied += 1
                 window = window[1:]
             if len(window) < 2:
-                return 0
+                return None
         # take (and clear) the pre-dispatched handle FIRST: every exit
         # from this pass either consumes it or drops it — a handle
         # must never survive a pass whose window it was not checked
@@ -183,47 +266,167 @@ class BlockSyncReactor:
                 # derives -> it cannot validate; refetch elsewhere
                 h, _, peer = window[0]
                 self.pool.redo_request(h, peer)
-            return 0
+            return None
         # Pipelined verify: reuse the handle pre-dispatched on the
-        # previous pass when its inputs are EXACTLY this window — the
-        # key is CONTENT-based (valset hash + every involved block's
-        # hash), so redo/ban refetches, valset changes and pool
-        # reshuffles all miss it and a wrong verdict can never be
-        # consumed.
-        if inflight is not None and inflight[0] == key:
-            handle = inflight[1]
-            self.pipeline_stats["reused"] += 1
-        else:
+        # previous pass when its inputs CONTENT-match this window —
+        # the key is content-based (valset hash + every involved
+        # block's hash), so redo/ban refetches, valset changes and
+        # pool reshuffles all miss it and a wrong verdict can never
+        # be consumed. Length drift (the pool refills between the
+        # lookahead peek and this pass) reuses the matching prefix
+        # and dispatches only the remainder (_reuse_inflight).
+        handle = (
+            self._reuse_inflight(inflight, jobs, key)
+            if inflight is not None
+            else None
+        )
+        if handle is None:
             if inflight is not None:
                 self.pipeline_stats["discarded"] += 1
             handle = verify_commits_coalesced_async(
                 self.state.chain_id, jobs, cache=self.sig_cache
             )
             self.pipeline_stats["dispatched"] += 1
-        # Pre-dispatch the NEXT window's batch before applying this
-        # one: the device verifies window K+1 while the host decodes/
-        # applies window K (docs/PERF.md "overlapped replay
-        # dispatch"). Built against the pre-apply valset — sound
-        # because only heights whose headers claim the SAME
-        # validators_hash enter a batch, and the key check above
-        # re-validates against the post-apply state before reuse.
-        pre = None
-        tail = window[len(jobs):]
-        if len(tail) >= 2:
-            pre_jobs, pre_key = self._build_jobs(
-                tail, vals_hash, self.window - 1
-            )
-            if pre_jobs:
-                pre = (
-                    pre_key,
-                    verify_commits_coalesced_async(
-                        self.state.chain_id,
-                        pre_jobs,
-                        cache=self.sig_cache,
-                    ),
+        return window, jobs, handle
+
+    def _reuse_inflight(self, inflight, jobs, key):
+        """Content-match the pre-dispatched handle against this
+        pass's jobs, tolerating LENGTH drift in either direction
+        (each coalesced job is independent, so verdict prefixes
+        compose):
+
+        - lookahead ⊇ window: consume the prefix of its verdicts;
+        - lookahead ⊂ window (the pool refilled after the lookahead
+          peek): consume ALL its verdicts and dispatch a fresh batch
+          for just the remainder, spliced in order.
+
+        Any content mismatch — a refetched block, a valset change —
+        returns None and the caller drops the handle. Returns a
+        result()-bearing handle or None."""
+        pre_key, pre_handle = inflight
+        if pre_key[0] != key[0]:
+            return None
+        pre_hs, hs = pre_key[1], key[1]
+        if len(hs) <= len(pre_hs):
+            if pre_hs[: len(hs)] != hs:
+                return None
+            self.pipeline_stats["reused"] += 1
+            if len(hs) == len(pre_hs):
+                return pre_handle
+            return _PrefixErrors(pre_handle, len(hs) - 1)
+        if hs[: len(pre_hs)] != pre_hs:
+            return None
+        n_pre = len(pre_hs) - 1
+        rest_handle = verify_commits_coalesced_async(
+            self.state.chain_id, jobs[n_pre:], cache=self.sig_cache
+        )
+        self.pipeline_stats["reused"] += 1
+        self.pipeline_stats["dispatched"] += 1
+        return _SplicedErrors(pre_handle, rest_handle, n_pre)
+
+    def _predispatch_lookahead(self, n_skip: int):
+        """Dispatch the NEXT window's batch before applying this one:
+        the verification plane (device, or the host pool) chews on
+        window K+1 while the host decodes/applies window K
+        (docs/PERF.md "overlapped replay dispatch"). Peeked FRESH
+        here — after this window's verdicts resolved — so the
+        lookahead covers the blocks the requesters pulled in WHILE
+        the verify was pending; peeking at prepare time instead sizes
+        the lookahead to the pre-refill pool and the next pass's
+        (longer) window misses the content key on every pass. Built
+        against the pre-apply valset — sound because only heights
+        whose headers claim the SAME validators_hash enter a batch,
+        and the reuse key check re-validates against the post-apply
+        state before any verdict is consumed."""
+        tail = self.pool.peek_window(self.window * 2)[n_skip:]
+        if len(tail) < 2:
+            return None
+        pre_jobs, pre_key = self._build_jobs(
+            tail, self.state.validators.hash(), self.window - 1
+        )
+        if not pre_jobs:
+            return None
+        self.pipeline_stats["predispatched"] += 1
+        return (
+            pre_key,
+            verify_commits_coalesced_async(
+                self.state.chain_id, pre_jobs, cache=self.sig_cache
+            ),
+        )
+
+    def _canonical_parts(self, blk, nxt):
+        """Part set for ``blk`` — from the peer's wire bytes when they
+        produce the part-set header the validators actually signed
+        (saves a full re-encode), else from our canonical encoding.
+
+        A peer could serve a NON-canonical encoding of the same block
+        (permissive parse) to poison the store; on mismatch every
+        memoized wire-bytes shortcut downstream (store save_block
+        persists commit._raw_bytes for SC:/C: records) must re-encode
+        canonically too, so the memos are dropped."""
+        signed_psh = nxt.last_commit.block_id.part_set_header
+        raw = getattr(blk, "_raw_bytes", None)
+        if raw is not None:
+            parts = T.PartSet.from_data(raw)
+            if parts.header.hash == signed_psh.hash:
+                return parts
+            for o in (blk, blk.last_commit):
+                if hasattr(o, "_raw_bytes"):
+                    del o._raw_bytes
+        return T.PartSet.from_data(codec.encode_block(blk))
+
+    def _apply_window(self, window, jobs, errors, pre) -> int:
+        """Apply the window's verified blocks in order; returns
+        #applied. ``errors`` are the per-job verdicts from the
+        coalesced batch (resolved by the caller, possibly in an
+        executor)."""
+        # Stage the window's store writes and flush them in ONE
+        # db.write_batch BEFORE any apply: the commit batch already
+        # vouched for every staged block (errors[i] is None ⇒ +2/3 of
+        # the valset signed this exact content), and store-ahead-of-
+        # state is the crash direction the handshake replays back
+        # (consensus/replay.py) — whereas deferring writes past the
+        # applies would leave the state ahead of the store, which no
+        # recovery path handles. A block that later fails
+        # validate_block (a fork — the reference panics there) stays
+        # persisted; the refetch loop skips re-saving via the height
+        # guard below, and content is hash-pinned by the commit either
+        # way. The ingestor path owns its own persistence.
+        parts_by_idx = {}
+        ec_by_idx = {}
+        if self.ingestor is None:
+            entries = []
+            for i in range(len(jobs)):
+                if errors[i] is not None:
+                    break
+                h, blk, peer_i = window[i]
+                _, nxt, _ = window[i + 1]
+                parts = self._canonical_parts(blk, nxt)
+                parts_by_idx[i] = parts
+                # the EC requirement gates persistence: a block whose
+                # extended commit is missing/invalid must never enter
+                # the store bare (a node serving a bare tip block
+                # stalls future joiners — the exact property the
+                # at-tip refusal below protects)
+                enabled = (
+                    self.state.consensus_params.vote_extensions_enabled(
+                        h
+                    )
                 )
-                self.pipeline_stats["predispatched"] += 1
-        errors = handle.result()
+                try:
+                    ec_bytes = self._check_extended_commit(
+                        h, blk, peer_i
+                    )
+                except Exception:
+                    # missing/invalid EC: the apply loop below re-runs
+                    # the check at this height and owns the tolerance/
+                    # redo logic; nothing at or past it is staged
+                    break
+                ec_by_idx[i] = (enabled, ec_bytes)
+                if self.block_store.height() < h:
+                    entries.append((blk, parts, nxt.last_commit))
+            if entries:
+                self.block_store.save_block_batch(entries)
         applied = 0
         for i, _job in enumerate(jobs):
             h, blk, peer = window[i]
@@ -252,7 +455,36 @@ class BlockSyncReactor:
                 self.pool.redo_request(h, peer)
                 break
             try:
-                ec_bytes = self._check_extended_commit(h, blk, peer)
+                cached = ec_by_idx.get(i)
+                if cached is not None and cached[0] == (
+                    self.state.consensus_params.vote_extensions_enabled(
+                        h
+                    )
+                ):
+                    # verified during window staging, and the
+                    # enablement the check assumed still holds under
+                    # the evolved state
+                    ec_bytes = cached[1]
+                else:
+                    if cached is not None:
+                        # consensus params moved mid-window: the
+                        # staged flush persisted this height (and the
+                        # rest of the window) under an enablement
+                        # that no longer holds — roll the UNAPPLIED
+                        # store tip back to h-1 before re-deciding,
+                        # so a block whose EC requirement just
+                        # flipped on can never outlive this pass bare
+                        # (the heights removed are exactly the
+                        # staged-not-yet-applied ones; re-applies
+                        # fall back to per-block save below)
+                        while self.block_store.height() >= h:
+                            self.block_store.delete_latest_block()
+                    # not staged (an EC decision was pending at this
+                    # height) or params moved: run the full check
+                    # against the CURRENT state
+                    ec_bytes = self._check_extended_commit(
+                        h, blk, peer
+                    )
             except MissingExtendedCommit as e:
                 served = self._ec_misses.setdefault(h, set())
                 served.add(peer)
@@ -319,29 +551,9 @@ class BlockSyncReactor:
             # on "peer omitted extended commit"
             if ec_bytes and not self.block_store.load_extended_commit(h):
                 self.block_store.save_extended_commit(h, ec_bytes)
-            # Build parts from the peer's wire bytes (saves a full
-            # re-encode) — but only if they produce the part-set header
-            # the validators actually signed: a peer could serve a
-            # NON-canonical encoding of the same block (permissive
-            # parse) to poison the store. On mismatch fall back to our
-            # canonical encoding, as before the memoization.
-            signed_psh = nxt.last_commit.block_id.part_set_header
-            raw = getattr(blk, "_raw_bytes", None)
-            parts = None
-            if raw is not None:
-                parts = T.PartSet.from_data(raw)
-                if parts.header.hash != signed_psh.hash:
-                    parts = None
-                    # the peer's encoding was non-canonical: every
-                    # memoized wire-bytes shortcut downstream (store
-                    # save_block persists commit._raw_bytes for SC:/C:
-                    # records) must re-encode canonically too, or the
-                    # store ends up holding the poisoned encoding
-                    for o in (blk, blk.last_commit):
-                        if hasattr(o, "_raw_bytes"):
-                            del o._raw_bytes
+            parts = parts_by_idx.get(i)
             if parts is None:
-                parts = T.PartSet.from_data(codec.encode_block(blk))
+                parts = self._canonical_parts(blk, nxt)
             if self.ingestor is not None:
                 # fork: adaptive sync — pipeline the verified block
                 # straight into the consensus state machine. The
@@ -363,6 +575,10 @@ class BlockSyncReactor:
                     # finish and resume on the next pass
                     break
             else:
+                # usually persisted by the window-batch flush above
+                # (or an earlier pass); blocks at/behind an EC
+                # decision made during THIS loop (e.g. a tolerated
+                # bare apply) were not staged — persist individually
                 if self.block_store.height() < h:
                     self.block_store.save_block(
                         blk, parts, nxt.last_commit

@@ -164,9 +164,21 @@ class InstrumentationConfig:
 
 @dataclass
 class CryptoConfig:
-    """TPU-native addition: signature-verification backend knobs."""
+    """TPU-native addition: signature-verification backend knobs.
 
-    batch_backend: str = "tpu"  # tpu | cpu
+    batch_backend names an entry in the crypto/batch.py backend
+    registry: "tpu" (device lanes, host-routed batches ride the
+    parallel plane), "cpu" (serial host baseline), "cpu-parallel"
+    (multi-core host plane, crypto/parallel_verify — the production
+    host policy when no device is reachable). Empty (the default)
+    inherits the process-wide default (crypto/batch.set_default_
+    backend — "tpu" unless the embedder changed it); a non-empty
+    value is applied at node build (node/inprocess.build_node). The
+    parallel plane's own knobs are env-based: GRAFT_VERIFY_WORKERS /
+    _TIER / _CHUNK_TARGET_MS / _MIN_PARALLEL (docs/PERF.md host
+    plane)."""
+
+    batch_backend: str = ""  # "" (inherit) | tpu | cpu | cpu-parallel
     min_batch_for_tpu: int = 2
     coalesce_window_ms: float = 2.0
     max_lanes: int = 131072

@@ -12,6 +12,18 @@ Mirrors the reference's injectable ``crypto.BatchVerifier``
   reference's random-linear-combination batch there is no second
   fall-back pass on failure.
 
+- ``CpuParallelBatchVerifier`` — the multi-core host plane
+  (crypto/parallel_verify): verification lanes fan out in calibrated
+  chunks over a persistent worker pool, verdicts merge in input
+  order. Bit-identical to CpuBatchVerifier; it IS the host path worth
+  benchmarking against the device.
+
+Backends live in a registry (``register_backend``) so config knobs,
+the bench ablation and tests select by name; the TPU verifier's
+host-routed lanes also ride the parallel plane, so every coalesced
+caller (types/validation windows, blocksync replay, light client,
+consensus vote sets) gets multi-core host verification for free.
+
 Mixed-curve sets (north-star config #5): ed25519 items go to the TPU
 lanes, anything else verifies on host; verdicts are re-interleaved.
 The reference instead abandons batching entirely when key types are
@@ -60,6 +72,13 @@ class _Calibration:
     _COMPILE_CUTOFF_S = 10.0
     _ALPHA = 0.4
     EXPLORE_EVERY = 256
+    # Samples below this floor are enqueue-time artifacts, not real
+    # dispatch walls: block_until_ready does not block through the
+    # axon tunnel (ADVICE r5 medium), so a non-blocking wait records
+    # a near-zero wall that would pull flat_s optimistic and keep
+    # misrouting small commits to a ~120 ms link. No genuine
+    # dispatch+fetch completes under 200us even on a local chip.
+    _WALL_FLOOR_S = 2e-4
 
     def __init__(self) -> None:
         self.host_s = 80e-6     # ~80us/sig OpenSSL (measured r2)
@@ -76,7 +95,9 @@ class _Calibration:
             self.host_s += self._ALPHA * (wall / n - self.host_s)
 
     def observe_device(self, n: int, wall: float) -> None:
-        if n <= 0 or not (0 < wall < self._COMPILE_CUTOFF_S):
+        if n <= 0 or not (
+            self._WALL_FLOOR_S <= wall < self._COMPILE_CUTOFF_S
+        ):
             return
         with self._lock:
             # The FIRST sample for a process often includes an XLA
@@ -184,6 +205,32 @@ class _PendingVerdicts:
         return all(oks) and bool(oks), oks
 
 
+class _PendingHostVerdicts:
+    """Host-routed async batch: ed25519 lanes in flight on the
+    parallel plane, other lanes already resolved in ``oks``. The
+    pool-completion wall (recorded by the handle's done callback, NOT
+    at result() time) feeds the host-cost EWMA, so a caller that
+    overlaps long host work before resolving cannot inflate the
+    observed host cost — the mirror of the device watcher's concern
+    (_PendingVerdicts below)."""
+
+    __slots__ = ("_handle", "_ed_idx", "_oks")
+
+    def __init__(self, handle, ed_idx, oks) -> None:
+        self._handle = handle
+        self._ed_idx = ed_idx
+        self._oks = oks
+
+    def result(self) -> Tuple[bool, List[bool]]:
+        oks = self._oks
+        for i, v in zip(self._ed_idx, self._handle.result()):
+            oks[i] = v
+        wall = self._handle.wall()
+        if wall:
+            calibration.observe_host(len(self._ed_idx), wall)
+        return all(oks) and bool(oks), oks
+
+
 class BatchVerifier:
     """Accumulate signatures, verify all at once.
 
@@ -211,6 +258,9 @@ class BatchVerifier:
 
 
 class CpuBatchVerifier(BatchVerifier):
+    """Sequential host verification — the correctness baseline and the
+    serial leg of the bench ablation (docs/PERF.md host plane)."""
+
     def __init__(self) -> None:
         self.items: List[Tuple[PubKey, bytes, bytes]] = []
 
@@ -220,6 +270,50 @@ class CpuBatchVerifier(BatchVerifier):
     def verify(self) -> Tuple[bool, List[bool]]:
         oks = [pk.verify(msg, sig) for pk, msg, sig in self.items]
         return all(oks) and bool(oks), oks
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+
+class _PendingParallelVerdicts:
+    """In-flight parallel-plane batch behind the async-handle
+    interface (``result()`` blocks for the pool and merges)."""
+
+    __slots__ = ("_handle",)
+
+    def __init__(self, handle) -> None:
+        self._handle = handle
+
+    def result(self) -> Tuple[bool, List[bool]]:
+        oks = self._handle.result()
+        return all(oks) and bool(oks), oks
+
+
+class CpuParallelBatchVerifier(BatchVerifier):
+    """Multi-core host plane: fans lanes over the persistent worker
+    pool (crypto/parallel_verify.engine()); verdicts are bit-identical
+    to CpuBatchVerifier and order-stable. verify_async() genuinely
+    enqueues — the blocksync window pipeline overlaps window K's host
+    apply with window K+1's verification even with no device."""
+
+    def __init__(self) -> None:
+        self.items: List[Tuple[PubKey, bytes, bytes]] = []
+
+    def add(self, pk: PubKey, msg: bytes, sig: bytes) -> None:
+        self.items.append((pk, msg, sig))
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        from .parallel_verify import engine
+
+        oks = engine().verify(self.items)
+        return all(oks) and bool(oks), oks
+
+    def verify_async(self):
+        from .parallel_verify import engine
+
+        return _PendingParallelVerdicts(
+            engine().verify_async(self.items)
+        )
 
     def __len__(self) -> int:
         return len(self.items)
@@ -272,15 +366,22 @@ class TpuBatchVerifier(BatchVerifier):
         return ed_idx, ed_items, other_idx, use_device
 
     def _host_lanes(self, oks, ed_idx, other_idx, ed_on_host: bool):
-        if ed_on_host:
+        """Host-routed lanes ride the multi-core plane: ed25519 lanes
+        fan out over the persistent pool (crypto/parallel_verify); the
+        rare non-ed lanes verify inline. observe_host feeds the
+        PARALLEL wall — routing must compare the device against the
+        host path's real (multi-core) cost, not one core's."""
+        if ed_on_host and ed_idx:
+            from .parallel_verify import engine
+
             t0 = time.perf_counter()
-            for i in ed_idx:
-                pk, msg, sig = self.items[i]
-                oks[i] = pk.verify(msg, sig)
-            if ed_idx:
-                calibration.observe_host(
-                    len(ed_idx), time.perf_counter() - t0
-                )
+            verdicts = engine().verify(
+                [self.items[i] for i in ed_idx]
+            )
+            wall = time.perf_counter() - t0
+            for i, v in zip(ed_idx, verdicts):
+                oks[i] = v
+            calibration.observe_host(len(ed_idx), wall)
         for i in other_idx:
             pk, msg, sig = self.items[i]
             oks[i] = pk.verify(msg, sig)
@@ -318,8 +419,24 @@ class TpuBatchVerifier(BatchVerifier):
         ed_idx, ed_items, other_idx, use_device = self._route()
         oks = [False] * len(self.items)
         if not use_device:
-            self._host_lanes(oks, ed_idx, other_idx, True)
-            return ResolvedVerdicts(all(oks) and bool(oks), oks)
+            # host route: enqueue ed lanes on the parallel plane and
+            # hand back a PENDING handle — the caller's host work
+            # (window decode/apply) overlaps pool verification even
+            # with no device in the picture
+            for i in other_idx:
+                pk, msg, sig = self.items[i]
+                oks[i] = pk.verify(msg, sig)
+            if not ed_idx:
+                return ResolvedVerdicts(all(oks) and bool(oks), oks)
+            from .parallel_verify import engine
+
+            return _PendingHostVerdicts(
+                engine().verify_async(
+                    [self.items[i] for i in ed_idx]
+                ),
+                ed_idx,
+                oks,
+            )
         from ..ops import ed25519 as _ed
 
         t0 = time.perf_counter()
@@ -328,7 +445,15 @@ class TpuBatchVerifier(BatchVerifier):
 
         def _observe_ready():
             try:
-                handle.wait()
+                # wait_fetch, not wait(): block_until_ready does not
+                # block through the axon tunnel (ADVICE r5 medium —
+                # exactly the environment the BENCH_r05 misrouting
+                # occurred in), so readiness is observed via a minimal
+                # 1-element result fetch that must genuinely
+                # round-trip. observe_device's wall floor rejects any
+                # residual non-blocking sample. (getattr: tolerate
+                # injected handles that only model the old surface)
+                getattr(handle, "wait_fetch", handle.wait)()
             except Exception:
                 return
             calibration.observe_device(
@@ -343,11 +468,33 @@ class TpuBatchVerifier(BatchVerifier):
 _default_backend = "tpu"
 _lock = threading.Lock()
 
+# Backend registry: every coalesced caller goes through
+# create_batch_verifier(), so registering a backend here hands it to
+# all of them (types/validation windows, blocksync replay, light
+# client, consensus vote sets) at once. Names mirror the config knob
+# (config.CryptoConfig.batch_backend).
+_BACKENDS = {
+    "tpu": TpuBatchVerifier,
+    "cpu": CpuBatchVerifier,
+    "cpu-parallel": CpuParallelBatchVerifier,
+}
+
+
+def register_backend(name: str, factory) -> None:
+    """Add/replace a named verifier backend (factory: () -> BatchVerifier)."""
+    with _lock:
+        _BACKENDS[name] = factory
+
+
+def backends() -> Tuple[str, ...]:
+    return tuple(_BACKENDS)
+
 
 def set_default_backend(name: str) -> None:
-    """'tpu' or 'cpu' (process-wide; mirrors config knobs)."""
+    """Any registered backend name — 'tpu', 'cpu', 'cpu-parallel', ...
+    (process-wide; mirrors config knobs)."""
     global _default_backend
-    assert name in ("tpu", "cpu")
+    assert name in _BACKENDS, (name, tuple(_BACKENDS))
     with _lock:
         _default_backend = name
 
@@ -357,9 +504,7 @@ def create_batch_verifier(
 ) -> BatchVerifier:
     """Factory mirroring crypto/batch.CreateBatchVerifier: returns the
     configured backend (TPU by default)."""
-    if _default_backend == "cpu":
-        return CpuBatchVerifier()
-    return TpuBatchVerifier()
+    return _BACKENDS[_default_backend]()
 
 
 def supports_batch_verification(pk: PubKey) -> bool:

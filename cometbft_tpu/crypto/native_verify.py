@@ -1,0 +1,157 @@
+"""Loader for the native chunk verifier (native/batchverify.cpp).
+
+Follows the logdb/wirecodec pattern: built on demand with g++ into
+~/.cache/cometbft_tpu (override with BATCHVERIFY_SO_DIR), loaded as a
+CPython extension. ``verify_chunk(items)`` returns per-lane verdicts
+or None when the extension is unavailable — callers (the parallel
+verify engine's worker body) keep the pure pk.verify() loop as both
+the fallback and the semantic source of truth.
+
+Why it exists (docs/PERF.md "Host verification plane"): the per-lane
+Python path pays ~6 short ctypes transitions per signature with the
+GIL reacquired between them, so pool threads convoy on the GIL and
+stop scaling; the extension verifies a whole chunk per call with the
+GIL released for the entire C loop.
+
+Verdict semantics are EXACTLY crypto/keys.Ed25519PubKey.verify:
+OpenSSL (RFC 8032, the strict subset of ZIP-215) accepts → True;
+OpenSSL rejects → re-run the liberal pure-python ZIP-215 check on
+that lane. Non-ed25519 lanes and malformed inputs take the per-lane
+Python path unchanged. GRAFT_NATIVE_VERIFY=0 disables.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import subprocess
+import sysconfig
+import threading
+from typing import List, Optional
+
+from .keys import Ed25519PubKey
+
+_SRC = os.path.join(
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+    "native",
+    "batchverify.cpp",
+)
+_SO = os.path.join(
+    os.environ.get(
+        "BATCHVERIFY_SO_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "cometbft_tpu"),
+    ),
+    "_batchverify.so",
+)
+
+_mod = None
+_tried = False
+_lock = threading.Lock()
+
+
+def module():
+    """The extension module, or None (no compiler / no libcrypto /
+    disabled)."""
+    global _mod, _tried
+    if _tried:
+        return _mod
+    with _lock:
+        if _tried:  # pragma: no cover - race
+            return _mod
+        _tried = True
+        if os.environ.get("GRAFT_NATIVE_VERIFY") == "0":
+            return None
+        try:
+            if (
+                not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            ):
+                os.makedirs(os.path.dirname(_SO), exist_ok=True)
+                subprocess.run(
+                    [
+                        "g++",
+                        "-O2",
+                        "-std=c++17",
+                        "-shared",
+                        "-fPIC",
+                        "-I",
+                        sysconfig.get_paths()["include"],
+                        _SRC,
+                        "-ldl",
+                        "-o",
+                        _SO,
+                    ],
+                    check=True,
+                    capture_output=True,
+                )
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "_batchverify", _SO
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            if mod.available():
+                _mod = mod
+        except Exception:
+            _mod = None
+        return _mod
+
+
+def verify_chunk(items) -> Optional[List[bool]]:
+    """Verdicts for [(pk, msg, sig)] via ONE GIL-releasing native
+    call, or None when the extension is unavailable (caller falls
+    back to the per-lane Python loop).
+
+    Only well-formed ed25519 lanes enter the native call; every other
+    lane — and every native-rejected lane — runs the exact per-lane
+    ``pk.verify`` path, so verdicts are bit-identical to the serial
+    backend on every input (incl. the liberal ZIP-215 edge cases
+    OpenSSL rejects)."""
+    mod = module()
+    if mod is None:
+        return None
+    n = len(items)
+    ed_idx: List[int] = []
+    pubs = bytearray()
+    sigs = bytearray()
+    msgs = bytearray()
+    lens: List[int] = []
+    for i, (pk, msg, sig) in enumerate(items):
+        if (
+            isinstance(pk, Ed25519PubKey)
+            and len(pk.key_bytes) == 32
+            and len(sig) == 64
+        ):
+            ed_idx.append(i)
+            pubs += pk.key_bytes
+            sigs += sig
+            msgs += msg
+            lens.append(len(msg))
+    oks = [False] * n
+    if ed_idx:
+        verdicts = mod.verify_ed25519(
+            bytes(pubs),
+            bytes(sigs),
+            bytes(msgs),
+            struct.pack(f"={len(lens)}I", *lens),
+            len(ed_idx),
+        )
+        for j, i in enumerate(ed_idx):
+            if verdicts[j]:
+                oks[i] = True
+            else:
+                # OpenSSL's RFC 8032 check is the strict subset of
+                # ZIP-215: a rejection here still goes through the
+                # full (liberal) per-lane path, exactly like
+                # keys.Ed25519PubKey.verify
+                pk, msg, sig = items[i]
+                oks[i] = pk.verify(msg, sig)
+    covered = set(ed_idx)
+    for i in range(n):
+        if i not in covered:
+            pk, msg, sig = items[i]
+            oks[i] = pk.verify(msg, sig)
+    return oks

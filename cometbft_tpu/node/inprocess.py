@@ -82,6 +82,13 @@ def build_node(
     wal: bool = False,
 ) -> NodeParts:
     config = config or test_config(home or ".")
+    if config.crypto.batch_backend:
+        # operator-selected verifier backend (config.toml [crypto]
+        # batch_backend); empty inherits the process-wide default so
+        # embedders/tests that call set_default_backend keep control
+        from ..crypto import batch as crypto_batch
+
+        crypto_batch.set_default_backend(config.crypto.batch_backend)
     proxy_addr = getattr(config.base, "proxy_app", "")
     if app is None and proxy_addr:
         # out-of-process app (reference proxy_app + abci transport

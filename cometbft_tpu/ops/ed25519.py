@@ -102,7 +102,13 @@ def _straus(ds, dh, A, shape):
         from .pallas_ladder import pallas_enabled, straus_pallas
 
         if pallas_enabled(shape[0]):
-            return straus_pallas(ds, dh, A, shape)
+            res = straus_pallas(ds, dh, A, shape)
+            if res is not None:
+                return res
+            # no VMEM-safe blocking exists for this width (e.g. a
+            # large prime sublane count like r=513 under the default
+            # cap): fall through to the compact/XLA ladder, as the
+            # straus_pallas docstring promises (ADVICE r5 medium)
     if fe.compact_mode():
         return _straus_compact(ds, dh, A, shape)
     ident = curve.identity(shape)
@@ -497,6 +503,21 @@ class AsyncVerdicts:
         if bur is not None:
             bur()
         return self
+
+    def wait_fetch(self) -> "AsyncVerdicts":
+        """Block until the result is GENUINELY available by fetching a
+        single element to host. On the tunneled (axon) platform
+        block_until_ready returns without blocking (the readiness
+        query doesn't round-trip the link — bench.py platform note),
+        so wait() under-reports dispatch walls; a 1-element fetch must
+        complete the round trip. The fetched slice is a fresh tiny
+        computation, so the full verdict array is not pulled over the
+        link (thread-safe; used by the calibration watcher)."""
+        res = self._res
+        if self._n and getattr(res, "ndim", 0) == 1:
+            np.asarray(res[:1])
+            return self
+        return self.wait()
 
     def result(self) -> np.ndarray:
         out = np.array(self._res)[: self._n]

@@ -290,9 +290,12 @@ def straus_pallas(ds, dh, A, shape, interpret=None):
     table = table.reshape(16, 4, fe.NLIMBS, r, 128)
     ds_t = ds.reshape(64, r, 128)
     dh_t = dh.reshape(64, r, 128)
+    # the EFFECTIVE block, not the configured one: _ladder_call's
+    # divisor assert rejects any configured value that doesn't divide
+    # r (ADVICE r5 high — N=128 under GRAFT_PALLAS=1 tripped it)
     out = _ladder_call(
         ds_t, dh_t, table,
-        block=block_sublanes(), interpret=interpret,
+        block=s, interpret=interpret,
     )
     out = out.reshape(3, fe.NLIMBS, n)
     return (

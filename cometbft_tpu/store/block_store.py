@@ -77,14 +77,13 @@ class BlockStore:
 
     # --- save ---------------------------------------------------------
 
-    def save_block(
-        self, block: Block, part_set: PartSet, seen_commit: Commit
-    ) -> None:
+    @staticmethod
+    def _block_sets(
+        block: Block, part_set: PartSet, seen_commit: Commit
+    ) -> List:
+        """The per-block KV writes shared by save_block and
+        save_block_batch (everything except base/height bookkeeping)."""
         h = block.height
-        if self._height > 0 and h != self._height + 1:
-            raise ValueError(
-                f"non-contiguous block save: have {self._height}, got {h}"
-            )
         bid = BlockID(block.hash(), part_set.header)
         meta = BlockMeta(
             block_id=bid,
@@ -116,13 +115,49 @@ class BlockStore:
             sets.append(
                 (_hkey(b"C:", h - 1), _commit_bytes(block.last_commit))
             )
+        return sets
+
+    def save_block(
+        self, block: Block, part_set: PartSet, seen_commit: Commit
+    ) -> None:
+        self.save_block_batch([(block, part_set, seen_commit)])
+
+    def save_block_batch(self, entries) -> None:
+        """Persist a contiguous ascending run of blocks in ONE atomic
+        db.write_batch (entries: [(block, part_set, seen_commit)]).
+
+        The blocksync window pipeline stages a whole verified window
+        and flushes it here — one sqlite transaction / one memdb lock
+        round per window instead of per block (docs/PERF.md host
+        plane). The batch is all-or-nothing, so the store can never be
+        observed mid-window; crash-wise a flushed window leaves the
+        store AHEAD of the state, which is the handshake-supported
+        direction (consensus/replay.py replays store blocks the app
+        has not seen)."""
+        if not entries:
+            return
         with self._lock:
+            expect = self._height
+            sets: List = []
+            for block, part_set, seen_commit in entries:
+                h = block.height
+                if expect > 0 and h != expect + 1:
+                    raise ValueError(
+                        f"non-contiguous block save: have {expect}, "
+                        f"got {h}"
+                    )
+                sets.extend(
+                    self._block_sets(block, part_set, seen_commit)
+                )
+                expect = h
             if self._base == 0:
-                self._base = h
-                sets.append((b"base", h.to_bytes(8, "big")))
-            sets.append((b"height", h.to_bytes(8, "big")))
+                self._base = entries[0][0].height
+                sets.append(
+                    (b"base", self._base.to_bytes(8, "big"))
+                )
+            sets.append((b"height", expect.to_bytes(8, "big")))
             self.db.write_batch(sets)
-            self._height = h
+            self._height = expect
 
     def save_seen_commit(self, height: int, commit: Commit) -> None:
         # canonical re-encode, same reasoning as save_block's SC record

@@ -95,7 +95,12 @@ def _basic_checks(
 def _run_batch_async(items, cache: Optional[SignatureCache]):
     """items: list of (pubkey, sign_bytes, sig). Returns a handle whose
     ``result()`` yields list[bool] — async so callers (the blocksync
-    window pipeline) can overlap host work with the device dispatch."""
+    window pipeline) can overlap host work with the verification in
+    flight. Genuinely pending on BOTH planes since the cpu-parallel
+    backend landed: device batches ride the XLA async dispatch,
+    host-routed batches ride the multi-core pool
+    (crypto/parallel_verify) — either way the caller's decode/apply
+    work proceeds while lanes verify (docs/PERF.md host plane)."""
     to_verify = []
     skip = [False] * len(items)
     if cache is not None:

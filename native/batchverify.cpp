@@ -1,0 +1,177 @@
+// Native chunk verifier for the host verification plane
+// (cometbft_tpu/crypto/parallel_verify.py; loader:
+// cometbft_tpu/crypto/native_verify.py — logdb/wirecodec pattern:
+// built on demand with g++, pure-Python fallback remains the
+// semantic source of truth).
+//
+// Motivation (docs/PERF.md "Host verification plane"): the per-lane
+// Python path costs ~6 ctypes transitions per signature with the GIL
+// reacquired between them — worker threads convoy on those short
+// GIL-held windows and the thread tier stops scaling. This extension
+// verifies a WHOLE chunk per call with the GIL released for the
+// entire C loop, so a chunk behaves like one long hashlib-style call:
+// threads scale to the hardware and the per-call ctypes overhead
+// (~20-40us/sig) disappears.
+//
+// Strictness contract: OpenSSL's Ed25519 verify is RFC 8032
+// (cofactorless) — a strict SUBSET of the ZIP-215 semantics the
+// framework pins. A 0-verdict here therefore means "OpenSSL
+// rejected", and the Python caller re-runs the liberal pure check on
+// exactly those lanes (crypto/keys.Ed25519PubKey.verify does the
+// same), keeping verdicts bit-identical across every tier.
+//
+// libcrypto is dlopen'd at module init (no OpenSSL headers needed at
+// build time; the runtime library is the same one crypto/_ossl.py
+// binds via ctypes).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <dlfcn.h>
+#include <stdint.h>
+#include <string.h>
+
+namespace {
+
+constexpr int kEvpPkeyEd25519 = 1087;  // NID_ED25519
+
+typedef void *(*fn_new_raw_pub)(int, void *, const unsigned char *,
+                                size_t);
+typedef void (*fn_pkey_free)(void *);
+typedef void *(*fn_md_ctx_new)();
+typedef void (*fn_md_ctx_free)(void *);
+typedef int (*fn_dv_init)(void *, void *, void *, void *, void *);
+typedef int (*fn_dv)(void *, const unsigned char *, size_t,
+                     const unsigned char *, size_t);
+
+struct Ossl {
+  fn_new_raw_pub new_raw_pub = nullptr;
+  fn_pkey_free pkey_free = nullptr;
+  fn_md_ctx_new md_ctx_new = nullptr;
+  fn_md_ctx_free md_ctx_free = nullptr;
+  fn_dv_init dv_init = nullptr;
+  fn_dv dv = nullptr;
+  bool ok = false;
+};
+
+Ossl g_ossl;
+
+void load_ossl() {
+  const char *names[] = {"libcrypto.so.3", "libcrypto.so.1.1",
+                         "libcrypto.so"};
+  void *lib = nullptr;
+  for (const char *n : names) {
+    lib = dlopen(n, RTLD_NOW | RTLD_GLOBAL);
+    if (lib) break;
+  }
+  if (!lib) return;
+  g_ossl.new_raw_pub = reinterpret_cast<fn_new_raw_pub>(
+      dlsym(lib, "EVP_PKEY_new_raw_public_key"));
+  g_ossl.pkey_free =
+      reinterpret_cast<fn_pkey_free>(dlsym(lib, "EVP_PKEY_free"));
+  g_ossl.md_ctx_new =
+      reinterpret_cast<fn_md_ctx_new>(dlsym(lib, "EVP_MD_CTX_new"));
+  g_ossl.md_ctx_free =
+      reinterpret_cast<fn_md_ctx_free>(dlsym(lib, "EVP_MD_CTX_free"));
+  g_ossl.dv_init =
+      reinterpret_cast<fn_dv_init>(dlsym(lib, "EVP_DigestVerifyInit"));
+  g_ossl.dv = reinterpret_cast<fn_dv>(dlsym(lib, "EVP_DigestVerify"));
+  g_ossl.ok = g_ossl.new_raw_pub && g_ossl.pkey_free &&
+              g_ossl.md_ctx_new && g_ossl.md_ctx_free &&
+              g_ossl.dv_init && g_ossl.dv;
+}
+
+// Serial RFC 8032 verify of n lanes; verdicts out[i] in {0, 1}. Runs
+// with the GIL released — touches only the raw input buffers.
+void verify_lanes(const unsigned char *pubs, const unsigned char *sigs,
+                  const unsigned char *msgs, const uint32_t *lens,
+                  Py_ssize_t n, unsigned char *out) {
+  size_t off = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    const unsigned char *msg = msgs + off;
+    size_t mlen = lens[i];
+    off += mlen;
+    out[i] = 0;
+    void *pkey = g_ossl.new_raw_pub(kEvpPkeyEd25519, nullptr,
+                                    pubs + 32 * i, 32);
+    if (!pkey) continue;
+    void *ctx = g_ossl.md_ctx_new();
+    if (ctx) {
+      if (g_ossl.dv_init(ctx, nullptr, nullptr, nullptr, pkey) == 1 &&
+          g_ossl.dv(ctx, sigs + 64 * i, 64, msg, mlen) == 1) {
+        out[i] = 1;
+      }
+      g_ossl.md_ctx_free(ctx);
+    }
+    g_ossl.pkey_free(pkey);
+  }
+}
+
+PyObject *py_available(PyObject *, PyObject *) {
+  return PyBool_FromLong(g_ossl.ok ? 1 : 0);
+}
+
+// verify_ed25519(pubs: bytes, sigs: bytes, msgs: bytes, lens: bytes,
+//                n: int) -> bytes
+//   pubs: n*32 bytes; sigs: n*64 bytes; msgs: concatenated messages;
+//   lens: n uint32 (native-endian) message lengths. Returns n verdict
+//   bytes (1 = RFC 8032 valid, 0 = rejected — caller applies the
+//   liberal ZIP-215 recheck on the zeros).
+PyObject *py_verify_ed25519(PyObject *, PyObject *args) {
+  Py_buffer pubs, sigs, msgs, lens;
+  Py_ssize_t n;
+  if (!PyArg_ParseTuple(args, "y*y*y*y*n", &pubs, &sigs, &msgs, &lens,
+                        &n)) {
+    return nullptr;
+  }
+  PyObject *ret = nullptr;
+  if (!g_ossl.ok) {
+    PyErr_SetString(PyExc_RuntimeError, "libcrypto unavailable");
+  } else if (pubs.len != 32 * n || sigs.len != 64 * n ||
+             lens.len != static_cast<Py_ssize_t>(sizeof(uint32_t)) * n) {
+    PyErr_SetString(PyExc_ValueError, "buffer sizes do not match n");
+  } else {
+    const uint32_t *lp = static_cast<const uint32_t *>(lens.buf);
+    uint64_t total = 0;
+    for (Py_ssize_t i = 0; i < n; i++) total += lp[i];
+    if (static_cast<uint64_t>(msgs.len) != total) {
+      PyErr_SetString(PyExc_ValueError, "msg buffer / lens mismatch");
+    } else {
+      ret = PyBytes_FromStringAndSize(nullptr, n);
+      if (ret) {
+        unsigned char *out = reinterpret_cast<unsigned char *>(
+            PyBytes_AS_STRING(ret));
+        Py_BEGIN_ALLOW_THREADS;
+        verify_lanes(static_cast<const unsigned char *>(pubs.buf),
+                     static_cast<const unsigned char *>(sigs.buf),
+                     static_cast<const unsigned char *>(msgs.buf), lp,
+                     n, out);
+        Py_END_ALLOW_THREADS;
+      }
+    }
+  }
+  PyBuffer_Release(&pubs);
+  PyBuffer_Release(&sigs);
+  PyBuffer_Release(&msgs);
+  PyBuffer_Release(&lens);
+  return ret;
+}
+
+PyMethodDef kMethods[] = {
+    {"available", py_available, METH_NOARGS,
+     "libcrypto loaded and all EVP symbols resolved"},
+    {"verify_ed25519", py_verify_ed25519, METH_VARARGS,
+     "chunked RFC 8032 ed25519 verify, GIL released for the C loop"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef kModule = {
+    PyModuleDef_HEAD_INIT, "_batchverify",
+    "native GIL-releasing chunk verifier", -1, kMethods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__batchverify(void) {
+  load_ossl();
+  return PyModule_Create(&kModule);
+}

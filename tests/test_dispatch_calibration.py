@@ -32,6 +32,20 @@ def test_local_chip_flat_cost_keeps_vote_waves_on_device():
     assert c.crossover() < 100
 
 
+def test_wall_floor_rejects_non_blocking_samples():
+    """block_until_ready does not block through the axon tunnel
+    (ADVICE r5): a watcher whose wait returned without blocking would
+    record a near-enqueue-time wall and pull flat_s optimistic, so
+    small commits keep routing to a ~120 ms link. Sub-floor walls
+    never enter the EWMA; genuine dispatch walls do."""
+    c = _Calibration()
+    flat0 = c.flat_s
+    c.observe_device(150, 3e-5)  # enqueue-time artifact
+    assert c.flat_s == flat0 and c.device_samples == 0
+    c.observe_device(150, 0.004)  # genuine local-chip dispatch+fetch
+    assert c.device_samples == 1
+
+
 def test_compile_walls_never_poison_the_ewma():
     c = _Calibration()
     flat0 = c.flat_s
@@ -140,6 +154,13 @@ def test_async_seam_feeds_calibration(monkeypatch):
         def wait(self):
             return self
 
+        def wait_fetch(self):
+            # the watcher observes via a minimal result fetch; a real
+            # round trip always costs more than the calibration's
+            # wall floor
+            time.sleep(0.002)
+            return self
+
         def result(self):
             return [True] * 150
 
@@ -186,6 +207,10 @@ def test_result_time_overlap_does_not_poison_flat_cost(monkeypatch):
     class FakeHandle:
         def wait(self):
             return self  # device ready ~instantly
+
+        def wait_fetch(self):
+            time.sleep(0.002)  # ~instant, but a genuine round trip
+            return self
 
         def result(self):
             return [True] * 150

@@ -1,0 +1,421 @@
+"""Host verification plane (crypto/parallel_verify) differential
+suite: the parallel engine must be BIT-IDENTICAL to the serial cpu
+backend on every input — RFC 8032 vectors, forged/mutated lanes
+landing on their exact indices, ZIP-215 liberal edge cases (which
+OpenSSL rejects and the liberal recheck must still accept), order
+stability across chunk sizes and worker counts, and the process-pool
+tier over the pure-Python crypto fallback. Plus the overlap contract:
+the blocksync reactor's event loop stays responsive while a window's
+verify wait runs, and block-store writes land one batch per window.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.crypto import keys as crypto_keys
+from cometbft_tpu.crypto import native_verify
+from cometbft_tpu.crypto import parallel_verify as pv
+from cometbft_tpu.crypto.keys import Ed25519PrivKey, Secp256k1PrivKey
+from cometbft_tpu.crypto.parallel_verify import ParallelVerifyEngine
+
+# RFC 8032 §7.1 TEST 1-3 (seed, pub, msg, sig)
+RFC8032_VECTORS = [
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+def _vector_items():
+    """Vector lanes + a forged twin of each (sig bit flipped) — the
+    forgeries must land on exactly the odd indices."""
+    items = []
+    for seed_hex, pub_hex, msg_hex, sig_hex in RFC8032_VECTORS:
+        pk = crypto_keys.Ed25519PubKey(bytes.fromhex(pub_hex))
+        msg = bytes.fromhex(msg_hex)
+        sig = bytes.fromhex(sig_hex)
+        assert (
+            Ed25519PrivKey.from_seed(
+                bytes.fromhex(seed_hex)
+            ).pub_key().key_bytes
+            == pk.key_bytes
+        )
+        items.append((pk, msg, sig))
+        bad = bytearray(sig)
+        bad[7] ^= 0x40
+        items.append((pk, msg, bytes(bad)))
+    return items
+
+
+def _random_items(n, seed=3, n_keys=12):
+    rng = np.random.default_rng(seed)
+    privs = [
+        Ed25519PrivKey.from_seed(rng.bytes(32)) for _ in range(n_keys)
+    ]
+    items = []
+    for i in range(n):
+        p = privs[i % n_keys]
+        m = bytes(rng.bytes(40 + (i % 90)))
+        items.append((p.pub_key(), m, p.sign(m)))
+    return items
+
+
+def _serial_verdicts(items):
+    v = crypto_batch.CpuBatchVerifier()
+    for it in items:
+        v.add(*it)
+    return v.verify()[1]
+
+
+def test_rfc8032_vectors_parallel_vs_serial():
+    items = _vector_items()
+    want = [i % 2 == 0 for i in range(len(items))]
+    assert _serial_verdicts(items) == want
+    eng = ParallelVerifyEngine(min_parallel=1)
+    try:
+        assert eng.verify(items) == want
+    finally:
+        eng.close()
+
+
+def test_forged_and_edge_lanes_land_on_exact_indices():
+    """Mixed adversarial batch: valid lanes, a zeroed sig, a mutated
+    msg, a wrong key, a truncated sig, a secp256k1 lane, and a
+    ZIP-215 liberal lane (identity pubkey + S=0 sig: OpenSSL rejects
+    it, the cofactored liberal check accepts — the exact case the
+    native fast path must re-check in Python)."""
+    from cometbft_tpu.crypto import ref_ed25519 as ref
+
+    items = _random_items(120)
+    sp = Secp256k1PrivKey.generate()
+    sp_msg = b"mixed-lane"
+    items[17] = (items[17][0], items[17][1], bytes(64))
+    items[41] = (items[41][0], b"mutated!", items[41][2])
+    items[42] = (items[0][0], items[42][1], items[42][2])
+    items[77] = (items[77][0], items[77][1], items[77][2][:60])
+    items[88] = (sp.pub_key(), sp_msg, sp.sign(sp_msg))
+    ident = ref.point_compress(ref.IDENTITY)
+    items[99] = (
+        crypto_keys.Ed25519PubKey(ident),
+        b"small order",
+        ident + b"\x00" * 32,
+    )
+    # non-canonical pubkey encoding (y = p+1 ≡ identity): ZIP-215
+    # decodes it liberally, OpenSSL's strict decoder rejects it — the
+    # canonical "fast path rejects, liberal recheck accepts" lane
+    items[100] = (
+        crypto_keys.Ed25519PubKey((ref.P + 1).to_bytes(32, "little")),
+        b"liberal encoding",
+        ident + b"\x00" * 32,
+    )
+    want = _serial_verdicts(items)
+    assert want[100], "liberal-encoding lane must verify"
+    assert not want[17] and not want[41] and not want[42]
+    assert not want[77]
+    assert want[88], "secp lane must verify on the host path"
+    assert want[99], "ZIP-215 liberal lane must verify"
+    for tier in ("thread",):
+        eng = ParallelVerifyEngine(min_parallel=1, tier=tier)
+        try:
+            assert eng.verify(items) == want, tier
+        finally:
+            eng.close()
+    # and through the registered backend
+    old = crypto_batch._default_backend
+    crypto_batch.set_default_backend("cpu-parallel")
+    try:
+        v = crypto_batch.create_batch_verifier()
+        for it in items:
+            v.add(*it)
+        all_ok, oks = v.verify()
+        assert not all_ok and oks == want
+        v2 = crypto_batch.create_batch_verifier()
+        for it in items:
+            v2.add(*it)
+        assert v2.verify_async().result() == (False, want)
+    finally:
+        crypto_batch.set_default_backend(old)
+
+
+def test_order_stability_across_chunk_sizes_and_workers():
+    items = _random_items(257)  # deliberately not chunk-aligned
+    items[3] = (items[3][0], items[3][1], bytes(64))
+    items[255] = (items[255][0], b"x", items[255][2])
+    want = _serial_verdicts(items)
+    for workers in (2, 3):
+        for target_s in (2e-4, 5e-3, 1.0):
+            eng = ParallelVerifyEngine(
+                workers=workers,
+                min_parallel=1,
+                chunk_target_s=target_s,
+            )
+            try:
+                got = eng.verify(items)
+                assert got == want, (workers, target_s)
+            finally:
+                eng.close()
+
+
+def test_native_chunk_matches_python_loop():
+    if native_verify.module() is None:
+        pytest.skip("native extension unavailable (no compiler)")
+    items = _random_items(64)
+    items[5] = (items[5][0], items[5][1], bytes(64))
+    want = [pk.verify(m, s) for pk, m, s in items]
+    assert native_verify.verify_chunk(items) == want
+
+
+def test_process_pool_tier_on_pure_python_fallback(monkeypatch):
+    """With every OpenSSL tier gone (pure-Python crypto fallback) the
+    engine must pick the PROCESS tier — pure verify holds the GIL, so
+    threads cannot spread it — and verdicts stay bit-identical.
+    The fork start method propagates the monkeypatched tier flags to
+    the workers."""
+    monkeypatch.setattr(crypto_keys, "_HAVE_OSSL", False)
+    monkeypatch.setattr(crypto_keys, "_HAVE_CTYPES_OSSL", False)
+    # the native extension rides libcrypto too: simulate its absence
+    monkeypatch.setattr(native_verify, "_tried", True)
+    monkeypatch.setattr(native_verify, "_mod", None)
+    assert not pv._ed25519_releases_gil()
+    items = _random_items(8, n_keys=2)
+    items[2] = (items[2][0], items[2][1], bytes(64))
+    want = [pk.verify(m, s) for pk, m, s in items]
+    eng = ParallelVerifyEngine(min_parallel=1)
+    try:
+        assert eng.tier == "process"
+        got = eng.verify(items)
+        assert got == want
+        assert not got[2] and got[0]
+    finally:
+        eng.close()
+
+
+def test_serial_degrade_when_single_worker():
+    eng = ParallelVerifyEngine(workers=1)
+    try:
+        assert eng.tier == "serial"
+        items = _random_items(30, n_keys=3)
+        assert eng.verify(items) == _serial_verdicts(items)
+    finally:
+        eng.close()
+
+
+def test_tpu_backend_host_lanes_ride_the_parallel_plane(monkeypatch):
+    """Host-routed batches on the DEFAULT (tpu) backend must go
+    through the shared engine — every coalesced caller gets the
+    multi-core plane for free — and verify_async must hand back a
+    genuinely pending handle, not an eagerly-resolved one."""
+    calls = []
+    real_engine = pv.engine()
+
+    class Recorder:
+        def verify(self, items):
+            calls.append(("verify", len(items)))
+            return real_engine.verify(items)
+
+        def verify_async(self, items):
+            calls.append(("verify_async", len(items)))
+            return real_engine.verify_async(items)
+
+    monkeypatch.setattr(pv, "engine", lambda: Recorder())
+    old = crypto_batch._default_backend
+    old_min = crypto_batch._MIN_TPU_BATCH
+    crypto_batch.set_default_backend("tpu")
+    crypto_batch.set_min_tpu_batch(1 << 30)  # force host routing
+    try:
+        items = _random_items(80, n_keys=4)
+        v = crypto_batch.create_batch_verifier()
+        for it in items:
+            v.add(*it)
+        ok, oks = v.verify()
+        assert ok and all(oks)
+        v2 = crypto_batch.create_batch_verifier()
+        for it in items:
+            v2.add(*it)
+        handle = v2.verify_async()
+        assert isinstance(handle, crypto_batch._PendingHostVerdicts)
+        assert handle.result() == (True, [True] * 80)
+        assert ("verify", 80) in calls
+        assert ("verify_async", 80) in calls
+    finally:
+        crypto_batch.set_min_tpu_batch(old_min)
+        crypto_batch.set_default_backend(old)
+
+
+# --- reactor overlap + store batching -----------------------------------
+
+
+def _make_src(n_blocks, n_vals=3, chain_id="pplane"):
+    from cometbft_tpu.node.inprocess import make_genesis
+    from cometbft_tpu.utils.chaingen import make_chain
+
+    gen, pvs = make_genesis(n_vals, chain_id=chain_id)
+    src = make_chain(gen, [pv_.priv_key for pv_ in pvs], n_blocks)
+    return gen, src
+
+
+def test_event_loop_responsive_during_window_verify(monkeypatch):
+    """The reactor's verify wait runs in an executor: a heartbeat
+    task must keep ticking while a (deliberately slow) window verify
+    blocks. Before the overlapped path, each 0.4 s result() starved
+    the loop for its full duration."""
+    from cometbft_tpu.blocksync import reactor as reactor_mod
+    from cometbft_tpu.blocksync.reactor import BlockSyncReactor
+    from cometbft_tpu.node.inprocess import build_node
+    from cometbft_tpu.utils.chaingen import StorePeerClient
+
+    gen, src = _make_src(24)
+    real = reactor_mod.verify_commits_coalesced_async
+    slow_calls = []
+
+    def wrapped(chain_id, jobs, cache=None, light=True):
+        handle = real(chain_id, jobs, cache=cache, light=light)
+
+        class Slow:
+            def result(self):
+                slow_calls.append(len(jobs))
+                time.sleep(0.4)
+                return handle.result()
+
+        return Slow()
+
+    monkeypatch.setattr(
+        reactor_mod, "verify_commits_coalesced_async", wrapped
+    )
+
+    async def main():
+        fresh = build_node(gen, None)
+        caught = asyncio.Event()
+        reactor = BlockSyncReactor(
+            fresh.state,
+            fresh.block_exec,
+            fresh.block_store,
+            on_caught_up=lambda st: caught.set(),
+            verify_window=8,
+        )
+        reactor.pool.set_peer_range(
+            "src", StorePeerClient(src), 1, src.block_store.height()
+        )
+        gaps = []
+        stop = asyncio.Event()
+
+        async def heartbeat():
+            last = time.monotonic()
+            while not stop.is_set():
+                await asyncio.sleep(0.01)
+                now = time.monotonic()
+                gaps.append(now - last)
+                last = now
+
+        hb = asyncio.create_task(heartbeat())
+        await reactor.start()
+        await asyncio.wait_for(caught.wait(), 60)
+        stop.set()
+        await reactor.stop()
+        await hb
+        return fresh, max(gaps)
+
+    fresh, max_gap = asyncio.run(asyncio.wait_for(main(), 120))
+    assert fresh.block_store.height() >= src.block_store.height() - 2
+    assert len(slow_calls) >= 2, "test must exercise >=2 slow waits"
+    # each verify wait blocked 0.4s; a responsive loop never gaps
+    # anywhere near that (generous margin for a loaded box)
+    assert max_gap < 0.25, f"event loop starved: max gap {max_gap:.3f}s"
+
+
+def test_block_store_writes_one_batch_per_window():
+    from cometbft_tpu.blocksync.reactor import BlockSyncReactor
+    from cometbft_tpu.node.inprocess import build_node
+    from cometbft_tpu.utils.chaingen import StorePeerClient
+
+    gen, src = _make_src(40, chain_id="pplane-batch")
+
+    async def main():
+        fresh = build_node(gen, None)
+        caught = asyncio.Event()
+        db = fresh.block_store.db
+        counts = []
+        orig = db.write_batch
+
+        def counting(sets, deletes=()):
+            counts.append(sum(1 for _ in sets))
+            return orig(sets, deletes)
+
+        db.write_batch = counting
+        reactor = BlockSyncReactor(
+            fresh.state,
+            fresh.block_exec,
+            fresh.block_store,
+            on_caught_up=lambda st: caught.set(),
+            verify_window=8,
+        )
+        reactor.pool.set_peer_range(
+            "src", StorePeerClient(src), 1, src.block_store.height()
+        )
+        await reactor.start()
+        await asyncio.wait_for(caught.wait(), 60)
+        await reactor.stop()
+        return fresh, reactor, counts
+
+    fresh, reactor, counts = asyncio.run(
+        asyncio.wait_for(main(), 120)
+    )
+    applied = reactor.blocks_applied
+    assert applied >= src.block_store.height() - 2
+    # one write_batch per WINDOW (plus pool-timing slack), nowhere
+    # near one per block — windows are up to 7 applies at window=8
+    assert len(counts) < applied / 2, (len(counts), applied)
+    assert max(counts) > 4, "batches must carry multiple blocks"
+
+
+def test_save_block_batch_contiguity_and_roundtrip():
+    from cometbft_tpu import types as T
+    from cometbft_tpu.store.block_store import BlockStore
+    from cometbft_tpu.utils import codec, kv
+
+    gen, src = _make_src(6, chain_id="pplane-store")
+    store = BlockStore(kv.MemKV())
+
+    def entry(h):
+        blk = src.block_store.load_block(h)
+        parts = T.PartSet.from_data(codec.encode_block(blk))
+        return (blk, parts, src.block_store.load_seen_commit(h))
+
+    store.save_block_batch([entry(1), entry(2), entry(3)])
+    assert store.base() == 1 and store.height() == 3
+    for h in (1, 2, 3):
+        assert (
+            store.load_block(h).hash()
+            == src.block_store.load_block(h).hash()
+        )
+        assert store.load_seen_commit(h) is not None
+    with pytest.raises(ValueError):
+        store.save_block_batch([entry(5)])  # gap after 3
+    with pytest.raises(ValueError):
+        store.save_block_batch([entry(4), entry(6)])  # internal gap
+    assert store.height() == 3
+    store.save_block_batch([entry(4)])
+    assert store.height() == 4
+    assert store.load_block_commit(3) is not None
