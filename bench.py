@@ -703,6 +703,8 @@ def bench_replay(gen, parts, n_blocks: int) -> dict:
 
     n_sigs = (n_blocks - 1) * N_VALS  # tip block is left to consensus
 
+    trace_on = os.environ.get("BENCH_TRACE") == "1"
+
     def replay(limit, window):
         cfg = test_config(".")
         cfg.base.db_backend = "memdb"
@@ -717,6 +719,9 @@ def bench_replay(gen, parts, n_blocks: int) -> dict:
                 on_caught_up=lambda st: caught.set(),
                 verify_window=window,
             )
+            # window spans land on the replay node's ring (--trace
+            # embeds their summary in the checkpointed JSON)
+            reactor.tracer = fresh.tracer
             reactor.pool.set_peer_range(
                 "src", StorePeerClient(parts), 1, limit
             )
@@ -731,7 +736,18 @@ def bench_replay(gen, parts, n_blocks: int) -> dict:
             # pool.go:227) can fire between window passes either side
             # of the final single-block pass
             assert fresh.block_store.height() >= limit - 2
-            return dt, dict(reactor.pipeline_stats)
+            tsum = None
+            if trace_on:
+                from cometbft_tpu.trace import global_tracer, summarize
+
+                tsum = summarize(
+                    {
+                        "replay": fresh.tracer.snapshot(),
+                        "process": global_tracer().snapshot(),
+                    }
+                )
+                global_tracer().clear()
+            return dt, dict(reactor.pipeline_stats), tsum
 
         return asyncio.run(main())
 
@@ -751,9 +767,9 @@ def bench_replay(gen, parts, n_blocks: int) -> dict:
 
         crypto_batch.set_default_backend("cpu-parallel")
         replay(min(129, n_blocks), 128)  # warm stores/caches
-        par_dt, pipe_stats = replay(n_blocks, 128)
+        par_dt, pipe_stats, tsum = replay(n_blocks, 128)
         crypto_batch.set_default_backend("cpu")
-        ser_dt, _ = replay(n_blocks, 128)
+        ser_dt, _, _ = replay(n_blocks, 128)
         seq = {}
         if os.environ.get("BENCH_SEQ_FULL", "0") == "1":
             seq_dt = replay(n_blocks, 2)[0]
@@ -786,6 +802,7 @@ def bench_replay(gen, parts, n_blocks: int) -> dict:
                 "neutral, PERF.md r5, so this also stands in for the "
                 "per-block sequential baseline)"
             ),
+            **({"trace_summary": tsum} if tsum else {}),
             **seq,
         }
 
@@ -798,7 +815,7 @@ def bench_replay(gen, parts, n_blocks: int) -> dict:
     # the same _pad_n lane bucket as the timed windows.
     crypto_batch.set_default_backend("tpu")
     replay(min(129, n_blocks), 128)
-    tpu_dt, pipe_stats = replay(n_blocks, 128)
+    tpu_dt, pipe_stats, tsum = replay(n_blocks, 128)
     # CPU baseline: sequential verify on a 300-block slice, extrapolated
     crypto_batch.set_default_backend("cpu")
     cpu_slice = min(300, n_blocks)
@@ -815,6 +832,7 @@ def bench_replay(gen, parts, n_blocks: int) -> dict:
         # pipelined-dispatch observability: reused ~= windows proves
         # the lookahead overlap genuinely engaged during the run
         "pipeline": pipe_stats,
+        **({"trace_summary": tsum} if tsum else {}),
     }
 
 
@@ -1050,6 +1068,11 @@ def bench_mixed() -> dict:
 def main() -> None:
     t_start = time.time()
     _CKPT["t_start"] = t_start
+    if "--trace" in sys.argv:
+        # bench.py --trace: node tracers stay attached (they always
+        # are) and the per-config span summary is embedded in the
+        # checkpointed JSON (docs/TRACE.md)
+        os.environ["BENCH_TRACE"] = "1"
     _install_signal_handlers()
     _setup_jax()
 

@@ -1,2 +1,2 @@
 """Built-in bftlint rules; importing this package registers them."""
-from . import async_rules, jax_rules  # noqa: F401
+from . import async_rules, jax_rules, trace_rules  # noqa: F401

@@ -21,6 +21,7 @@ import traceback
 from typing import Callable, Optional
 
 from .. import types as T
+from ..trace import NOOP as TRACE_NOOP
 from ..types.validation import (
     verify_commits_coalesced_async,
 )
@@ -116,6 +117,11 @@ class BlockSyncReactor:
         }
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
+        # tracing plane (trace/): node wiring swaps in the per-node
+        # tracer; last_window_bps feeds the Prometheus window-
+        # throughput gauge (utils/metrics.py)
+        self.tracer = TRACE_NOOP
+        self.last_window_bps = 0.0
 
     # --- lifecycle ----------------------------------------------------
 
@@ -198,13 +204,26 @@ class BlockSyncReactor:
         Blocking form (tests, adaptive/ingestor mode); the pool
         routine's plain path goes through _process_window_overlapped,
         which parks the verify wait in an executor instead."""
-        prep = self._prepare_window(window)
+        t0 = time.monotonic()
+        with self.tracer.span(
+            "blocksync.window.prepare", tid="blocksync"
+        ):
+            prep = self._prepare_window(window)
         if prep is None:
             return 0
         window, jobs, handle = prep
-        errors = handle.result()
+        with self.tracer.span(
+            "blocksync.window.verify_wait", tid="blocksync",
+            jobs=len(jobs),
+        ):
+            errors = handle.result()
         pre = self._predispatch_lookahead(len(jobs))
-        return self._apply_window(window, jobs, errors, pre)
+        with self.tracer.span(
+            "blocksync.window.apply", tid="blocksync", jobs=len(jobs)
+        ):
+            applied = self._apply_window(window, jobs, errors, pre)
+        self._observe_window(applied, time.monotonic() - t0)
+        return applied
 
     async def _process_window_overlapped(self, window) -> int:
         """Same pass as _process_window, but the blocking verify wait
@@ -214,15 +233,33 @@ class BlockSyncReactor:
         window pre-dispatched by _prepare_window verifies on pool
         threads WHILE this pass's host apply runs — overlap with no
         device required."""
-        prep = self._prepare_window(window)
+        t0 = time.monotonic()
+        with self.tracer.span(
+            "blocksync.window.prepare", tid="blocksync"
+        ):
+            prep = self._prepare_window(window)
         if prep is None:
             return 0
         window, jobs, handle = prep
-        errors = await asyncio.get_running_loop().run_in_executor(
-            None, handle.result
+        # the executor-parked wait is where the verify plane's wall
+        # hides (PR 3): its span length vs apply's is the overlap
+        sp = self.tracer.span(
+            "blocksync.window.verify_wait", tid="blocksync",
+            jobs=len(jobs),
         )
+        try:
+            errors = await asyncio.get_running_loop().run_in_executor(
+                None, handle.result
+            )
+        finally:
+            sp.end()
         pre = self._predispatch_lookahead(len(jobs))
-        return self._apply_window(window, jobs, errors, pre)
+        with self.tracer.span(
+            "blocksync.window.apply", tid="blocksync", jobs=len(jobs)
+        ):
+            applied = self._apply_window(window, jobs, errors, pre)
+        self._observe_window(applied, time.monotonic() - t0)
+        return applied
 
     def _prepare_window(self, window):
         """Dispatch (or reuse) the window's coalesced signature batch.
@@ -426,7 +463,11 @@ class BlockSyncReactor:
                 if self.block_store.height() < h:
                     entries.append((blk, parts, nxt.last_commit))
             if entries:
-                self.block_store.save_block_batch(entries)
+                with self.tracer.span(
+                    "blocksync.window.persist", tid="blocksync",
+                    blocks=len(entries),
+                ):
+                    self.block_store.save_block_batch(entries)
         applied = 0
         for i, _job in enumerate(jobs):
             h, blk, peer = window[i]
@@ -602,6 +643,18 @@ class BlockSyncReactor:
         if pre is not None and self._inflight is not pre:
             self.pipeline_stats["discarded"] += 1
         return applied
+
+    def _observe_window(self, applied: int, wall_s: float) -> None:
+        """Per-window throughput: a counter event on the trace
+        timeline + the live value the Prometheus gauge reads."""
+        if applied <= 0 or wall_s <= 0:
+            return
+        bps = applied / wall_s
+        self.last_window_bps = bps
+        self.tracer.counter(
+            "blocksync.window_blocks_per_s", round(bps, 1),
+            tid="blocksync",
+        )
 
     def _build_jobs(self, window, vals_hash, max_jobs: int):
         """Verify jobs for the leading valset-constant prefix of

@@ -36,6 +36,13 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--liveness-bound", type=float, default=60.0)
     ap.add_argument("--json", help="write the report as JSON here")
+    ap.add_argument(
+        "--trace-dump",
+        metavar="DIR",
+        help="export every node's trace ring here (JSONL per node + "
+        "Perfetto trace.json); without it violated runs still dump "
+        "to a fresh temp directory",
+    )
     args = ap.parse_args(argv)
 
     if args.schedule:
@@ -52,6 +59,7 @@ def main(argv=None) -> int:
                 base_dir=tmp,
                 n_nodes=args.nodes,
                 liveness_bound_s=args.liveness_bound,
+                trace_dir=args.trace_dump,
             )
         )
     print(report.format())
@@ -66,6 +74,7 @@ def main(argv=None) -> int:
                     "final_heights": report.final_heights,
                     "link_decisions": report.link_decisions,
                     "wal_checks": report.wal_checks,
+                    "trace_files": report.trace_files,
                     "schedule": json.loads(report.schedule_json),
                 },
                 f,

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import tempfile
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -26,6 +27,7 @@ from ..node.inprocess import make_genesis
 from ..node.node import Node
 from ..p2p import MemoryTransport, NodeInfo, NodeKey
 from ..store.block_store import _hkey
+from ..trace import global_tracer, write_chrome, write_jsonl
 from ..utils.log import get_logger
 from ..utils.tasks import spawn
 from .invariants import (
@@ -51,6 +53,9 @@ class ChaosNode:
     privval: object
     home: str
     node: Optional[Node] = None  # None while crashed
+    # one tracer per incarnation (restarts build a fresh ring); kept
+    # here so a crashed node's timeline survives for the dump
+    tracers: List[object] = field(default_factory=list)
 
     @property
     def node_id(self) -> str:
@@ -70,6 +75,7 @@ class ChaosReport:
     final_heights: Dict[str, int] = field(default_factory=dict)
     link_decisions: Dict[str, Dict[str, int]] = field(default_factory=dict)
     wal_checks: int = 0
+    trace_files: List[str] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -92,6 +98,14 @@ class ChaosReport:
                 lines.append(f"  {link}: {counts}")
         for v in self.violations:
             lines.append(f"VIOLATION: {v}")
+        if self.trace_files:
+            lines.append("node trace rings (docs/TRACE.md):")
+            for p in self.trace_files:
+                lines.append(f"  {p}")
+            lines.append(
+                "  summarize: python -m cometbft_tpu.trace summarize "
+                + os.path.dirname(self.trace_files[0])
+            )
         if not self.ok:
             lines.append(
                 "replay: python -m cometbft_tpu.chaos --seed "
@@ -155,6 +169,7 @@ class ChaosNet:
     async def start(self) -> None:
         for cn in self.nodes:
             cn.node = self._build(cn)
+            cn.tracers.append(cn.node.parts.tracer)
             await cn.node.start()
         for i, a in enumerate(self.nodes):
             for b in self.nodes[i + 1 :]:
@@ -191,6 +206,7 @@ class ChaosNet:
         if cn.node is not None:
             return
         cn.node = self._build(cn)
+        cn.tracers.append(cn.node.parts.tracer)
         await cn.node.start()
         # WAL-replay consistency right after recovery, before the node
         # re-joins gossip
@@ -255,6 +271,49 @@ class ChaosNet:
             for cn in self.nodes
         }
 
+    def dump_traces(self, out_dir: str) -> List[str]:
+        """Write every node's trace ring (one JSONL per incarnation —
+        restarts get a fresh ring, so n1 that crashed and came back
+        dumps n1.0 and n1.1) plus the crypto plane's process ring and
+        one merged Perfetto-loadable trace.json. Returns the files."""
+        os.makedirs(out_dir, exist_ok=True)
+        files: List[str] = []
+        by_node: Dict[str, list] = {}
+        for cn in self.nodes:
+            for gen, tr in enumerate(cn.tracers):
+                events = tr.snapshot()
+                if not events:
+                    continue
+                label = (
+                    cn.name if len(cn.tracers) == 1
+                    else f"{cn.name}.{gen}"
+                )
+                by_node[label] = events
+                files.append(
+                    write_jsonl(
+                        os.path.join(out_dir, f"{label}.trace.jsonl"),
+                        label,
+                        events,
+                    )
+                )
+        proc = global_tracer().snapshot()
+        if proc:
+            by_node["process"] = proc
+            files.append(
+                write_jsonl(
+                    os.path.join(out_dir, "process.trace.jsonl"),
+                    "process",
+                    proc,
+                )
+            )
+        if by_node:
+            files.append(
+                write_chrome(
+                    os.path.join(out_dir, "trace.json"), by_node
+                )
+            )
+        return files
+
 
 async def run_schedule(
     schedule: FaultSchedule,
@@ -264,9 +323,16 @@ async def run_schedule(
     settle_heights: int = 2,
     liveness_bound_s: float = 60.0,
     fuzz_config=None,
+    trace_dir: Optional[str] = None,
 ) -> ChaosReport:
     """Execute one seeded chaos run end-to-end and return its report
-    (violations recorded, not raised — callers assert on report.ok)."""
+    (violations recorded, not raised — callers assert on report.ok).
+
+    Trace dumps: with ``trace_dir`` set every node's trace ring is
+    exported there unconditionally; without it a VIOLATED run still
+    dumps the rings to a fresh persistent directory next to the seed
+    + fault trace in the report — the timeline of what each node was
+    doing is part of the replay contract."""
     table = LinkTable(seed, fuzz_config=fuzz_config)
     net = ChaosNet(n_nodes, seed, base_dir, table=table)
     report = ChaosReport(seed=seed, schedule_json=schedule.to_json())
@@ -349,6 +415,16 @@ async def run_schedule(
     finally:
         report.final_heights = net.heights()
         await net.stop()
+        # rings survive node stop (ChaosNode holds the tracers)
+        try:
+            if trace_dir is not None:
+                report.trace_files = net.dump_traces(trace_dir)
+            elif report.violations:
+                report.trace_files = net.dump_traces(
+                    tempfile.mkdtemp(prefix=f"chaos_trace_{seed}_")
+                )
+        except OSError:
+            pass  # trace dump is best-effort diagnostics
 
     report.trace = nemesis.trace
     report.link_decisions = table.decision_counts()

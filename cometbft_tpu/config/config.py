@@ -160,6 +160,13 @@ class InstrumentationConfig:
     # libs/sync/deadlock.go): tasks suspended at the same await point
     # longer than this are reported with their stack; 0 disables
     watchdog_stall_s: float = 0.0
+    # always-on tracing plane (cometbft_tpu/trace, docs/TRACE.md):
+    # per-node fixed-memory event ring; the disabled fast path is a
+    # single attribute check, the enabled cost is ~2us per span
+    trace_enabled: bool = True
+    # events retained per node (ring slots, preallocated; oldest
+    # events are overwritten once the ring laps)
+    trace_ring_size: int = 16384
 
 
 @dataclass

@@ -24,6 +24,7 @@ from typing import Callable, List, Optional, Tuple
 from .. import types as T
 from ..config import ConsensusConfig
 from ..state.state_types import State
+from ..trace import NOOP as TRACE_NOOP
 from ..types import events as ev
 from ..utils import codec
 from ..utils.fail import fail_point
@@ -96,6 +97,15 @@ class ConsensusState:
         self._wal_path = wal_path
         self._broadcast_hooks: List[Callable] = []
         self.decided_heights = 0
+        # tracing plane (trace/, docs/TRACE.md): the node build swaps
+        # in the real per-node tracer; NOOP keeps call sites
+        # unconditional. Step spans are opened/closed across
+        # callsites, so the open handles live here (LIFO:
+        # height ⊇ round ⊇ step — Perfetto nests them by time range).
+        self.tracer = TRACE_NOOP
+        self._sp_height = None
+        self._sp_round = None
+        self._sp_step = None
 
         self.update_to_state(state)
 
@@ -119,7 +129,7 @@ class ConsensusState:
         self.queue = asyncio.Queue(maxsize=10000)
         self.event_bus.set_loop(asyncio.get_running_loop())
         if self._wal_path:
-            self.wal = walmod.WAL(self._wal_path)
+            self.wal = walmod.WAL(self._wal_path, tracer=self.tracer)
             self._catchup_replay()
         self._routine_task = asyncio.create_task(self._receive_routine())
         # kick off the first height
@@ -154,6 +164,11 @@ class ConsensusState:
                 self.wal.close()
             else:
                 self.wal.crash_close()
+        # record the in-progress height's open spans: the ring must
+        # show what this node was doing when it stopped/crashed —
+        # that partial timeline is exactly what the chaos dump
+        # exists for
+        self._close_trace_spans()
 
     # --- state transitions --------------------------------------------
 
@@ -373,6 +388,13 @@ class ConsensusState:
             app_hash=Lazy(lambda: new_state.app_hash[:8].hex()),
         )
         self.decided_heights += 1
+        # close the height's span stack and stamp the commit;
+        # ingest-path commits may have no open round/step spans
+        self._close_trace_spans()
+        self.tracer.instant(
+            "consensus.commit", tid="consensus",
+            height=height, txs=len(block.data.txs),
+        )
         if self.on_decided:
             try:
                 self.on_decided(height, bid, block)
@@ -528,6 +550,9 @@ class ConsensusState:
             vals = rs.validators.copy()
             vals.increment_proposer_priority(round_ - rs.round)
             rs.validators = vals
+        # close the previous round's open spans (step first — LIFO)
+        # so the new round's spans nest cleanly under the height span
+        self._close_trace_spans("_sp_step", "_sp_round")
         _log.debug("entering new round", height=height, round=round_)
         rs.round = round_
         rs.step = Step.NEW_ROUND
@@ -1236,7 +1261,44 @@ class ConsensusState:
 
     # --- misc ---------------------------------------------------------
 
+    def _close_trace_spans(
+        self, *attrs: str
+    ) -> None:
+        """End the named open trace spans (default: the whole stack),
+        always innermost-first — step ⊂ round ⊂ height must close
+        LIFO or Perfetto's time-range nesting breaks. Every handle is
+        None-guarded (replay/ingest paths open lazily)."""
+        for attr in attrs or ("_sp_step", "_sp_round", "_sp_height"):
+            sp = getattr(self, attr)
+            if sp is not None:
+                sp.end()
+                setattr(self, attr, None)
+
     def _new_step(self) -> None:
+        # step-span lifecycle: each step's span runs until the NEXT
+        # step begins (the machine is event-driven, not call-scoped);
+        # height/round spans open lazily so replay/ingest paths that
+        # skip _enter_new_round still nest correctly
+        sp = self._sp_step
+        if sp is not None:
+            sp.end()
+            self._sp_step = None
+        if self.tracer.enabled:
+            rs = self.rs
+            if self._sp_height is None:
+                self._sp_height = self.tracer.span(
+                    "consensus.height", tid="consensus",
+                    height=rs.height,
+                )
+            if self._sp_round is None:
+                self._sp_round = self.tracer.span(
+                    "consensus.round", tid="consensus",
+                    height=rs.height, round=rs.round,
+                )
+            self._sp_step = self.tracer.span(
+                "consensus.step", tid="consensus",
+                height=rs.height, round=rs.round, step=rs.step.name,
+            )
         self.event_bus.publish_type(
             ev.EVENT_NEW_ROUND_STEP,
             {

@@ -28,6 +28,7 @@ import zlib
 from dataclasses import dataclass
 from typing import Iterator, List, Optional
 
+from ..trace import NOOP as TRACE_NOOP
 from ..utils import proto
 from ..utils.fail import fail_point
 from ..utils.log import get_logger
@@ -114,10 +115,12 @@ class WAL:
         path: str,
         head_size_limit: int = DEFAULT_HEAD_SIZE_LIMIT,
         total_size_limit: int = DEFAULT_TOTAL_SIZE_LIMIT,
+        tracer=None,
     ):
         self.path = path
         self.head_size_limit = head_size_limit
         self.total_size_limit = total_size_limit
+        self.tracer = tracer or TRACE_NOOP
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f = open(path, "ab")
         self._head_size = self._f.tell()
@@ -143,8 +146,11 @@ class WAL:
         self.flush_sync()
 
     def flush_sync(self) -> None:
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        # the fsync barrier is the consensus hot path's only disk
+        # stall — span it so step latencies attribute to it
+        with self.tracer.span("wal.fsync", tid="wal"):
+            self._f.flush()
+            os.fsync(self._f.fileno())
 
     def write_end_height(self, height: int) -> None:
         self.write_sync(WALMessage(kind=MSG_END_HEIGHT, height=height))

@@ -45,6 +45,8 @@ import threading
 import time
 from typing import List, Optional, Sequence, Tuple
 
+from ..trace import global_tracer
+
 _MAX_WORKERS_CAP = 16
 _MIN_CHUNK = 8
 _DEFAULT_MIN_PARALLEL = 24
@@ -62,7 +64,16 @@ def _ed25519_releases_gil() -> bool:
     return bool(keys._HAVE_OSSL or keys._HAVE_CTYPES_OSSL)
 
 
-def _verify_chunk(items) -> Tuple[List[bool], float]:
+def _disable_worker_tracing() -> None:
+    """Process-pool child initializer: a fork-started worker inherits
+    the parent's enabled process tracer, but its ring can never be
+    read (it lives in the child) — keep the chunk path no-op there."""
+    from ..trace import enable_global
+
+    enable_global(False)
+
+
+def _verify_chunk(items, tier: str = "?") -> Tuple[List[bool], float]:
     """Worker body (top-level so the process tier can pickle it):
     verify one chunk, returning (verdicts, serial wall) — the wall
     feeds the per-item EWMA that sizes future chunks.
@@ -71,7 +82,23 @@ def _verify_chunk(items) -> Tuple[List[bool], float]:
     the whole chunk in ONE GIL-releasing call — the per-lane ctypes
     transitions otherwise convoy worker threads on the GIL and cap
     thread-tier scaling well below the core count. Fallback (no
-    compiler / disabled): the bit-identical per-lane Python loop."""
+    compiler / disabled): the bit-identical per-lane Python loop.
+
+    Traced onto the process-wide ring (trace/global_tracer) with
+    worker id + lane count + tier: worker subprocesses never enable
+    the global tracer, so the process tier's children stay no-op and
+    only the thread tier (shared ring) records chunk spans."""
+    tr = global_tracer()
+    sp = (
+        tr.span(
+            "crypto.verify_chunk",
+            tid=threading.current_thread().name,
+            lanes=len(items),
+            tier=tier,
+        )
+        if tr.enabled
+        else None
+    )
     t0 = time.perf_counter()
     try:
         from . import native_verify
@@ -81,7 +108,10 @@ def _verify_chunk(items) -> Tuple[List[bool], float]:
         oks = None
     if oks is None:
         oks = [pk.verify(msg, sig) for pk, msg, sig in items]
-    return oks, time.perf_counter() - t0
+    wall = time.perf_counter() - t0
+    if sp is not None:
+        sp.end()
+    return oks, wall
 
 
 class PendingLanes:
@@ -252,8 +282,13 @@ class ParallelVerifyEngine:
                             ProcessPoolExecutor,
                         )
 
+                        # fork-started children inherit the parent's
+                        # enabled global tracer; their rings are
+                        # unreadable (and COW-duplicated), so the
+                        # traced path must stay no-op there
                         self._pool = ProcessPoolExecutor(
-                            max_workers=self.workers
+                            max_workers=self.workers,
+                            initializer=_disable_worker_tracing,
                         )
                 except (OSError, ImportError, RuntimeError):
                     # restricted container (no fork / thread limit):
@@ -301,7 +336,7 @@ class ParallelVerifyEngine:
     # --- verification -------------------------------------------------
 
     def _serial(self, items) -> _ResolvedLanes:
-        oks, wall = _verify_chunk(items)
+        oks, wall = _verify_chunk(items, self.tier)
         self._observe_chunk(len(items), wall)
         return _ResolvedLanes(oks, wall)
 
@@ -317,12 +352,23 @@ class ParallelVerifyEngine:
             # chunks cross a pickle boundary: normalize to plain tuples
             items = [(pk, bytes(m), bytes(s)) for pk, m, s in items]
         chunk = self.chunk_size(n)
+        tr = global_tracer()
+        if tr.enabled:
+            tr.instant(
+                "crypto.batch.dispatch",
+                tid="crypto",
+                lanes=n,
+                chunk=chunk,
+                tier=self.tier,
+                workers=self.workers,
+            )
         futures = []
         try:
             for start in range(0, n, chunk):
                 futures.append(
                     (start, pool.submit(
-                        _verify_chunk, items[start : start + chunk]
+                        _verify_chunk, items[start : start + chunk],
+                        self.tier,
                     ))
                 )
         except RuntimeError:

@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..abci import types as abci
+from ..trace import NOOP as TRACE_NOOP
 
 
 def tx_key(tx: bytes) -> bytes:
@@ -62,6 +63,11 @@ class MempoolTx:
 
 class Mempool:
     """Interface (reference mempool/mempool.go Mempool)."""
+
+    # tracing plane (trace/): the node build swaps in the per-node
+    # tracer; class-level NOOP keeps every flavor's call sites
+    # unconditional
+    tracer = TRACE_NOOP
 
     def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
         raise NotImplementedError
@@ -121,6 +127,18 @@ class CListMempool(Mempool):
     # --- ingress ------------------------------------------------------
 
     def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
+        tr = self.tracer
+        if not tr.enabled:
+            return self._check_tx(tx, sender)
+        with tr.span("mempool.insert", tid="mempool", bytes=len(tx)) as sp:
+            res = self._check_tx(tx, sender)
+            sp.set(ok=res.is_ok())
+        # unlocked len read (like update's counter): a size() here
+        # would re-take the pool lock once per tx just for the stamp
+        tr.counter("mempool.size", len(self.pool), tid="mempool")
+        return res
+
+    def _check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
         if len(tx) > self.max_tx_bytes:
             return abci.ResponseCheckTx(code=1, log="tx too large")
         if not self.cache.push(tx):
@@ -152,16 +170,18 @@ class CListMempool(Mempool):
 
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
         out, total_b, total_g = [], 0, 0
-        with self._lock:
-            for mt in self.pool.values():
-                nb = total_b + len(mt.tx)
-                ng = total_g + mt.gas_wanted
-                if max_bytes >= 0 and nb > max_bytes:
-                    break
-                if max_gas >= 0 and ng > max_gas:
-                    break
-                out.append(mt.tx)
-                total_b, total_g = nb, ng
+        with self.tracer.span("mempool.reap", tid="mempool") as sp:
+            with self._lock:
+                for mt in self.pool.values():
+                    nb = total_b + len(mt.tx)
+                    ng = total_g + mt.gas_wanted
+                    if max_bytes >= 0 and nb > max_bytes:
+                        break
+                    if max_gas >= 0 and ng > max_gas:
+                        break
+                    out.append(mt.tx)
+                    total_b, total_g = nb, ng
+            sp.set(txs=len(out), bytes=total_b)
         return out
 
     def iter_txs(self) -> List[bytes]:
@@ -221,6 +241,7 @@ class CListMempool(Mempool):
                 self._notify()
         else:
             self._txs_available.clear()
+        self.tracer.counter("mempool.size", len(self.pool), tid="mempool")
 
     def _recheck_txs(self) -> None:
         for k in list(self.pool.keys()):

@@ -29,6 +29,8 @@ from ..state.execution import BlockExecutor
 from ..state.store import Store as StateStore
 from ..state.state_types import State
 from ..store import BlockStore
+from ..trace import NOOP as TRACE_NOOP
+from ..trace import Tracer, enable_global
 from ..types import events as ev
 from ..types.genesis import GenesisDoc
 from ..utils import kv
@@ -56,6 +58,9 @@ class NodeParts:
     tx_indexer: object = None
     block_indexer: object = None
     index_db: object = None
+    # per-node tracing plane (trace/, docs/TRACE.md); NOOP when
+    # [instrumentation] trace_enabled = false
+    tracer: object = TRACE_NOOP
 
     def close_stores(self) -> None:
         """Release every store handle (the native logdb backend holds
@@ -82,6 +87,16 @@ def build_node(
     wal: bool = False,
 ) -> NodeParts:
     config = config or test_config(home or ".")
+    # tracing plane: one ring per node; cross-node planes (the crypto
+    # worker pool) land on the process-wide tracer, enabled the first
+    # time any tracing node is built
+    tracer = TRACE_NOOP
+    if config.instrumentation.trace_enabled:
+        tracer = Tracer(
+            name=config.base.moniker or "node",
+            size=config.instrumentation.trace_ring_size,
+        )
+        enable_global()
     if config.crypto.batch_backend:
         # operator-selected verifier backend (config.toml [crypto]
         # batch_backend); empty inherits the process-wide default so
@@ -197,6 +212,8 @@ def build_node(
         wal_path=wal_path,
         evidence_pool=evpool,
     )
+    cs.tracer = tracer
+    mempool.tracer = tracer
     return NodeParts(
         config=config,
         genesis=genesis,
@@ -216,6 +233,7 @@ def build_node(
         tx_indexer=tx_indexer,
         block_indexer=block_indexer,
         index_db=index_db,
+        tracer=tracer,
     )
 
 

@@ -162,6 +162,10 @@ class Node:
         self.switch.add_reactor("statesync", self.statesync_reactor)
         if self.pex_reactor is not None:
             self.switch.add_reactor("pex", self.pex_reactor)
+        # tracing plane: point the networked planes at the node ring
+        # (consensus/mempool/WAL got theirs in build_node)
+        self.switch.tracer = self.parts.tracer
+        self.blocksync_reactor.inner.tracer = self.parts.tracer
         self._adaptive = adaptive
         self._cs_started = False
         self.rpc_server = None

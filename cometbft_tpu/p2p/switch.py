@@ -17,6 +17,7 @@ import asyncio
 import traceback
 from typing import Dict, List, Optional
 
+from ..trace import NOOP as TRACE_NOOP
 from ..utils.backoff import Backoff
 from ..utils.log import get_logger
 from ..utils.tasks import spawn
@@ -59,6 +60,9 @@ class Switch:
         self._accept_task: Optional[asyncio.Task] = None
         self._reconnect_tasks: Dict[str, asyncio.Task] = {}
         self._stopped = False
+        # tracing plane (trace/): node wiring swaps in the per-node
+        # tracer; peer-count changes land as counter events
+        self.tracer = TRACE_NOOP
 
     # --- reactor registry ---------------------------------------------
 
@@ -186,6 +190,7 @@ class Switch:
         """Shared tail of peer construction: register, start, announce
         to reactors."""
         self.peers[peer.peer_id] = peer
+        self.tracer.counter("p2p.peers", len(self.peers), tid="p2p")
         _log.info(
             "added peer",
             peer=peer.peer_id[:12],
@@ -265,6 +270,7 @@ class Switch:
         if self.peers.get(peer.peer_id) is not peer:
             return
         del self.peers[peer.peer_id]
+        self.tracer.counter("p2p.peers", len(self.peers), tid="p2p")
         _log.info(
             "removed peer",
             peer=peer.peer_id[:12],

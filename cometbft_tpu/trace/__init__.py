@@ -1,0 +1,58 @@
+"""Always-on low-overhead tracing plane (docs/TRACE.md).
+
+Per-node fixed-size ring-buffer tracers with a span API over the hot
+planes (consensus step lifecycle, blocksync windows, crypto batch
+verify, mempool, WAL fsync), Chrome trace-event / JSONL export
+(Perfetto-loadable) and p50/p95/p99 summaries.
+
+Two tracer scopes:
+
+- **per-node** — built by node/inprocess.build_node when
+  ``[instrumentation] trace_enabled`` (default on); carried on
+  NodeParts.tracer and attached to the node's consensus state,
+  mempool, WAL, blocksync reactor and switch.
+- **process-wide** — ``global_tracer()``: the landing zone for
+  planes shared across in-process nodes (the crypto parallel-verify
+  worker pool). Disabled until the first tracing-enabled node calls
+  ``enable_global()``; worker subprocesses never enable it, so the
+  pickled chunk path stays no-op there.
+
+Instrumented classes default ``self.tracer`` to the shared ``NOOP``
+tracer, so call sites are unconditional and the disabled path is one
+attribute check (tests/test_trace.py bounds it).
+"""
+
+from .bridge import SpanMetricsBridge
+from .export import chrome_trace, read_jsonl, write_chrome, write_jsonl
+from .summary import format_summary, percentile, summarize
+from .tracer import NOOP, NOOP_SPAN, Tracer
+
+__all__ = [
+    "NOOP",
+    "NOOP_SPAN",
+    "SpanMetricsBridge",
+    "Tracer",
+    "chrome_trace",
+    "enable_global",
+    "format_summary",
+    "global_tracer",
+    "percentile",
+    "read_jsonl",
+    "summarize",
+    "write_chrome",
+    "write_jsonl",
+]
+
+# process-wide tracer for cross-node planes (crypto worker pool)
+_GLOBAL = Tracer(name="process", size=8192, enabled=False)
+
+
+def global_tracer() -> Tracer:
+    return _GLOBAL
+
+
+def enable_global(enabled: bool = True) -> Tracer:
+    """Flip the process-wide tracer; idempotent (called by every
+    tracing-enabled node build)."""
+    _GLOBAL.enabled = enabled
+    return _GLOBAL

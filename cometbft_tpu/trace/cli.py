@@ -1,0 +1,86 @@
+"""Trace CLI:
+
+    python -m cometbft_tpu.trace dump      FILE_OR_DIR...
+    python -m cometbft_tpu.trace convert   FILE_OR_DIR... -o trace.json
+    python -m cometbft_tpu.trace summarize FILE_OR_DIR... [--json]
+
+Inputs are JSONL trace files (one event per line, as written by
+trace/export.write_jsonl — chaos dumps, bench --trace, node dumps) or
+directories of them. ``convert`` emits Chrome trace-event JSON:
+open the output at https://ui.perfetto.dev or chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .export import chrome_trace, read_jsonl, write_chrome
+from .summary import format_summary, summarize
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m cometbft_tpu.trace")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p_dump = sub.add_parser(
+        "dump", help="print events as JSON lines, time-ordered"
+    )
+    p_dump.add_argument("paths", nargs="+")
+
+    p_conv = sub.add_parser(
+        "convert", help="convert to Chrome trace JSON (Perfetto)"
+    )
+    p_conv.add_argument("paths", nargs="+")
+    p_conv.add_argument(
+        "-o", "--out", help="output file (default: stdout)"
+    )
+
+    p_sum = sub.add_parser(
+        "summarize",
+        help="p50/p95/p99 per span kind per node",
+    )
+    p_sum.add_argument("paths", nargs="+")
+    p_sum.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+
+    args = ap.parse_args(argv)
+    events = read_jsonl(args.paths)
+    if not events:
+        print("no trace events found", file=sys.stderr)
+        return 1
+
+    if args.cmd == "dump":
+        flat = [
+            {"node": node, **e}
+            for node, evs in events.items()
+            for e in evs
+        ]
+        flat.sort(key=lambda e: e.get("ts_ns", 0))
+        try:
+            for e in flat:
+                print(json.dumps(e))
+        except BrokenPipeError:
+            # downstream pager/head closed the pipe: a clean exit,
+            # not a traceback
+            sys.stderr.close()
+    elif args.cmd == "convert":
+        if args.out:
+            write_chrome(args.out, events)
+            n = sum(len(v) for v in events.values())
+            print(
+                f"wrote {args.out}: {n} events from "
+                f"{len(events)} node(s) — load in ui.perfetto.dev"
+            )
+        else:
+            json.dump(chrome_trace(events), sys.stdout)
+            print()
+    else:  # summarize
+        s = summarize(events)
+        if args.json:
+            print(json.dumps(s, indent=2))
+        else:
+            print(format_summary(s))
+    return 0

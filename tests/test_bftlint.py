@@ -24,10 +24,15 @@ from cometbft_tpu.analysis.registry import all_rules, resolve
 REPO_ROOT = Path(__file__).resolve().parents[1]
 
 
-def ids_of(src: str):
+def ids_of(src: str, path: str = "x.py"):
     return sorted(
-        {f.rule_id for f in analyze_source(textwrap.dedent(src), "x.py")}
+        {f.rule_id for f in analyze_source(textwrap.dedent(src), path)}
     )
+
+
+# path-scoped rules need their fixtures analyzed under an in-scope
+# path (ASY107 only applies inside the tracing plane)
+FIXTURE_PATHS = {"ASY107": "cometbft_tpu/trace/x.py"}
 
 
 # --- 1. rule fixtures -------------------------------------------------
@@ -259,6 +264,21 @@ FIXTURES = [
         """,
     ),
     (
+        "ASY107",  # wallclock-in-trace (path-scoped: FIXTURE_PATHS)
+        """
+        import time
+        def stamp():
+            return time.time_ns()
+        """,
+        """
+        import time
+        def stamp():
+            return time.monotonic_ns()
+        def also_fine():
+            return time.perf_counter()
+        """,
+    ),
+    (
         "SYN000",  # syntax errors are findings, not crashes
         """
         def f(:
@@ -277,10 +297,23 @@ FIXTURES = [
     ids=[f"{r}-{i}" for i, (r, _, _) in enumerate(FIXTURES)],
 )
 def test_rule_fixture(rule_id, bad, good):
-    assert rule_id in ids_of(bad), f"{rule_id} missed its positive"
-    assert rule_id not in ids_of(good), (
+    path = FIXTURE_PATHS.get(rule_id, "x.py")
+    assert rule_id in ids_of(bad, path), (
+        f"{rule_id} missed its positive"
+    )
+    assert rule_id not in ids_of(good, path), (
         f"{rule_id} false-positived on its negative"
     )
+
+
+def test_asy107_scoped_to_trace_package():
+    src = """
+    import time
+    def stamp():
+        return time.time()
+    """
+    assert "ASY107" not in ids_of(src)  # outside the plane: fine
+    assert "ASY107" in ids_of(src, "cometbft_tpu/trace/export.py")
 
 
 def test_at_least_eight_distinct_rules_have_fixtures():

@@ -1,0 +1,423 @@
+"""Tracing plane (cometbft_tpu/trace) tier-1 suite.
+
+Layers:
+  1. tracer core contracts: preallocated ring reuse (no growth, no
+     slot churn), disabled fast-path overhead bound, span/instant/
+     counter semantics, observers;
+  2. export + summary + CLI;
+  3. live instrumentation: 1-node consensus span nesting, crypto
+     parallel-verify chunk spans on the process tracer;
+  4. the ISSUE 4 acceptance scenario: a 4-node in-process chaos run
+     with tracing enabled produces a Perfetto-loadable trace whose
+     consensus step spans nest correctly per height/round.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from cometbft_tpu.trace import (
+    NOOP,
+    SpanMetricsBridge,
+    Tracer,
+    chrome_trace,
+    percentile,
+    read_jsonl,
+    summarize,
+    write_jsonl,
+)
+from cometbft_tpu.trace.cli import main as trace_cli
+
+
+def run(coro, timeout=240):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+# --- 1. tracer core ------------------------------------------------------
+
+
+def test_ring_reuses_slots_without_growing():
+    t = Tracer("ring", size=16)
+    # warm up: lap the ring once
+    for i in range(16):
+        t.instant(f"e{i}")
+    slot_ids = {id(s) for s in t._ring}
+    assert len(t._ring) == 16
+    # three more laps: same slot objects, same ring length
+    for i in range(48):
+        t.instant("later", k=i)
+    assert len(t._ring) == 16
+    assert {id(s) for s in t._ring} == slot_ids
+    ev = t.snapshot()
+    assert len(ev) == 16
+    # only the newest 16 events survive, in seq order
+    assert [e["args"]["k"] for e in ev] == list(range(32, 48))
+    st = t.stats()
+    assert st["written"] == 64 and st["dropped"] == 48
+
+
+def test_disabled_tracer_fast_path_overhead():
+    """The disabled span() path must stay a near-free attribute check.
+    Envelope target is ~100ns/call on real hardware; standalone on
+    this 2-vCPU throttled box it measures ~150ns bare / ~310ns with
+    kwargs — but under full-suite contention every Python call
+    inflates ~10x, so the bound SCALES with a no-op-call baseline
+    measured in the same conditions (plus a generous absolute
+    backstop). What this still catches: a disabled path that started
+    doing real work (ring writes, clock reads, object churn) costs a
+    large multiple of a bare call and blows the ratio regardless of
+    box load."""
+    import gc
+
+    t = Tracer("off", size=64, enabled=False)
+    en = Tracer("on", size=1024)
+    N = 50_000
+
+    def per_call(fn):
+        best = None
+        for _ in range(7):
+            t0 = time.perf_counter_ns()
+            for _ in range(N):
+                fn()
+            dt = (time.perf_counter_ns() - t0) / N
+            best = dt if best is None else min(best, dt)
+        return best
+
+    def noop():
+        pass
+
+    gc.disable()
+    try:
+        baseline = per_call(noop)  # plain call cost on this box, now
+        bare = per_call(lambda: t.span("x"))
+        kw = per_call(lambda: t.span("x", height=1, round=0))
+        enabled = per_call(lambda: en.span("x", height=1).end())
+    finally:
+        gc.enable()
+    # ~100ns-envelope spirit: a handful of call-costs, never real work
+    assert bare < max(1500, 12 * baseline), (
+        f"disabled bare span() {bare:.0f}ns/call "
+        f"(baseline {baseline:.0f}ns)"
+    )
+    assert kw < max(3000, 25 * baseline), (
+        f"disabled kwargs span() {kw:.0f}ns/call "
+        f"(baseline {baseline:.0f}ns)"
+    )
+    # and strictly cheaper than a real (enabled) span cycle
+    assert bare < enabled, (bare, enabled)
+    # and it must be an actual no-op: nothing entered the ring
+    assert t.snapshot() == []
+    # instant/counter share the guard
+    t.instant("x", a=1)
+    t.counter("c", 1)
+    assert t.snapshot() == []
+
+
+def test_span_semantics_and_observer():
+    t = Tracer("s", size=64)
+    with t.span("outer", tid="tr", height=1) as sp:
+        sp.set(extra=7)
+        with t.span("inner", tid="tr"):
+            pass
+    # manual begin/end (the consensus step machine's usage)
+    h = t.span("manual", tid="tr")
+    h.end()
+    h.end()  # idempotent: records exactly once
+    ev = t.snapshot()
+    names = [e["name"] for e in ev]
+    assert names == ["inner", "outer", "manual"]  # completion order
+    outer = ev[1]
+    inner = ev[0]
+    assert outer["args"] == {"height": 1, "extra": 7}
+    assert outer["ts_ns"] <= inner["ts_ns"]
+    assert (
+        outer["ts_ns"] + outer["dur_ns"]
+        >= inner["ts_ns"] + inner["dur_ns"]
+    )
+    # observers see every completed span; a raising observer is
+    # dropped without disturbing the hot path
+    seen = []
+    t.add_observer(lambda n, d, a: seen.append((n, a)))
+
+    def bad(n, d, a):
+        raise RuntimeError("boom")
+
+    t.add_observer(bad)
+    t.span("obs", k=2).end()
+    t.span("obs2").end()
+    assert ("obs", {"k": 2}) in seen and ("obs2", {}) in seen
+    assert bad not in t._observers
+
+
+def test_noop_tracer_is_disabled_and_shared():
+    assert not NOOP.enabled
+    sp = NOOP.span("anything", height=1)
+    with sp:
+        sp.set(x=1)
+    NOOP.instant("i")
+    NOOP.counter("c", 1)
+    assert NOOP.snapshot() == []
+
+
+def test_metrics_bridge_routes_by_span_name():
+    got = []
+    b = SpanMetricsBridge()
+    b.route("consensus.step", lambda dur_s, args: got.append((dur_s, args)))
+    t = Tracer("b", size=8)
+    t.add_observer(b)
+    t.span("consensus.step", step="PROPOSE").end()
+    t.span("unrouted").end()
+    assert len(got) == 1
+    dur_s, args = got[0]
+    assert args["step"] == "PROPOSE" and dur_s >= 0
+
+
+# --- 2. export / summary / CLI ------------------------------------------
+
+
+def _sample_tracer():
+    t = Tracer("n0", size=64)
+    with t.span("a.outer", tid="x", height=1):
+        with t.span("a.inner", tid="x"):
+            pass
+    t.instant("mark", tid="y", k=1)
+    t.counter("depth", 3, tid="y")
+    return t
+
+
+def test_chrome_trace_structure():
+    t = _sample_tracer()
+    ct = chrome_trace({"n0": t.snapshot()})
+    json.loads(json.dumps(ct))  # serializable
+    te = ct["traceEvents"]
+    metas = [e for e in te if e["ph"] == "M"]
+    assert {"process_name", "thread_name"} <= {e["name"] for e in metas}
+    xs = [e for e in te if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"a.outer", "a.inner"}
+    for e in xs:
+        assert e["dur"] >= 0 and isinstance(e["pid"], int)
+    assert [e for e in te if e["ph"] == "i"][0]["s"] == "t"
+    assert [e for e in te if e["ph"] == "C"][0]["args"] == {"value": 3}
+
+
+def test_jsonl_roundtrip_and_cli(tmp_path, capsys):
+    t = _sample_tracer()
+    p = write_jsonl(
+        str(tmp_path / "n0.trace.jsonl"), "n0", t.snapshot()
+    )
+    back = read_jsonl([str(tmp_path)])
+    assert list(back) == ["n0"] and len(back["n0"]) == 4
+
+    assert trace_cli(["dump", p]) == 0
+    lines = [
+        json.loads(ln)
+        for ln in capsys.readouterr().out.strip().splitlines()
+    ]
+    assert len(lines) == 4 and all(e["node"] == "n0" for e in lines)
+
+    out = tmp_path / "trace.json"
+    assert trace_cli(["convert", str(tmp_path), "-o", str(out)]) == 0
+    capsys.readouterr()
+    with open(out) as f:
+        assert "traceEvents" in json.load(f)
+
+    assert trace_cli(["summarize", p]) == 0
+    text = capsys.readouterr().out
+    assert "a.outer" in text and "p95ms" in text and "== n0 ==" in text
+
+    assert trace_cli(["summarize", "--json", p]) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["n0"]["a.outer"]["count"] == 1
+
+    # empty input is an error, not a silent pass
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert trace_cli(["summarize", str(empty)]) == 1
+
+
+def test_summary_percentiles():
+    durs = list(range(1, 101))  # 1..100 "ns"
+    events = [
+        {"name": "k", "ph": "X", "ts_ns": 0, "dur_ns": d, "tid": "t"}
+        for d in durs
+    ]
+    events.append(
+        {"name": "c", "ph": "C", "ts_ns": 0, "dur_ns": 0, "tid": "t",
+         "args": {"value": 9}}
+    )
+    s = summarize({"n": events})
+    k = s["n"]["k"]
+    assert k["count"] == 100
+    assert abs(percentile(sorted(durs), 0.5) - 50.5) < 1e-9
+    assert k["max_ms"] == round(100 / 1e6, 3)
+    assert s["n"]["_counters"] == {"c": 9}
+    assert percentile([], 0.5) == 0.0
+    assert percentile([7], 0.99) == 7.0
+
+
+# --- 3. live instrumentation --------------------------------------------
+
+
+def test_consensus_span_nesting_one_node():
+    """height ⊇ round ⊇ step on a real consensus run, plus mempool and
+    commit events — the per-node wiring end-to-end."""
+    from cometbft_tpu.node.inprocess import (
+        LocalNet,
+        build_node,
+        make_genesis,
+    )
+
+    async def main():
+        gen, pvs = make_genesis(1, chain_id="trace-nest")
+        parts = build_node(gen, pvs[0])
+        net = LocalNet([parts])
+        await net.start()
+        parts.mempool.check_tx(b"t=1")
+        await net.wait_for_height(3, 120)
+        await net.stop()
+        return parts
+
+    parts = run(main())
+    assert parts.tracer.enabled  # always-on default
+    ev = parts.tracer.snapshot()
+    _assert_consensus_nesting(ev, min_heights=3)
+    names = {e["name"] for e in ev}
+    assert {"mempool.insert", "mempool.reap", "consensus.commit"} <= names
+    reaps = [e for e in ev if e["name"] == "mempool.reap"]
+    assert any(e["args"].get("txs", 0) >= 1 for e in reaps)
+
+
+def _assert_consensus_nesting(events, min_heights=1, require_steps=()):
+    def encloses(o, i):
+        return (
+            o["ts_ns"] <= i["ts_ns"]
+            and o["ts_ns"] + o["dur_ns"] >= i["ts_ns"] + i["dur_ns"]
+        )
+
+    steps = [e for e in events if e["name"] == "consensus.step"]
+    rounds = [e for e in events if e["name"] == "consensus.round"]
+    heights = [e for e in events if e["name"] == "consensus.height"]
+    assert len(heights) >= min_heights, (len(heights), min_heights)
+    assert steps and rounds
+    for s in steps:
+        assert any(
+            r["args"]["height"] == s["args"]["height"]
+            and r["args"]["round"] == s["args"]["round"]
+            and encloses(r, s)
+            for r in rounds
+        ), f"step span not nested in its round: {s}"
+    for r in rounds:
+        assert any(
+            h["args"]["height"] == r["args"]["height"] and encloses(h, r)
+            for h in heights
+        ), f"round span not nested in its height: {r}"
+    kinds = {s["args"]["step"] for s in steps}
+    assert set(require_steps) <= kinds, (require_steps, kinds)
+
+
+def test_crypto_chunk_spans_on_process_tracer():
+    """The parallel-verify plane records dispatch instants + per-chunk
+    worker spans (worker id, lane count, tier) on the process-wide
+    tracer."""
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.crypto.parallel_verify import ParallelVerifyEngine
+    from cometbft_tpu.trace import enable_global, global_tracer
+
+    g = global_tracer()
+    was_enabled = g.enabled
+    enable_global()
+    g.clear()
+    try:
+        priv = Ed25519PrivKey.from_seed(b"\x11" * 32)
+        pk = priv.pub_key()
+        items = []
+        for i in range(40):
+            m = b"chunk-span-%03d" % i
+            items.append((pk, m, priv.sign(m)))
+        eng = ParallelVerifyEngine(workers=2, min_parallel=8)
+        try:
+            assert all(eng.verify(items))
+        finally:
+            eng.close()
+        ev = g.snapshot()
+        dispatches = [
+            e for e in ev if e["name"] == "crypto.batch.dispatch"
+        ]
+        chunks = [e for e in ev if e["name"] == "crypto.verify_chunk"]
+        if eng.tier == "serial":  # restricted box: pool creation failed
+            pytest.skip("no worker pool on this box")
+        assert dispatches and dispatches[0]["args"]["lanes"] == 40
+        assert dispatches[0]["args"]["tier"] == eng.tier
+        if eng.tier == "thread":
+            # thread tier shares the ring: chunk spans must be there,
+            # carrying worker id + lanes + tier
+            assert chunks
+            assert sum(c["args"]["lanes"] for c in chunks) == 40
+            assert all(
+                c["args"]["tier"] == "thread" and c["tid"]
+                for c in chunks
+            )
+    finally:
+        enable_global(was_enabled)
+        g.clear()
+
+
+# --- 4. ISSUE 4 acceptance: 4-node chaos run with tracing ---------------
+
+
+def test_chaos_run_traced_perfetto_loadable(tmp_path):
+    """A 4-node in-process chaos net with tracing enabled exports a
+    Perfetto-loadable trace whose consensus step spans nest correctly
+    per height/round on every node, with WAL fsync spans alongside."""
+    from cometbft_tpu.chaos import FaultSchedule, run_schedule
+
+    async def main():
+        return await run_schedule(
+            FaultSchedule([]),  # no faults: the fast acceptance run
+            seed=77,
+            base_dir=str(tmp_path / "net"),
+            n_nodes=4,
+            settle_heights=3,
+            liveness_bound_s=120.0,
+            trace_dir=str(tmp_path / "traces"),
+        )
+
+    report = run(main())
+    assert report.ok, report.format()
+    assert report.trace_files
+    jsonls = [p for p in report.trace_files if p.endswith(".jsonl")]
+    chrome = [p for p in report.trace_files if p.endswith("trace.json")]
+    # one ring per node (no restarts in this schedule)
+    node_dumps = [p for p in jsonls if "/n" in p]
+    assert len(node_dumps) == 4, report.trace_files
+    assert len(chrome) == 1
+
+    # Perfetto-loadable: valid JSON, traceEvents, process metadata for
+    # every node, X events with ts+dur
+    with open(chrome[0]) as f:
+        ct = json.load(f)
+    te = ct["traceEvents"]
+    procs = {
+        e["args"]["name"]
+        for e in te
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert {"n0", "n1", "n2", "n3"} <= procs
+    assert all(
+        "ts" in e and "dur" in e for e in te if e["ph"] == "X"
+    )
+
+    by_node = read_jsonl(node_dumps)
+    for node, events in by_node.items():
+        _assert_consensus_nesting(
+            events, min_heights=2,
+            require_steps=("PROPOSE", "PREVOTE", "PRECOMMIT", "COMMIT"),
+        )
+        names = {e["name"] for e in events}
+        # chaos homes persist a WAL: the fsync barrier must be spanned
+        assert "wal.fsync" in names, (node, sorted(names))
+    # and the summary machinery digests the whole dump
+    s = summarize(by_node)
+    assert all("consensus.step" in kinds for kinds in s.values())

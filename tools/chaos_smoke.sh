@@ -15,9 +15,18 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 SEED="${CHAOS_SEED:-1337}"
+TRACE_DIR="$(mktemp -d -t chaos_smoke_trace.XXXXXX)"
+trap 'rm -rf "$TRACE_DIR"' EXIT
 
 echo "== chaos smoke: invariants must hold (seed=$SEED) =="
-JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED"
+JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" \
+    --trace-dump "$TRACE_DIR"
+
+echo "== chaos smoke: per-node span summary (docs/TRACE.md) =="
+python -m cometbft_tpu.trace summarize "$TRACE_DIR"
 
 echo "== chaos smoke: byzantine corruption must be DETECTED =="
-JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" --byzantine 2
+# --trace-dump keeps the EXPECTED violation's auto-dump inside the
+# trap-cleaned dir instead of leaking a /tmp/chaos_trace_* per run
+JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" --byzantine 2 \
+    --trace-dump "$TRACE_DIR/byzantine"
