@@ -618,6 +618,120 @@ def bench_batch64() -> dict:
     }
 
 
+def bench_ingest() -> dict:
+    """Mempool ingest plane ablation (docs/PERF.md): the identical tx
+    workload (valid + app-rejected + duplicate + oversize txs) through
+    the serial check_tx loop vs the batched check_tx_batch path —
+    per-tx verdicts asserted identical, median of 3 runs each on this
+    throttled box. Host-only: measures the amortized per-item costs
+    (client mutex, cache/pool locks, tx_key hashing, ABCI dispatch),
+    no device involved."""
+    import statistics
+
+    from cometbft_tpu.abci import types as abci_t
+    from cometbft_tpu.abci.client import LocalClient
+    from cometbft_tpu.mempool.mempool import CListMempool
+
+    n = int(os.environ.get("BENCH_INGEST_TXS", "20000"))
+    batch = int(os.environ.get("BENCH_INGEST_BATCH", "256"))
+    repeats = int(os.environ.get("BENCH_INGEST_REPEATS", "3"))
+
+    class _App(abci_t.Application):
+        def check_tx(self, req):
+            if req.tx.startswith(b"bad"):
+                return abci_t.ResponseCheckTx(code=5, log="rejected")
+            return abci_t.ResponseCheckTx(gas_wanted=1)
+
+    work = []
+    for i in range(n):
+        work.append(b"ingest-%08d=%s" % (i, b"v" * 80))
+        if i % 23 == 0:
+            work.append(b"bad-%08d" % i)
+        if i % 17 == 0:
+            work.append(work[-2])  # in-stream duplicate
+    work.append(b"x" * (2 << 20))  # oversize
+
+    def build():
+        return CListMempool(
+            LocalClient(_App()),
+            max_txs=len(work) + 16,
+            cache_size=2 * len(work),
+            recheck=False,
+        )
+
+    import gc
+
+    # segment-interleaved pairing: within one repeat, serial and
+    # batched each process the SAME workload on their own fresh pool,
+    # alternating every `seg` txs — this box's throttling spikes
+    # (±30% run-to-run) then average over both legs instead of
+    # sinking whichever whole pass they land on. GC is collected
+    # before and disabled during the timed region for the same
+    # reason (a gen2 cycle mid-pass skews one leg).
+    seg = 2000
+    segments = [
+        (i, min(i + seg, len(work))) for i in range(0, len(work), seg)
+    ]
+
+    def run_pair(flip: bool):
+        mp_s, mp_b = build(), build()
+        codes_s, codes_b = [], []
+        t_s = t_b = 0.0
+        gc.collect()
+        gc.disable()
+        try:
+            for si, (lo, hi) in enumerate(segments):
+                for which in ((si + flip) % 2, (si + flip + 1) % 2):
+                    if which == 0:
+                        t0 = time.perf_counter()
+                        codes_s.extend(
+                            mp_s.check_tx(tx).code for tx in work[lo:hi]
+                        )
+                        t_s += time.perf_counter() - t0
+                    else:
+                        t0 = time.perf_counter()
+                        for j in range(lo, hi, batch):
+                            codes_b.extend(
+                                r.code
+                                for r in mp_b.check_tx_batch(
+                                    work[j:min(j + batch, hi)]
+                                )
+                            )
+                        t_b += time.perf_counter() - t0
+        finally:
+            gc.enable()
+        return t_s, t_b, codes_s, codes_b, mp_s.size(), mp_b.size()
+
+    # one throwaway pass: first-touch effects (native hasher
+    # build/dlopen, allocator warmup) must not land on either side
+    run_pair(False)
+    serial_ts, batched_ts, ratios = [], [], []
+    parity = True
+    for r in range(repeats):
+        t_s, t_b, codes_s, codes_b, size_s, size_b = run_pair(bool(r % 2))
+        serial_ts.append(t_s)
+        batched_ts.append(t_b)
+        ratios.append(t_s / t_b)
+        parity = parity and codes_s == codes_b and size_s == size_b
+    assert parity, "serial vs batched CheckTx verdicts diverged"
+    serial_rate = len(work) / statistics.median(serial_ts)
+    batched_rate = len(work) / statistics.median(batched_ts)
+    return {
+        "rate": round(batched_rate, 1),
+        "serial_txs_s": round(serial_rate, 1),
+        "batched_txs_s": round(batched_rate, 1),
+        "speedup": round(statistics.median(ratios), 2),
+        "speedups": [round(x, 2) for x in ratios],
+        "verdict_parity": True,
+        "n_txs": len(work),
+        "batch": batch,
+        "repeats": repeats,
+        "note": "serial check_tx loop vs batched check_tx_batch, "
+        "identical workload + verdicts; speedup = median of "
+        f"{repeats} paired-run ratios",
+    }
+
+
 def bench_commit150(gen, parts) -> dict:
     import cometbft_tpu.types as T
 
@@ -1086,6 +1200,7 @@ def main() -> None:
             "bisect",
             "mixed",
             "pipeline",
+            "ingest",
         }
         if which == "all"
         else set(which.split(","))
@@ -1205,6 +1320,10 @@ def main() -> None:
                 corpus_parts.close_stores()
     if "batch64" in todo:
         run_config("batch64", bench_batch64)
+    if "ingest" in todo:
+        # host-only mempool ingest ablation: cheap enough to always
+        # run (no corpus, no device, ~a minute on this box)
+        run_config("ingest", bench_ingest)
     budget_skip = {
         "skipped": f"host budget ({host_budget_s:.0f}s) "
         "exhausted before this config"

@@ -196,6 +196,14 @@ class SocketClient:
     def check_tx_async(self, req) -> Future:
         return self._send(codec.CHECK_TX, req)
 
+    def check_tx_batch(self, reqs):
+        """Batched CheckTx over the socket: pipeline every request
+        before waiting on any response, so the process boundary costs
+        one round-trip per BATCH instead of per tx (the wire protocol
+        is unchanged — FIFO request/response matching does the rest)."""
+        futs = [self._send(codec.CHECK_TX, r) for r in reqs]
+        return [f.result() for f in futs]
+
     def insert_tx(self, tx: bytes) -> bool:
         return self._call(codec.INSERT_TX, tx)
 

@@ -361,6 +361,15 @@ class Application:
     def check_tx(self, req: RequestCheckTx) -> ResponseCheckTx:
         return ResponseCheckTx()
 
+    # fork: batched CheckTx for the mempool ingest plane. The default
+    # is the per-tx loop, so every app supports the batch call and
+    # overriding it is purely an optimization (one VM entry / one DB
+    # snapshot per batch instead of per tx).
+    def check_tx_batch(
+        self, reqs: List[RequestCheckTx]
+    ) -> List[ResponseCheckTx]:
+        return [self.check_tx(r) for r in reqs]
+
     # fork: app-side mempool (abci/types/application.go:16-17)
     def insert_tx(self, tx: bytes) -> bool:
         raise NotImplementedError("app-side mempool not supported")

@@ -307,6 +307,96 @@ def sync_lock_across_await(ctx: FileContext) -> List[Finding]:
     return out
 
 
+# ABCI application-surface methods (abci/types.py Application + the
+# fork's app-mempool/batch extensions): a synchronous call to any of
+# these inside a reactor's receive() runs an app round-trip on the
+# event loop — every peer connection stalls behind one tx.
+_ABCI_SYNC_METHODS = {
+    "check_tx",
+    "check_tx_batch",
+    "insert_tx",
+    "reap_txs",
+    "query",
+    "info",
+    "echo",
+    "init_chain",
+    "prepare_proposal",
+    "process_proposal",
+    "extend_vote",
+    "verify_vote_extension",
+    "finalize_block",
+    "commit",
+    "list_snapshots",
+    "offer_snapshot",
+    "load_snapshot_chunk",
+    "apply_snapshot_chunk",
+}
+
+# receiver spellings that mark the call as an ABCI/mempool path
+# (name-based like the other rules: `self.mempool.check_tx`,
+# `self.proxy.query`, `env.proxy.mempool.check_tx`, ...)
+_ABCI_RECEIVER_SEGMENTS = {"proxy", "mempool", "app", "abci", "client"}
+
+
+def _reactor_classes(tree: ast.Module) -> Iterator[ast.ClassDef]:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        names = [node.name] + [
+            b for base in node.bases if (b := dotted(base)) is not None
+        ]
+        if any(n.endswith("Reactor") for n in names):
+            yield node
+
+
+@rule(
+    "ASY108",
+    "sync-abci-in-receive",
+    "a synchronous ABCI proxy/mempool call inside a reactor receive() "
+    "blocks the p2p event loop on an app round-trip; enqueue to the "
+    "mempool ingest plane or offload via asyncio.to_thread",
+)
+def sync_abci_in_receive(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in _reactor_classes(ctx.tree):
+        for fn in cls.body:
+            # receive() is a SYNC callback by contract; an async
+            # variant would be a different bug (the switch never
+            # awaits it) caught by ASY102 at the call site
+            if not (
+                isinstance(fn, ast.FunctionDef) and fn.name == "receive"
+            ):
+                continue
+            for node in walk_in_function(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func)
+                if name is None or "." not in name:
+                    continue
+                parts = name.split(".")
+                if parts[-1] not in _ABCI_SYNC_METHODS:
+                    continue
+                recv_segments = {
+                    s
+                    for part in parts[:-1]
+                    for s in part.lower().split("_")
+                }
+                if not recv_segments & _ABCI_RECEIVER_SEGMENTS:
+                    continue
+                out.append(
+                    Finding(
+                        ctx.path, node.lineno, node.col_offset,
+                        "ASY108", "sync-abci-in-receive",
+                        f"`{name}` inside `{cls.name}.receive`: a "
+                        "synchronous ABCI call on the p2p dispatch "
+                        "path stalls every peer behind one app "
+                        "round-trip — enqueue (mempool/ingest.py) or "
+                        "offload to a thread",
+                    )
+                )
+    return out
+
+
 @rule(
     "ASY106",
     "nested-event-loop",

@@ -121,9 +121,14 @@ class ChaosNet:
         seed: int,
         base_dir: str,
         table: Optional[LinkTable] = None,
+        config_hook=None,
     ):
         self.seed = seed
         self.base_dir = base_dir
+        # optional Config mutator applied to every node build — chaos
+        # runs can pin feature knobs (e.g. mempool.async_recheck)
+        # without forking the harness
+        self.config_hook = config_hook
         self.table = table or LinkTable(seed)
         self.genesis, pvs = make_genesis(
             n_nodes, chain_id=f"chaos-{seed}"
@@ -149,6 +154,8 @@ class ChaosNet:
         cfg.rpc.laddr = ""  # invariants read stores directly
         cfg.blocksync.enable = False
         cfg.p2p.pex = False
+        if self.config_hook is not None:
+            self.config_hook(cfg)
         info = NodeInfo(
             node_id=cn.node_id,
             network=self.genesis.chain_id,
@@ -324,6 +331,7 @@ async def run_schedule(
     liveness_bound_s: float = 60.0,
     fuzz_config=None,
     trace_dir: Optional[str] = None,
+    config_hook=None,
 ) -> ChaosReport:
     """Execute one seeded chaos run end-to-end and return its report
     (violations recorded, not raised — callers assert on report.ok).
@@ -334,7 +342,9 @@ async def run_schedule(
     + fault trace in the report — the timeline of what each node was
     doing is part of the replay contract."""
     table = LinkTable(seed, fuzz_config=fuzz_config)
-    net = ChaosNet(n_nodes, seed, base_dir, table=table)
+    net = ChaosNet(
+        n_nodes, seed, base_dir, table=table, config_hook=config_hook
+    )
     report = ChaosReport(seed=seed, schedule_json=schedule.to_json())
     nemesis = Nemesis(net, schedule)
 

@@ -86,6 +86,17 @@ class MempoolConfig:
     cache_size: int = 10000
     max_tx_bytes: int = 1024 * 1024
     max_txs_bytes: int = 64 * 1024 * 1024
+    # ingest plane (docs/PERF.md "Mempool ingest plane"): micro-batch
+    # coalescing in front of CheckTx — max txs per batch, and how long
+    # the drainer waits after the first tx before flushing a partial
+    # batch (latency bound for a lone RPC submission)
+    batch_max_txs: int = 256
+    batch_flush_ms: float = 2.0
+    # post-commit recheck off the consensus critical section:
+    # update() snapshots and returns; verdicts apply in the
+    # background, height-guarded, with unrechecked txs masked from
+    # reap. Off = the reference's synchronous recheck-inside-update.
+    async_recheck: bool = True
 
 
 @dataclass

@@ -6,15 +6,30 @@ by max bytes/gas, post-commit update with recheck, TxsAvailable
 notification. The reference's concurrent linked list becomes an
 insertion-ordered dict under one lock — the Python runtime serializes
 reactor callbacks anyway; gossip iterates over snapshots.
+
+Ingest plane (docs/PERF.md "Mempool ingest plane"): beside the serial
+``check_tx`` path there is a batched one — ``check_tx_batch`` hashes
+every tx key in one native pass (tx_keys), prechecks against the
+cache under one cache lock, issues ONE ``check_tx_batch`` ABCI call
+(per-tx fallback preserved) and admits the survivors under one pool
+lock. Post-commit recheck can run asynchronously (``async_recheck``):
+``update()`` snapshots the pool and returns immediately; a background
+executor rechecks the snapshot in one batched ABCI call, and a
+generation guard drops stale verdicts for txs committed/evicted since
+the snapshot. While a recheck is in flight its txs are masked from
+``reap_max_bytes_max_gas`` so a proposer never includes a tx whose
+post-commit validity is still unknown (the reference's
+notifyTxsAvailable-after-recheck discipline).
 """
 
 from __future__ import annotations
 
 import hashlib
 import threading
+import traceback
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..abci import types as abci
 from ..trace import NOOP as TRACE_NOOP
@@ -24,33 +39,78 @@ def tx_key(tx: bytes) -> bytes:
     return hashlib.sha256(tx).digest()
 
 
+# below this many txs the native call's fixed overhead beats the win
+_NATIVE_HASH_MIN = 4
+
+# async recheck issues its ABCI batches in chunks of this many txs so
+# the shared app mutex is released between them (consensus' next
+# FinalizeBlock must not queue behind a whole-pool batch)
+_RECHECK_CHUNK = 256
+
+# cache-duplicate reject log, shared by the serial path and the
+# batch path's intra-batch duplicate resolution (matching on it
+# decides whether a duplicate re-enters the next round)
+_LOG_CACHE_DUP = "tx already in cache"
+
+
+def tx_keys(txs: Sequence[bytes]) -> List[bytes]:
+    """All tx keys in one pass: the native batch hasher
+    (native/wirecodec.cpp sha256_many, same build-on-demand loader the
+    merkle tree uses) when available, hashlib otherwise. Bit-identical
+    either way — sha256 is sha256."""
+    if len(txs) >= _NATIVE_HASH_MIN:
+        from ..utils import wirecodec
+
+        nat = wirecodec.module()
+        if nat is not None:
+            f = getattr(nat, "sha256_many", None)
+            if f is not None:
+                try:
+                    return list(f(txs))
+                except Exception:  # pragma: no cover - non-bytes items
+                    pass
+    sha = hashlib.sha256
+    return [sha(t).digest() for t in txs]
+
+
 class TxCache:
-    """LRU of recently seen tx keys (reference mempool/cache.go)."""
+    """LRU of recently seen tx KEYS (reference mempool/cache.go).
+
+    Keyed API: callers hash once (tx_key / tx_keys) and pass the
+    32-byte key — the cache never rehashes the full tx."""
 
     def __init__(self, size: int = 10000):
         self.size = size
         self._od: "OrderedDict[bytes, None]" = OrderedDict()
         self._lock = threading.Lock()
 
-    def push(self, tx: bytes) -> bool:
+    def push(self, key: bytes) -> bool:
         """False if already present."""
-        k = tx_key(tx)
         with self._lock:
-            if k in self._od:
-                self._od.move_to_end(k)
-                return False
-            self._od[k] = None
-            while len(self._od) > self.size:
-                self._od.popitem(last=False)
-            return True
+            return self._push_locked(key)
 
-    def remove(self, tx: bytes) -> None:
-        with self._lock:
-            self._od.pop(tx_key(tx), None)
+    def _push_locked(self, key: bytes) -> bool:
+        if key in self._od:
+            self._od.move_to_end(key)
+            return False
+        self._od[key] = None
+        while len(self._od) > self.size:
+            self._od.popitem(last=False)
+        return True
 
-    def has(self, tx: bytes) -> bool:
+    def push_many(self, keys: Sequence[bytes]) -> List[bool]:
+        """Batch push under ONE lock acquisition; duplicates within
+        the batch reject exactly like sequential pushes would."""
         with self._lock:
-            return tx_key(tx) in self._od
+            return [self._push_locked(k) for k in keys]
+
+    def remove(self, key: bytes) -> None:
+        with self._lock:
+            self._od.pop(key, None)
+
+    def has(self, key: bytes) -> bool:
+        with self._lock:
+            return key in self._od
 
 
 @dataclass
@@ -71,6 +131,15 @@ class Mempool:
 
     def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
         raise NotImplementedError
+
+    def check_tx_batch(
+        self, txs: List[bytes], senders: Optional[List[str]] = None
+    ) -> List[abci.ResponseCheckTx]:
+        """Default: the serial path per tx (flavors without a batched
+        ingest plane stay correct)."""
+        if senders is None:
+            senders = [""] * len(txs)
+        return [self.check_tx(t, s) for t, s in zip(txs, senders)]
 
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
         raise NotImplementedError
@@ -107,6 +176,7 @@ class CListMempool(Mempool):
         max_txs: int = 5000,
         recheck: bool = True,
         notify: Optional[Callable[[], None]] = None,
+        async_recheck: bool = False,
     ):
         self.proxy = proxy_app
         self.height = height
@@ -120,9 +190,18 @@ class CListMempool(Mempool):
         self.max_tx_bytes = max_tx_bytes
         self.max_txs = max_txs
         self.recheck = recheck
+        self.async_recheck = async_recheck
         self._lock = threading.RLock()
         self._txs_available = threading.Event()
         self._notify = notify
+        # async-recheck state, all guarded by self._lock: keys of the
+        # current recheck snapshot (masked from reap), the generation
+        # the snapshot belongs to (bumped every update/flush so a
+        # superseded recheck drops its verdicts wholesale), and the
+        # lazily-built single-thread executor the recheck runs on
+        self._recheck_pending: set = set()
+        self._recheck_gen = 0
+        self._recheck_executor = None
 
     # --- ingress ------------------------------------------------------
 
@@ -141,30 +220,166 @@ class CListMempool(Mempool):
     def _check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
         if len(tx) > self.max_tx_bytes:
             return abci.ResponseCheckTx(code=1, log="tx too large")
-        if not self.cache.push(tx):
-            k = tx_key(tx)
+        key = tx_key(tx)
+        if not self.cache.push(key):
             with self._lock:
-                if k in self.pool and sender:
-                    self.pool[k].senders.add(sender)
-            return abci.ResponseCheckTx(code=1, log="tx already in cache")
+                return self._cache_dup_locked(key, sender)
         res = self.proxy.check_tx(abci.RequestCheckTx(tx=tx))
+        with self._lock:
+            res = self._admit_locked(tx, key, sender, res)
         if res.is_ok():
-            with self._lock:
-                if len(self.pool) >= self.max_txs:
-                    self.cache.remove(tx)
-                    return abci.ResponseCheckTx(code=1, log="mempool full")
-                mt = MempoolTx(tx=tx, height=self.height, gas_wanted=res.gas_wanted)
-                if sender:
-                    mt.senders.add(sender)
-                self.pool[tx_key(tx)] = mt
-                self._seq += 1
-                self._log.append((self._seq, tx_key(tx)))
-                self._txs_available.set()
+            self._txs_available.set()
             if self._notify:
                 self._notify()
-        else:
-            self.cache.remove(tx)
         return res
+
+    def _cache_dup_locked(
+        self, key: bytes, sender: str
+    ) -> abci.ResponseCheckTx:
+        """Cache-duplicate reject; caller holds self._lock. Records
+        the extra sender for gossip echo suppression."""
+        if sender:
+            mt = self.pool.get(key)
+            if mt is not None:
+                mt.senders.add(sender)
+        return abci.ResponseCheckTx(code=1, log=_LOG_CACHE_DUP)
+
+    def _admit_locked(
+        self, tx: bytes, key: bytes, sender: str, res: abci.ResponseCheckTx
+    ) -> abci.ResponseCheckTx:
+        """Post-ABCI pool insertion; caller holds self._lock."""
+        if res.is_ok():
+            if len(self.pool) >= self.max_txs:
+                self.cache.remove(key)
+                return abci.ResponseCheckTx(code=1, log="mempool full")
+            mt = MempoolTx(tx=tx, height=self.height, gas_wanted=res.gas_wanted)
+            if sender:
+                mt.senders.add(sender)
+            self.pool[key] = mt
+            self._seq += 1
+            self._log.append((self._seq, key))
+            # txs_available is set by the CALLER — once per tx on the
+            # serial path, once per BATCH on the batched one (Event.set
+            # takes a condition lock + notify_all; per-item it was ~25%
+            # of the serial ingest wall)
+        else:
+            self.cache.remove(key)
+        return res
+
+    def check_tx_batch(
+        self, txs: List[bytes], senders: Optional[List[str]] = None
+    ) -> List[abci.ResponseCheckTx]:
+        """Batched ingest: hash all keys in one native pass, precheck
+        under one cache lock, ONE check_tx_batch ABCI call for the
+        survivors (per-tx fallback inside _proxy_check_tx_batch),
+        admit under one pool lock. Verdicts are identical to running
+        check_tx serially over the same txs."""
+        n = len(txs)
+        if n == 0:
+            return []
+        if senders is None:
+            senders = [""] * n
+        with self.tracer.span(
+            "mempool.batch", tid="mempool", txs=n
+        ) as sp:
+            out: List[Optional[abci.ResponseCheckTx]] = [None] * n
+            remaining: List[int] = []
+            for i, tx in enumerate(txs):
+                if len(tx) > self.max_tx_bytes:
+                    out[i] = abci.ResponseCheckTx(
+                        code=1, log="tx too large"
+                    )
+                else:
+                    remaining.append(i)
+            keys: Dict[int, bytes] = dict(
+                zip(remaining, tx_keys([txs[i] for i in remaining]))
+            )
+            n_ok = n_checked = 0
+            # Round-based so verdicts are EXACTLY serial-equivalent
+            # even with intra-batch duplicates: the first occurrence
+            # of a key processes this round; later occurrences wait
+            # on its verdict — a cache-removing outcome (app reject /
+            # pool full) means the serial loop would have re-checked
+            # the duplicate through the app, so it re-enters the next
+            # round. Real workloads resolve in one round; a batch of
+            # k identical rejected txs degrades to k rounds, i.e. to
+            # the serial cost, never worse.
+            while remaining:
+                first_of: Dict[bytes, int] = {}
+                round_items: List[int] = []
+                deferred: List[int] = []
+                for i in remaining:
+                    if keys[i] in first_of:
+                        deferred.append(i)
+                    else:
+                        first_of[keys[i]] = i
+                        round_items.append(i)
+                fresh = self.cache.push_many(
+                    [keys[i] for i in round_items]
+                )
+                dups: List[int] = []
+                pending: List[int] = []
+                for i, f in zip(round_items, fresh):
+                    (pending if f else dups).append(i)
+                results = (
+                    self._proxy_check_tx_batch(
+                        [abci.RequestCheckTx(tx=txs[i]) for i in pending]
+                    )
+                    if pending
+                    else []
+                )
+                n_checked += len(pending)
+                remaining = []
+                with self._lock:
+                    for i in dups:
+                        out[i] = self._cache_dup_locked(
+                            keys[i], senders[i]
+                        )
+                    for i, res in zip(pending, results):
+                        out[i] = self._admit_locked(
+                            txs[i], keys[i], senders[i], res
+                        )
+                        if out[i].is_ok():
+                            n_ok += 1
+                    for i in deferred:
+                        pres = out[first_of[keys[i]]]
+                        if pres.is_ok() or pres.log == _LOG_CACHE_DUP:
+                            out[i] = self._cache_dup_locked(
+                                keys[i], senders[i]
+                            )
+                        else:
+                            remaining.append(i)
+            if n_ok:
+                self._txs_available.set()
+                if self._notify:
+                    self._notify()
+            sp.set(ok=n_ok, checked=n_checked)
+        self.tracer.counter("mempool.size", len(self.pool), tid="mempool")
+        return out  # type: ignore[return-value]
+
+    def _proxy_check_tx_batch(
+        self, reqs: List[abci.RequestCheckTx]
+    ) -> List[abci.ResponseCheckTx]:
+        """One batched ABCI call when the proxy supports the fork
+        extension, an automatic per-tx fallback loop otherwise
+        (mirrors how InsertTx/ReapTxs degrade in abci/types.py)."""
+        fn = getattr(self.proxy, "check_tx_batch", None)
+        if fn is not None:
+            try:
+                res = fn(reqs)
+            except NotImplementedError:
+                res = None
+            if res is not None:
+                if len(res) != len(reqs):
+                    # a short list would silently zip-truncate
+                    # verdicts downstream (None entries, unresolved
+                    # ingest futures) — fail the batch loudly instead
+                    raise RuntimeError(
+                        "check_tx_batch returned "
+                        f"{len(res)} responses for {len(reqs)} requests"
+                    )
+                return res
+        return [self.proxy.check_tx(r) for r in reqs]
 
     # --- egress -------------------------------------------------------
 
@@ -172,7 +387,13 @@ class CListMempool(Mempool):
         out, total_b, total_g = [], 0, 0
         with self.tracer.span("mempool.reap", tid="mempool") as sp:
             with self._lock:
-                for mt in self.pool.values():
+                pending = self._recheck_pending
+                for k, mt in self.pool.items():
+                    if pending and k in pending:
+                        # recheck verdict still in flight: a proposer
+                        # must not include a tx the app may be about
+                        # to invalidate post-commit
+                        continue
                     nb = total_b + len(mt.tx)
                     ng = total_g + mt.gas_wanted
                     if max_bytes >= 0 and nb > max_bytes:
@@ -213,6 +434,11 @@ class CListMempool(Mempool):
         with self._lock:
             return len(self.pool)
 
+    def recheck_pending(self) -> int:
+        """Txs masked from reap while their recheck is in flight."""
+        with self._lock:
+            return len(self._recheck_pending)
+
     # --- post-commit --------------------------------------------------
 
     def lock(self):
@@ -223,19 +449,36 @@ class CListMempool(Mempool):
 
     def update(self, height: int, txs: List[bytes], results) -> None:
         """Called with the mempool LOCKED, between FinalizeBlock and
-        releasing consensus (reference clist_mempool.go:583)."""
+        releasing consensus (reference clist_mempool.go:583). With
+        async_recheck the recheck leaves the critical section: wall
+        time here no longer scales with the pooled tx count."""
         self.height = height
-        for tx, res in zip(txs, results):
+        committed_keys = tx_keys(txs) if txs else []
+        for key, res in zip(committed_keys, results):
             if res.is_ok():
-                self.cache.push(tx)  # keep committed txs in cache
+                self.cache.push(key)  # keep committed txs in cache
             else:
-                self.cache.remove(tx)
-            self.pool.pop(tx_key(tx), None)
+                self.cache.remove(key)
+            self.pool.pop(key, None)
+        # any in-flight recheck is stale the moment a block commits:
+        # bump the generation so its verdicts are dropped wholesale
+        # and reset the reap mask (re-populated if we re-snapshot)
+        self._recheck_gen += 1
+        self._recheck_pending = set()
+        scheduled = False
         if self.recheck and self.pool:
-            self._recheck_txs()
+            if self.async_recheck:
+                scheduled = self._schedule_recheck(height)
+            else:
+                self._recheck_txs()
         if len(self._log) > 4 * len(self.pool) + 1024:
             self._log = [e for e in self._log if e[1] in self.pool]
-        if self.pool:
+        if scheduled:
+            # availability decided when the verdicts land (the whole
+            # pool is masked right now); an empty pool can't happen
+            # here — recheck only scheduled when self.pool is truthy
+            pass
+        elif self.pool:
             self._txs_available.set()
             if self._notify:
                 self._notify()
@@ -244,16 +487,112 @@ class CListMempool(Mempool):
         self.tracer.counter("mempool.size", len(self.pool), tid="mempool")
 
     def _recheck_txs(self) -> None:
-        for k in list(self.pool.keys()):
-            mt = self.pool[k]
-            res = self.proxy.check_tx(
-                abci.RequestCheckTx(
-                    tx=mt.tx, type_=abci.CHECK_TX_TYPE_RECHECK
-                )
-            )
+        """Synchronous recheck (async_recheck off): one batched ABCI
+        call for the whole pool, still inside the consensus critical
+        section."""
+        snapshot = [(k, self.pool[k].tx) for k in self.pool.keys()]
+        results = self._proxy_check_tx_batch(
+            [
+                abci.RequestCheckTx(tx=tx, type_=abci.CHECK_TX_TYPE_RECHECK)
+                for _, tx in snapshot
+            ]
+        )
+        for (k, _), res in zip(snapshot, results):
             if not res.is_ok():
-                del self.pool[k]
-                self.cache.remove(mt.tx)
+                mt = self.pool.pop(k, None)
+                if mt is not None:
+                    self.cache.remove(k)
+
+    def _schedule_recheck(self, height: int) -> bool:
+        """Snapshot the pool, mask it from reap, and hand the batch to
+        the background executor. Caller holds self._lock (update runs
+        inside the consensus critical section)."""
+        snapshot = [(k, mt.tx) for k, mt in self.pool.items()]
+        self._recheck_pending = {k for k, _ in snapshot}
+        ex = self._recheck_executor
+        if ex is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            ex = self._recheck_executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="mempool-recheck"
+            )
+        ex.submit(self._run_recheck, self._recheck_gen, height, snapshot)
+        return True
+
+    def _run_recheck(
+        self, gen: int, height: int, snapshot: List[Tuple[bytes, bytes]]
+    ) -> None:
+        """Background half of the async recheck. Height/generation
+        guarded: if another update (or flush) landed while an ABCI
+        chunk was in flight, the remaining verdicts are stale — the
+        newer update's own recheck owns the pool — so they are
+        dropped wholesale and the pending mask is left to the newer
+        owner. The snapshot is rechecked in CHUNKS so the shared app
+        mutex is released between them: one whole-pool batch would
+        head-of-line-block the next height's FinalizeBlock for the
+        full recheck wall (the stall this plane exists to kill).
+        Verdicts apply per chunk, so reap unmasks progressively."""
+        try:
+            with self.tracer.span(
+                "mempool.recheck", tid="mempool",
+                txs=len(snapshot), height=height,
+            ) as sp:
+                removed = 0
+                for lo in range(0, len(snapshot), _RECHECK_CHUNK):
+                    chunk = snapshot[lo:lo + _RECHECK_CHUNK]
+                    try:
+                        results = self._proxy_check_tx_batch(
+                            [
+                                abci.RequestCheckTx(
+                                    tx=tx,
+                                    type_=abci.CHECK_TX_TYPE_RECHECK,
+                                )
+                                for _, tx in chunk
+                            ]
+                        )
+                    except Exception:
+                        # app unreachable mid-recheck: fail open
+                        # (keep these txs, unmask them) — the next
+                        # update rechecks again
+                        traceback.print_exc()
+                        results = [abci.ResponseCheckTx()] * len(chunk)
+                    with self._lock:
+                        if gen != self._recheck_gen or height != self.height:
+                            sp.set(stale=True)
+                            return
+                        for (k, _), res in zip(chunk, results):
+                            self._recheck_pending.discard(k)
+                            if not res.is_ok():
+                                mt = self.pool.pop(k, None)
+                                if mt is not None:
+                                    self.cache.remove(k)
+                                    removed += 1
+                with self._lock:
+                    if gen != self._recheck_gen or height != self.height:
+                        sp.set(stale=True)
+                        return
+                    self._recheck_pending = set()
+                    has_txs = bool(self.pool)
+                    # availability decided UNDER the lock: a clear()
+                    # outside it could clobber the event a concurrent
+                    # admission just set
+                    if has_txs:
+                        self._txs_available.set()
+                    else:
+                        self._txs_available.clear()
+                sp.set(removed=removed)
+            if has_txs and self._notify:
+                self._notify()
+            self.tracer.counter(
+                "mempool.size", len(self.pool), tid="mempool"
+            )
+        except Exception:  # pragma: no cover - belt and braces
+            # executor futures swallow exceptions silently; a recheck
+            # crash must at least leave a trace and unmask the pool
+            traceback.print_exc()
+            with self._lock:
+                if gen == self._recheck_gen:
+                    self._recheck_pending = set()
 
     def txs_available(self) -> threading.Event:
         return self._txs_available
@@ -261,6 +600,8 @@ class CListMempool(Mempool):
     def flush(self) -> None:
         with self._lock:
             self.pool.clear()
+            self._recheck_gen += 1  # abort any in-flight recheck
+            self._recheck_pending = set()
             self._txs_available.clear()
 
 

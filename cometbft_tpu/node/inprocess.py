@@ -186,6 +186,7 @@ def build_node(
             max_tx_bytes=config.mempool.max_tx_bytes,
             max_txs=config.mempool.size,
             recheck=config.mempool.recheck,
+            async_recheck=config.mempool.async_recheck,
         )
     block_exec = BlockExecutor(
         state_store,

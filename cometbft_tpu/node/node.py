@@ -121,7 +121,10 @@ class Node:
             )
         else:
             self.mempool_reactor = MempoolReactor(
-                self.parts.mempool, broadcast=config.mempool.broadcast
+                self.parts.mempool,
+                broadcast=config.mempool.broadcast,
+                batch_max_txs=config.mempool.batch_max_txs,
+                batch_flush_ms=config.mempool.batch_flush_ms,
             )
         self.evidence_reactor = EvidenceReactor(self.parts.evpool)
         self.blocksync_reactor = BlockSyncNetReactor(

@@ -418,13 +418,13 @@ def check_tx(env, tx=None) -> Dict[str, Any]:
 
 def broadcast_tx_async(env, tx=None) -> Dict[str, Any]:
     raw = _bytes_param(tx)
-    env.submit_tx(raw)
+    env.submit_tx_nowait(raw)
     return {"code": 0, "data": "", "log": "", "hash": enc.hexb(_tx_hash(raw))}
 
 
-def broadcast_tx_sync(env, tx=None) -> Dict[str, Any]:
+async def broadcast_tx_sync(env, tx=None) -> Dict[str, Any]:
     raw = _bytes_param(tx)
-    res = env.submit_tx(raw)
+    res = await env.submit_tx_async(raw)
     return {
         "code": res.code,
         "data": "",
@@ -443,7 +443,7 @@ async def broadcast_tx_commit(env, tx=None, timeout_s: float = 10.0):
         lambda e: e.type_ == "Tx" and e.attrs.get("hash") == key.hex()
     )
     try:
-        res = env.submit_tx(raw)
+        res = await env.submit_tx_async(raw)
         if res.code != 0:
             return {
                 "check_tx": {"code": res.code, "log": res.log},

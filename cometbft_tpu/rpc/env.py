@@ -27,11 +27,49 @@ class Environment:
     mempool_reactor: object = None  # for app-mempool local submission
 
     def submit_tx(self, tx: bytes):
-        """CheckTx + (app-mempool) gossip: RPC broadcast entry point."""
+        """CheckTx + (app-mempool) gossip: RPC broadcast entry point
+        (synchronous direct path; the async routes prefer
+        submit_tx_async below)."""
         r = self.mempool_reactor
         if r is not None and hasattr(r, "submit_local"):
             return r.submit_local(tx)
         return self.mempool.check_tx(tx)
+
+    def _ingest(self):
+        """The mempool reactor's running ingest queue, or None."""
+        ing = getattr(self.mempool_reactor, "ingest", None)
+        return ing if ing is not None and ing.running else None
+
+    async def submit_tx_async(self, tx: bytes):
+        """Broadcast entry for async routes: enqueue on the mempool
+        ingest plane (batched CheckTx, event loop never blocks) and
+        await the verdict; degrade to the direct path off-loop when
+        the plane isn't running (nop/app mempool, inspect mode)."""
+        import asyncio
+
+        ing = self._ingest()
+        if ing is not None:
+            return await ing.submit(tx)
+        return await asyncio.to_thread(self.submit_tx, tx)
+
+    def submit_tx_nowait(self, tx: bytes) -> None:
+        """Fire-and-forget broadcast (broadcast_tx_async route)."""
+        ing = self._ingest()
+        if ing is not None:
+            # a full queue DROPS the tx (counted by the queue): that
+            # is the overload backpressure the bounded queue exists
+            # for — spawning direct-check tasks here would grow
+            # unboundedly on exactly the flood being shed
+            ing.submit_nowait(tx)
+            return
+        import asyncio
+
+        from ..utils.tasks import spawn
+
+        spawn(
+            asyncio.to_thread(self.submit_tx, tx),
+            name="broadcast-tx-async",
+        )
 
     @classmethod
     def from_node(cls, node) -> "Environment":

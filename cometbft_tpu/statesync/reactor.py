@@ -13,6 +13,7 @@ from ..abci import types as abci
 from ..p2p.node_info import ChannelDescriptor
 from ..p2p.reactor import Reactor
 from ..utils import proto
+from ..utils.tasks import spawn
 from .syncer import Syncer
 
 SNAPSHOT_CHANNEL = 0x60
@@ -123,27 +124,20 @@ class StateSyncReactor(Reactor):
         mtype = msg[0]
         body = msg[1:]
         if mtype == MSG_SNAPSHOTS_REQUEST:
-            for snap in (self.proxy.snapshot.list_snapshots() or [])[
-                -MAX_ADVERTISED_SNAPSHOTS:
-            ]:
-                peer.try_send(
-                    SNAPSHOT_CHANNEL,
-                    bytes([MSG_SNAPSHOTS_RESPONSE])
-                    + _encode_snapshot(snap),
-                )
+            # serving hits the app's snapshot store (disk): off-loop
+            # (bftlint ASY108 — receive must never run an ABCI call)
+            spawn(
+                self._serve_snapshots(peer),
+                name="statesync-serve-snapshots",
+            )
         elif mtype == MSG_SNAPSHOTS_RESPONSE:
             if self.syncer is not None:
                 self.syncer.pool.add(peer.peer_id, _decode_snapshot(body))
         elif mtype == MSG_CHUNK_REQUEST:
             height, format_, index = struct.unpack(">qii", body)
-            chunk = self.proxy.snapshot.load_snapshot_chunk(
-                height, format_, index
-            )
-            peer.try_send(
-                CHUNK_CHANNEL,
-                bytes([MSG_CHUNK_RESPONSE])
-                + struct.pack(">qii?", height, format_, index, bool(chunk))
-                + (chunk or b""),
+            spawn(
+                self._serve_chunk(peer, height, format_, index),
+                name="statesync-serve-chunk",
             )
         elif mtype == MSG_CHUNK_RESPONSE:
             height, format_, index, ok = struct.unpack(">qii?", body[:17])
@@ -153,3 +147,26 @@ class StateSyncReactor(Reactor):
                 fut.set_result(chunk)
         else:
             raise ValueError(f"unknown statesync msg type {mtype}")
+
+    async def _serve_snapshots(self, peer) -> None:
+        snaps = await asyncio.to_thread(
+            self.proxy.snapshot.list_snapshots
+        )
+        for snap in (snaps or [])[-MAX_ADVERTISED_SNAPSHOTS:]:
+            peer.try_send(
+                SNAPSHOT_CHANNEL,
+                bytes([MSG_SNAPSHOTS_RESPONSE]) + _encode_snapshot(snap),
+            )
+
+    async def _serve_chunk(
+        self, peer, height: int, format_: int, index: int
+    ) -> None:
+        chunk = await asyncio.to_thread(
+            self.proxy.snapshot.load_snapshot_chunk, height, format_, index
+        )
+        peer.try_send(
+            CHUNK_CHANNEL,
+            bytes([MSG_CHUNK_RESPONSE])
+            + struct.pack(">qii?", height, format_, index, bool(chunk))
+            + (chunk or b""),
+        )

@@ -69,6 +69,7 @@ def module():
                         _SRC,
                         "-o",
                         _SO,
+                        "-ldl",  # sha256_many dlopens libcrypto
                     ],
                     check=True,
                     capture_output=True,

@@ -19,6 +19,7 @@
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <dlfcn.h>
 
 #include <array>
 #include <cstdint>
@@ -598,6 +599,74 @@ static PyObject* wc_commit_merkle_root(PyObject*, PyObject* args) {
   return PyBytes_FromStringAndSize((const char*)out, 32);
 }
 
+// sha256_many(items) -> list[bytes32]: one digest per input, computed
+// in a single C++ pass. The mempool ingest plane hashes every tx key
+// of a batch through here (mempool/mempool.py tx_keys): per-call
+// hashlib overhead (object alloc + GIL bounce per tx) dominates the
+// actual compression work at typical ~100-byte tx sizes. When
+// libcrypto is present its one-shot SHA256() is used (hardware SHA
+// extensions — the portable implementation below exists for the
+// merkle tree and as the no-libcrypto fallback; both are sha256, so
+// the digests are identical either way).
+typedef unsigned char* (*fn_ossl_sha256)(const unsigned char*, size_t,
+                                         unsigned char*);
+
+static fn_ossl_sha256 ossl_sha256() {
+  static fn_ossl_sha256 fn = []() -> fn_ossl_sha256 {
+    const char* names[] = {"libcrypto.so.3", "libcrypto.so.1.1",
+                           "libcrypto.so"};
+    for (const char* n : names) {
+      if (void* lib = dlopen(n, RTLD_NOW | RTLD_GLOBAL)) {
+        if (void* sym = dlsym(lib, "SHA256"))
+          return reinterpret_cast<fn_ossl_sha256>(sym);
+      }
+    }
+    return nullptr;
+  }();
+  return fn;
+}
+
+static PyObject* wc_sha256_many(PyObject*, PyObject* args) {
+  PyObject* items;
+  if (!PyArg_ParseTuple(args, "O", &items)) return nullptr;
+  PyObject* seq = PySequence_Fast(items, "items must be a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  PyObject* out = PyList_New(n);
+  if (!out) {
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  fn_ossl_sha256 fast = ossl_sha256();
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* it = PySequence_Fast_GET_ITEM(seq, i);
+    char* p;
+    Py_ssize_t ln;
+    if (PyBytes_AsStringAndSize(it, &p, &ln) < 0) {
+      Py_DECREF(seq);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    uint8_t d[32];
+    if (fast) {
+      fast((const unsigned char*)p, (size_t)ln, d);
+    } else {
+      Sha256 s;
+      s.update((const uint8_t*)p, (size_t)ln);
+      s.final(d);
+    }
+    PyObject* b = PyBytes_FromStringAndSize((const char*)d, 32);
+    if (!b) {
+      Py_DECREF(seq);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, i, b);
+  }
+  Py_DECREF(seq);
+  return out;
+}
+
 // varints(seq_of_ints) -> bytes: concatenated LEB128 varints with the
 // proto writer's semantics (negatives as 10-byte two's complement) —
 // the state store's priority-vector hot loop.
@@ -633,6 +702,8 @@ static PyMethodDef Methods[] = {
      "merkle_root(leaves) -> 32-byte RFC 6962 root"},
     {"commit_merkle_root", wc_commit_merkle_root, METH_VARARGS,
      "commit_merkle_root(sigs) -> 32-byte root of encoded CommitSigs"},
+    {"sha256_many", wc_sha256_many, METH_VARARGS,
+     "sha256_many(items) -> list of 32-byte digests, one per item"},
     {nullptr, nullptr, 0, nullptr}};
 
 static struct PyModuleDef Module = {PyModuleDef_HEAD_INIT, "_wirecodec",

@@ -279,6 +279,29 @@ FIXTURES = [
         """,
     ),
     (
+        "ASY108",  # sync-abci-in-receive
+        """
+        class MempoolishReactor(Reactor):
+            def receive(self, chan_id, peer, msg):
+                self.mempool.check_tx(msg, sender=peer.peer_id)
+        class ServingReactor:
+            def receive(self, chan_id, peer, msg):
+                chunk = self.proxy.snapshot.load_snapshot_chunk(1, 0, 0)
+        """,
+        """
+        class GoodReactor(Reactor):
+            def receive(self, chan_id, peer, msg):
+                self.ingest.submit_nowait(msg, sender=peer.peer_id)
+                n = self.mempool.size()   # not an ABCI call: fine
+        class NotAReactorClass:
+            def receive(self, chan_id, peer, msg):
+                self.mempool.check_tx(msg)  # not a reactor: fine
+        class OtherReactor(Reactor):
+            def add_peer(self, peer):
+                self.proxy.info(None)  # not receive(): other rules' job
+        """,
+    ),
+    (
         "SYN000",  # syntax errors are findings, not crashes
         """
         def f(:
