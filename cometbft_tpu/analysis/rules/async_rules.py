@@ -397,6 +397,85 @@ def sync_abci_in_receive(ctx: FileContext) -> List[Finding]:
     return out
 
 
+# hot-plane packages where an UNBOUNDED asyncio queue is a latent
+# OOM + latency bomb: producers outrun a stalled consumer silently
+# until the process dies. Bounded queues shed-and-count instead
+# (obs/queues.py). Path prefixes, posix-style.
+_HOT_PLANE_PREFIXES = (
+    "cometbft_tpu/mempool/",
+    "cometbft_tpu/p2p/",
+    "cometbft_tpu/lp2p/",
+    "cometbft_tpu/blocksync/",
+    "cometbft_tpu/consensus/",
+    "cometbft_tpu/rpc/",
+    "cometbft_tpu/statesync/",
+    "cometbft_tpu/types/",
+    "cometbft_tpu/obs/",
+)
+
+# constructor spellings that create an asyncio-queue-like object
+_QUEUE_CTORS = ("Queue", "LifoQueue", "PriorityQueue", "InstrumentedQueue")
+
+
+def _unbounded_queue_call(node: ast.Call) -> str | None:
+    """Return the offending ctor spelling if this call builds an
+    unbounded asyncio queue (no maxsize, or a literal 0)."""
+    name = dotted(node.func)
+    if name is None:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    if last not in _QUEUE_CTORS:
+        return None
+    if last != "InstrumentedQueue" and not name.startswith("asyncio."):
+        # only the unambiguous asyncio spelling and our own wrapper:
+        # bare Queue()/LifoQueue()/PriorityQueue() could be the sync
+        # queue module's (thread-safe, a different concern), and
+        # queue.Queue/multiprocessing.Queue are definitely not ours
+        return None
+    size = None
+    if node.args:
+        size = node.args[0]
+    for kw in node.keywords:
+        if kw.arg == "maxsize":
+            size = kw.value
+    if size is None:
+        return name
+    if isinstance(size, ast.Constant) and size.value in (0, None):
+        return name
+    return None
+
+
+@rule(
+    "ASY109",
+    "unbounded-queue-in-hot-plane",
+    "an asyncio.Queue() with no maxsize in a hot-plane module grows "
+    "without bound when its consumer stalls; bound it and shed-and-"
+    "count (obs/queues.InstrumentedQueue)",
+)
+def unbounded_queue_in_hot_plane(ctx: FileContext) -> List[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if not any(p in path for p in _HOT_PLANE_PREFIXES):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _unbounded_queue_call(node)
+        if name is not None:
+            out.append(
+                Finding(
+                    ctx.path, node.lineno, node.col_offset,
+                    "ASY109", "unbounded-queue-in-hot-plane",
+                    f"`{name}(...)` without a maxsize in a hot-plane "
+                    "module: a stalled consumer grows it until OOM "
+                    "and every queued item adds tail latency — pass "
+                    "a bound (shed-and-count under overload, "
+                    "obs/queues.py)",
+                )
+            )
+    return out
+
+
 @rule(
     "ASY106",
     "nested-event-loop",

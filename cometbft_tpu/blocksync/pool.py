@@ -61,6 +61,10 @@ class BlockPool:
         # or a byzantine feeder can launder its ban with a reconnect
         self.banned_until: Dict[str, float] = {}
         self.blocks: Dict[int, Tuple[object, str]] = {}  # h -> (block, peer)
+        # backpressure telemetry (obs/queues.py registry): worst
+        # buffered-window size since start — the pool's pending window
+        # is the blocksync plane's bounded queue
+        self.blocks_hwm = 0
         # soft per-height exclusions (e.g. "peer lacks the extended
         # commit for h"): skipped when alternatives exist, ignored
         # otherwise — never a liveness risk, unlike a ban
@@ -204,6 +208,8 @@ class BlockPool:
                     if block is None:
                         raise PeerError(peer.peer_id, f"no block {height}")
                     self.blocks[height] = (block, peer.peer_id)
+                    if len(self.blocks) > self.blocks_hwm:
+                        self.blocks_hwm = len(self.blocks)
                     self._new_block.set()
                     return
                 except asyncio.CancelledError:
@@ -252,6 +258,18 @@ class BlockPool:
             if pid == ban_peer and h >= self.height:
                 del self.blocks[h]
         self.start_requesters()
+
+    def queue_stats(self) -> dict:
+        """Pending-window backpressure (obs/queues.py registry). A
+        FULL window is normal flow control while syncing, so the
+        bound is reported as a soft target, not "maxsize" (which
+        would trip the health route's full-queue degraded check)."""
+        return {
+            "depth": len(self.blocks),
+            "high_watermark": self.blocks_hwm,
+            "dropped": 0,
+            "window_target": self.max_pending,
+        }
 
     def is_caught_up(self) -> bool:
         """Reference blocksync/pool.go:227 IsCaughtUp: at least one

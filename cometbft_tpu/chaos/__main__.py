@@ -37,6 +37,23 @@ def main(argv=None) -> int:
     ap.add_argument("--liveness-bound", type=float, default=60.0)
     ap.add_argument("--json", help="write the report as JSON here")
     ap.add_argument(
+        "--budget",
+        nargs="?",
+        const="",
+        default=None,
+        metavar="FILE",
+        help="evaluate span budgets over the run's trace rings "
+        "(default file tools/span_budgets.toml); any breach dumps "
+        "traces and exits 2",
+    )
+    ap.add_argument(
+        "--expect-stall",
+        action="store_true",
+        help="flight-recorder check: exit 0 iff a loop stall was "
+        "captured whose snapshot contains the injected chaos_stall "
+        "frame (pair with a schedule carrying a stall event)",
+    )
+    ap.add_argument(
         "--trace-dump",
         metavar="DIR",
         help="export every node's trace ring here (JSONL per node + "
@@ -51,6 +68,12 @@ def main(argv=None) -> int:
     else:
         schedule = default_schedule(byzantine_node=args.byzantine)
 
+    budget_file = None
+    if args.budget is not None:
+        from ..obs.budget import default_budget_file
+
+        budget_file = args.budget or default_budget_file()
+
     with tempfile.TemporaryDirectory(prefix="chaos_") as tmp:
         report = asyncio.run(
             run_schedule(
@@ -60,6 +83,7 @@ def main(argv=None) -> int:
                 n_nodes=args.nodes,
                 liveness_bound_s=args.liveness_bound,
                 trace_dir=args.trace_dump,
+                budget_file=budget_file,
             )
         )
     print(report.format())
@@ -76,10 +100,26 @@ def main(argv=None) -> int:
                     "wal_checks": report.wal_checks,
                     "trace_files": report.trace_files,
                     "schedule": json.loads(report.schedule_json),
+                    "stall_records": report.stall_records,
+                    "budget_verdicts": report.budget_verdicts,
+                    "profile_file": report.profile_file,
                 },
                 f,
                 indent=2,
             )
+    if args.expect_stall:
+        caught = any(
+            any("chaos_stall" in ln for ln in r.get("loop_stack", []))
+            for r in report.stall_records
+        )
+        print(
+            "stall flight-record:",
+            "CAPTURED (chaos_stall frame in snapshot)"
+            if caught
+            else "MISSED",
+        )
+        if not caught:
+            return 1
     if args.byzantine is not None:
         detected = any("agreement" in v for v in report.violations)
         print(
@@ -87,7 +127,11 @@ def main(argv=None) -> int:
             "DETECTED" if detected else "MISSED",
         )
         return 0 if detected else 1
-    return 0 if report.ok else 1
+    if not report.ok:
+        return 1
+    if not report.budget_ok:
+        return 2
+    return 0
 
 
 if __name__ == "__main__":

@@ -15,6 +15,7 @@ invariant violation.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import List
 
 from ..utils.log import get_logger
@@ -24,6 +25,16 @@ from .schedule import FaultEvent, FaultSchedule
 _log = get_logger("chaos.nemesis")
 
 _POLL_S = 0.05
+
+
+def chaos_stall(duration_s: float) -> None:
+    """Deliberately block the event loop with a synchronous callback —
+    the fault the obs watchdog's flight recorder exists to catch. The
+    function name is the needle: a correct flight record's loop-thread
+    snapshot (and this frame inside it) must contain ``chaos_stall``.
+    ``time.sleep`` releases the GIL, so the off-loop monitor threads
+    observe the stall mid-flight and snapshot THIS frame."""
+    time.sleep(duration_s)
 
 
 class Nemesis:
@@ -98,6 +109,11 @@ class Nemesis:
         if ev.action == "restart":
             await net.restart(ev.node)
             return {"node": net.nodes[ev.node].name}
+        if ev.action == "stall":
+            # runs ON the loop on purpose: every in-process node
+            # shares it, so every node's watchdog sees the stall
+            chaos_stall(ev.duration_s)
+            return {"duration_s": ev.duration_s}
         if ev.action == "byzantine":
             # tamper bytes come from the MASTER rng: schedule execution
             # is sequential, so the draw is deterministic per run

@@ -56,6 +56,9 @@ class ChaosNode:
     # one tracer per incarnation (restarts build a fresh ring); kept
     # here so a crashed node's timeline survives for the dump
     tracers: List[object] = field(default_factory=list)
+    # likewise one loop watchdog per incarnation: its flight records
+    # (loop-stall snapshots) outlive the crash for the report
+    watchdogs: List[object] = field(default_factory=list)
 
     @property
     def node_id(self) -> str:
@@ -76,10 +79,21 @@ class ChaosReport:
     link_decisions: Dict[str, Dict[str, int]] = field(default_factory=dict)
     wal_checks: int = 0
     trace_files: List[str] = field(default_factory=list)
+    # runtime health plane (obs/, docs/OBS.md)
+    stall_records: List[dict] = field(default_factory=list)
+    budget_verdicts: List[dict] = field(default_factory=list)
+    profile_file: str = ""
 
     @property
     def ok(self) -> bool:
         return not self.violations
+
+    @property
+    def budget_ok(self) -> bool:
+        """Span budgets hold (vacuously true when not evaluated).
+        Separate from ``ok``: a budget breach is a perf regression
+        gate, not a BFT invariant violation."""
+        return all(v["ok"] for v in self.budget_verdicts)
 
     def format(self) -> str:
         lines = [
@@ -98,6 +112,25 @@ class ChaosReport:
                 lines.append(f"  {link}: {counts}")
         for v in self.violations:
             lines.append(f"VIOLATION: {v}")
+        if self.stall_records:
+            lines.append(
+                f"loop stalls flight-recorded: {len(self.stall_records)}"
+            )
+            for r in self.stall_records[:8]:
+                top = " <- ".join(r.get("loop_stack", [])[:3])
+                lines.append(
+                    f"  {r.get('node')}: {r.get('stalled_s')}s at {top}"
+                )
+        if self.budget_verdicts:
+            from ..obs.budget import format_verdicts
+
+            lines.append("span budgets (docs/OBS.md):")
+            lines.extend(
+                "  " + ln
+                for ln in format_verdicts(self.budget_verdicts).splitlines()
+            )
+        if self.profile_file:
+            lines.append(f"sampling profile: {self.profile_file}")
         if self.trace_files:
             lines.append("node trace rings (docs/TRACE.md):")
             for p in self.trace_files:
@@ -173,10 +206,17 @@ class ChaosNet:
             home=cn.home,
         )
 
+    @staticmethod
+    def _track(cn: ChaosNode) -> None:
+        """Keep diagnostics handles that must survive a crash."""
+        cn.tracers.append(cn.node.parts.tracer)
+        if cn.node.loop_watchdog is not None:
+            cn.watchdogs.append(cn.node.loop_watchdog)
+
     async def start(self) -> None:
         for cn in self.nodes:
             cn.node = self._build(cn)
-            cn.tracers.append(cn.node.parts.tracer)
+            self._track(cn)
             await cn.node.start()
         for i, a in enumerate(self.nodes):
             for b in self.nodes[i + 1 :]:
@@ -213,7 +253,7 @@ class ChaosNet:
         if cn.node is not None:
             return
         cn.node = self._build(cn)
-        cn.tracers.append(cn.node.parts.tracer)
+        self._track(cn)
         await cn.node.start()
         # WAL-replay consistency right after recovery, before the node
         # re-joins gossip
@@ -278,13 +318,20 @@ class ChaosNet:
             for cn in self.nodes
         }
 
-    def dump_traces(self, out_dir: str) -> List[str]:
-        """Write every node's trace ring (one JSONL per incarnation —
-        restarts get a fresh ring, so n1 that crashed and came back
-        dumps n1.0 and n1.1) plus the crypto plane's process ring and
-        one merged Perfetto-loadable trace.json. Returns the files."""
-        os.makedirs(out_dir, exist_ok=True)
-        files: List[str] = []
+    def stall_records(self) -> List[dict]:
+        """Every flight record captured by any incarnation's loop
+        watchdog, time-ordered (obs/watchdog.py)."""
+        out: List[dict] = []
+        for cn in self.nodes:
+            for wd in cn.watchdogs:
+                out.extend(dict(r) for r in wd.stalls)
+        out.sort(key=lambda r: r.get("ts_ns", 0))
+        return out
+
+    def ring_snapshots(self) -> Dict[str, list]:
+        """{label: events} over every incarnation's ring plus the
+        process ring — the in-memory form dump_traces writes out and
+        the span-budget evaluation reads."""
         by_node: Dict[str, list] = {}
         for cn in self.nodes:
             for gen, tr in enumerate(cn.tracers):
@@ -296,21 +343,25 @@ class ChaosNet:
                     else f"{cn.name}.{gen}"
                 )
                 by_node[label] = events
-                files.append(
-                    write_jsonl(
-                        os.path.join(out_dir, f"{label}.trace.jsonl"),
-                        label,
-                        events,
-                    )
-                )
         proc = global_tracer().snapshot()
         if proc:
             by_node["process"] = proc
+        return by_node
+
+    def dump_traces(self, out_dir: str) -> List[str]:
+        """Write every node's trace ring (one JSONL per incarnation —
+        restarts get a fresh ring, so n1 that crashed and came back
+        dumps n1.0 and n1.1) plus the crypto plane's process ring and
+        one merged Perfetto-loadable trace.json. Returns the files."""
+        os.makedirs(out_dir, exist_ok=True)
+        files: List[str] = []
+        by_node = self.ring_snapshots()
+        for label, events in by_node.items():
             files.append(
                 write_jsonl(
-                    os.path.join(out_dir, "process.trace.jsonl"),
-                    "process",
-                    proc,
+                    os.path.join(out_dir, f"{label}.trace.jsonl"),
+                    label,
+                    events,
                 )
             )
         if by_node:
@@ -332,6 +383,8 @@ async def run_schedule(
     fuzz_config=None,
     trace_dir: Optional[str] = None,
     config_hook=None,
+    budget_file: Optional[str] = None,
+    profile_hz: float = 19.0,
 ) -> ChaosReport:
     """Execute one seeded chaos run end-to-end and return its report
     (violations recorded, not raised — callers assert on report.ok).
@@ -340,13 +393,25 @@ async def run_schedule(
     exported there unconditionally; without it a VIOLATED run still
     dumps the rings to a fresh persistent directory next to the seed
     + fault trace in the report — the timeline of what each node was
-    doing is part of the replay contract."""
+    doing is part of the replay contract.
+
+    Health plane (docs/OBS.md): a low-rate sampling profiler runs for
+    the whole schedule (``profile_hz``; 0 disables) and its folded
+    stacks land beside any trace dump as profile.folded. With
+    ``budget_file`` set, span budgets are evaluated over every ring
+    at end of run; a breach dumps traces exactly like an invariant
+    violation (report.budget_ok goes False, the CLI exits nonzero)."""
     table = LinkTable(seed, fuzz_config=fuzz_config)
     net = ChaosNet(
         n_nodes, seed, base_dir, table=table, config_hook=config_hook
     )
     report = ChaosReport(seed=seed, schedule_json=schedule.to_json())
     nemesis = Nemesis(net, schedule)
+    profiler = None
+    if profile_hz and profile_hz > 0:
+        from ..obs import SamplingProfiler
+
+        profiler = SamplingProfiler(hz=profile_hz).start()
 
     stop_polling = asyncio.Event()
 
@@ -425,14 +490,39 @@ async def run_schedule(
     finally:
         report.final_heights = net.heights()
         await net.stop()
+        if profiler is not None:
+            profiler.stop()
+        report.stall_records = net.stall_records()
+        if budget_file:
+            # evaluated over the in-memory rings so a breach can force
+            # the dump below even when no invariant tripped
+            try:
+                from ..obs.budget import evaluate_budgets, load_budgets
+                from ..trace import summarize
+
+                report.budget_verdicts = evaluate_budgets(
+                    summarize(net.ring_snapshots()),
+                    load_budgets(budget_file),
+                )
+            except Exception as e:
+                report.violations.append(
+                    f"budget evaluation failed: {e!r}"
+                )
         # rings survive node stop (ChaosNode holds the tracers)
         try:
-            if trace_dir is not None:
-                report.trace_files = net.dump_traces(trace_dir)
-            elif report.violations:
-                report.trace_files = net.dump_traces(
-                    tempfile.mkdtemp(prefix=f"chaos_trace_{seed}_")
+            dump_dir = trace_dir
+            if dump_dir is None and (
+                report.violations or not report.budget_ok
+            ):
+                dump_dir = tempfile.mkdtemp(
+                    prefix=f"chaos_trace_{seed}_"
                 )
+            if dump_dir is not None:
+                report.trace_files = net.dump_traces(dump_dir)
+                if profiler is not None and profiler.samples:
+                    report.profile_file = profiler.write_folded(
+                        os.path.join(dump_dir, "profile.folded")
+                    )
         except OSError:
             pass  # trace dump is best-effort diagnostics
 

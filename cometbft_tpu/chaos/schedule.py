@@ -26,6 +26,12 @@ deterministic and fast):
 ``crash``             ``node=i``: in-process power cut (Node.kill)
 ``restart``           ``node=i``: rebuild from the same home dir —
                       recovery runs WAL replay + ABCI handshake replay
+``stall``             ``duration_s=T``: block the (shared in-process)
+                      event loop with a synchronous callback for T
+                      seconds — the loop-stall the obs watchdog's
+                      flight recorder must catch mid-flight
+                      (docs/OBS.md; the snapshot must contain
+                      ``chaos_stall``)
 ``byzantine``         ``node=i``: corrupt the node's NEXT commit (its
                       stored block ID at that height is rewritten with
                       seeded tamper bytes). This simulates the
@@ -46,7 +52,10 @@ import json
 from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional
 
-ACTIONS = ("partition", "heal", "set_link", "crash", "restart", "byzantine")
+ACTIONS = (
+    "partition", "heal", "set_link", "crash", "restart", "byzantine",
+    "stall",
+)
 
 
 @dataclass
@@ -60,6 +69,7 @@ class FaultEvent:
     dst: Optional[int] = None  # set_link
     link: Optional[Dict[str, float]] = None  # set_link LinkState fields
     symmetric: bool = True  # set_link: apply both directions
+    duration_s: Optional[float] = None  # stall: loop-block length
 
     def __post_init__(self):
         if self.action not in ACTIONS:
@@ -78,6 +88,10 @@ class FaultEvent:
             self.src is None or self.dst is None or not self.link
         ):
             raise ValueError("set_link: src, dst and link required")
+        if self.action == "stall" and not (
+            self.duration_s and self.duration_s > 0
+        ):
+            raise ValueError("stall: duration_s > 0 required")
 
 
 @dataclass

@@ -178,6 +178,18 @@ class InstrumentationConfig:
     # events retained per node (ring slots, preallocated; oldest
     # events are overwritten once the ring laps)
     trace_ring_size: int = 16384
+    # runtime health plane (cometbft_tpu/obs, docs/OBS.md): the
+    # event-loop watchdog measures scheduling lag via a monotonic
+    # heartbeat and fires the loop-stall flight recorder (thread +
+    # task stack snapshot into the trace ring) when a callback blocks
+    # the loop past the stall threshold. Always-on by default — the
+    # heartbeat is one task wakeup per interval.
+    loop_watchdog: bool = True
+    # heartbeat period (the lag-sample rate; also bounds how quickly a
+    # stall is noticed: detection latency ~ interval + stall threshold)
+    loop_lag_interval_ms: float = 100.0
+    # loop blocked longer than this => flight record (0 < stall)
+    loop_stall_ms: float = 500.0
 
 
 @dataclass

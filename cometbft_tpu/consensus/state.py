@@ -126,7 +126,9 @@ class ConsensusState:
     # --- lifecycle ----------------------------------------------------
 
     async def start(self) -> None:
-        self.queue = asyncio.Queue(maxsize=10000)
+        from ..obs.queues import InstrumentedQueue
+
+        self.queue = InstrumentedQueue(10000, name="consensus.inbox")
         self.event_bus.set_loop(asyncio.get_running_loop())
         if self._wal_path:
             self.wal = walmod.WAL(self._wal_path, tracer=self.tracer)
@@ -1315,4 +1317,10 @@ class ConsensusState:
     def enqueue_nowait(self, kind: str, payload, peer_id: str) -> None:
         if self.queue is None:
             return  # not started yet (sync phase); drop
-        self.queue.put_nowait((kind, payload, peer_id))
+        try:
+            self.queue.put_nowait((kind, payload, peer_id))
+        except asyncio.QueueFull:
+            # overload shed: count it (obs telemetry), callers keep
+            # their existing QueueFull handling
+            self.queue.count_drop()
+            raise

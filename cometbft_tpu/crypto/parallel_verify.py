@@ -138,6 +138,7 @@ class PendingLanes:
             fut.add_done_callback(self._one_done)
 
     def _one_done(self, _fut) -> None:
+        self._engine._chunk_done()
         with self._lock:
             self._left -= 1
             if self._left == 0:
@@ -231,6 +232,12 @@ class ParallelVerifyEngine:
         self._calibrated = False
         self._pool = None
         self._lock = threading.Lock()
+        # dispatch backpressure telemetry (obs/queues.py registry):
+        # chunks submitted but not yet completed, worst case since
+        # start, and total chunks dispatched
+        self.inflight_chunks = 0
+        self.inflight_hwm = 0
+        self.chunks_dispatched = 0
 
     # --- pool / calibration ------------------------------------------
 
@@ -296,6 +303,33 @@ class ParallelVerifyEngine:
                     self.tier = "serial"
                     self._pool = None
             return self._pool
+
+    def _chunk_submitted(self, n: int = 1) -> None:
+        with self._lock:
+            self.chunks_dispatched += n
+            self.inflight_chunks += n
+            if self.inflight_chunks > self.inflight_hwm:
+                self.inflight_hwm = self.inflight_chunks
+
+    def _chunk_done(self) -> None:
+        with self._lock:
+            if self.inflight_chunks > 0:
+                self.inflight_chunks -= 1
+
+    def queue_stats(self) -> dict:
+        """Dispatch-queue backpressure (obs/queues.py registry).
+        inflight > workers just means chunks are queued on the pool —
+        normal under load — so the worker count is NOT reported as
+        "maxsize" (the health route treats depth >= maxsize as a
+        degraded full queue)."""
+        with self._lock:
+            return {
+                "depth": self.inflight_chunks,
+                "high_watermark": self.inflight_hwm,
+                "enqueued": self.chunks_dispatched,
+                "dropped": 0,
+                "workers": self.workers,
+            }
 
     def _observe_chunk(self, n: int, wall: float) -> None:
         if n <= 0 or wall <= 0:
@@ -365,12 +399,12 @@ class ParallelVerifyEngine:
         futures = []
         try:
             for start in range(0, n, chunk):
-                futures.append(
-                    (start, pool.submit(
-                        _verify_chunk, items[start : start + chunk],
-                        self.tier,
-                    ))
+                fut = pool.submit(
+                    _verify_chunk, items[start : start + chunk],
+                    self.tier,
                 )
+                self._chunk_submitted()
+                futures.append((start, fut))
         except RuntimeError:
             # pool shut down underneath us (interpreter teardown):
             # fall back serially for the lanes not yet submitted —
@@ -403,6 +437,15 @@ def engine() -> ParallelVerifyEngine:
         if _ENGINE is None:
             _ENGINE = ParallelVerifyEngine()
         return _ENGINE
+
+
+def dispatch_stats_if_running():
+    """The shared engine's dispatch-queue telemetry, or None when no
+    engine was ever built — the obs registry entry must never CREATE
+    the engine (pool spin-up) just to report an idle plane."""
+    with _ENGINE_LOCK:
+        e = _ENGINE
+    return None if e is None else e.queue_stats()
 
 
 def set_engine(e: Optional[ParallelVerifyEngine]) -> None:

@@ -22,6 +22,7 @@ import asyncio
 from typing import List, Optional, Tuple
 
 from ..abci import types as abci
+from ..obs.queues import InstrumentedQueue
 from ..trace import NOOP as TRACE_NOOP
 from ..utils.log import get_logger
 
@@ -63,7 +64,7 @@ class IngestQueue:
             return
         from ..utils.tasks import spawn
 
-        self._q = asyncio.Queue(self.max_queue)
+        self._q = InstrumentedQueue(self.max_queue, name="mempool.ingest")
         self._task = spawn(self._drain(), name="mempool-ingest")
 
     async def stop(self) -> None:
@@ -82,6 +83,17 @@ class IngestQueue:
                     abci.ResponseCheckTx(code=1, log="ingest stopped"),
                 )
 
+    def queue_stats(self):
+        """Backpressure telemetry (obs/queues.py registry entry);
+        ``dropped`` is the plane-lifetime shed count — the live queue
+        is rebuilt on every start()."""
+        q = self._q
+        if q is None:
+            return None
+        s = q.stats()
+        s["dropped"] = self.dropped
+        return s
+
     # --- entries ------------------------------------------------------
 
     def submit_nowait(self, tx: bytes, sender: str = "") -> bool:
@@ -95,6 +107,7 @@ class IngestQueue:
             q.put_nowait((tx, sender, None))
         except asyncio.QueueFull:
             self.dropped += 1
+            q.count_drop()  # unified shed counter (obs/queues.py)
             return False
         self.submitted += 1
         return True

@@ -179,6 +179,78 @@ class Node:
         self.metrics_server = None
         self.debug_server = None
         self.watchdog = None
+        # runtime health plane (cometbft_tpu/obs, docs/OBS.md): the
+        # loop watchdog object is built here (started in start() — it
+        # needs the running loop) so Environment.from_node and the
+        # metrics attach can hold a stable reference
+        from ..obs import LoopWatchdog, QueueRegistry
+
+        inst = config.instrumentation
+        self.loop_watchdog = (
+            LoopWatchdog(
+                tracer=self.parts.tracer,
+                interval_s=inst.loop_lag_interval_ms / 1e3,
+                stall_s=inst.loop_stall_ms / 1e3,
+                name=config.base.moniker or "node",
+            )
+            if inst.loop_watchdog
+            else None
+        )
+        self.queues = QueueRegistry()
+        self._register_queues()
+
+    def _register_queues(self) -> None:
+        """Point the backpressure registry (obs/queues.py) at every
+        bounded queue in the hot planes. Entries are callables read at
+        scrape time — planes rebuild queues across start/stop."""
+        q = self.queues
+        mr = self.mempool_reactor
+        ing = getattr(mr, "ingest", None)
+        if ing is not None:
+            q.register("mempool.ingest", ing.queue_stats)
+        q.register(
+            "consensus.inbox",
+            lambda: self.parts.cs.queue.stats()
+            if getattr(self.parts.cs.queue, "stats", None)
+            else None,
+        )
+        q.register("events.subs", self.parts.event_bus.queue_stats)
+
+        def p2p_send():
+            depth = hwm = dropped = enqueued = 0
+            seen = False
+            for peer in list(self.switch.peers.values()):
+                mc = getattr(peer, "mconn", None)
+                if mc is None or not hasattr(mc, "send_queue_stats"):
+                    continue
+                seen = True
+                s = mc.send_queue_stats()
+                depth += s["depth"]
+                hwm = max(hwm, s["high_watermark"])
+                dropped += s["dropped"]
+                enqueued += s["enqueued"]
+            if not seen:
+                return None
+            return {
+                "depth": depth,
+                "high_watermark": hwm,
+                "dropped": dropped,
+                "enqueued": enqueued,
+            }
+
+        q.register("p2p.send", p2p_send)
+        q.register(
+            "blocksync.window",
+            lambda: self.blocksync_reactor.inner.pool.queue_stats()
+            if getattr(self.blocksync_reactor.inner, "pool", None)
+            is not None
+            else None,
+        )
+        # process-wide: the parallel-verify dispatch plane (shared by
+        # every in-process node; reported per node for convenience)
+        from ..crypto.parallel_verify import dispatch_stats_if_running
+
+        q.register("crypto.verify.dispatch", dispatch_stats_if_running)
 
     # --- phase switching ----------------------------------------------
 
@@ -322,6 +394,9 @@ class Node:
                 self.config.instrumentation.pprof_laddr
             )
             await self.debug_server.start()
+        if self.loop_watchdog is not None:
+            # loop-lag heartbeat + stall flight recorder (docs/OBS.md)
+            self.loop_watchdog.start()
         if self.config.instrumentation.watchdog_stall_s > 0:
             from ..utils.debug import StuckTaskWatchdog
 
@@ -366,6 +441,8 @@ class Node:
     async def _shutdown(self, graceful: bool) -> None:
         if getattr(self, "watchdog", None) is not None:
             self.watchdog.stop()
+        if getattr(self, "loop_watchdog", None) is not None:
+            self.loop_watchdog.stop()
         if self._statesync_task is not None:
             self._statesync_task.cancel()
         # kill(): servers still close (an in-process restart must be

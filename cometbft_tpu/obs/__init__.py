@@ -1,0 +1,40 @@
+"""Runtime health plane (docs/OBS.md).
+
+The connective tissue between the tracing plane (PR 4) and every perf
+regression gate: the tracer says how long a span took, this package
+says *why* the tail is slow and *whether* it is allowed to be.
+
+Four coordinated pieces:
+
+- **LoopWatchdog** (obs/watchdog.py) — per-node event-loop scheduling
+  lag measured by a monotonic heartbeat task, plus a **flight
+  recorder**: when the loop stalls past a threshold, a monitor thread
+  snapshots every thread's frame and every asyncio task's stack INTO
+  THE TRACE RING as instant events, so the offending stack appears
+  right next to the stalled spans in Perfetto.
+- **SamplingProfiler** (obs/profiler.py) — stdlib sampling profiler
+  (sys._current_frames at a configurable Hz) with folded-stack
+  output; attached to bench runs and chaos violation dumps.
+- **InstrumentedQueue / QueueRegistry** (obs/queues.py) —
+  backpressure telemetry for every bounded queue in the hot planes:
+  depth, high watermark, unified shed/drop counters.
+- **span budgets** (obs/budget.py) — declarative per-span-kind
+  p95/p99 budgets in tools/span_budgets.toml, evaluated by
+  ``trace summarize --budget``, enforced in chaos runs and recorded
+  in bench JSON.
+"""
+
+from .budget import evaluate_budgets, format_verdicts, load_budgets
+from .profiler import SamplingProfiler
+from .queues import InstrumentedQueue, QueueRegistry
+from .watchdog import LoopWatchdog
+
+__all__ = [
+    "InstrumentedQueue",
+    "LoopWatchdog",
+    "QueueRegistry",
+    "SamplingProfiler",
+    "evaluate_budgets",
+    "format_verdicts",
+    "load_budgets",
+]

@@ -16,6 +16,7 @@ import traceback
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from ...obs.queues import InstrumentedQueue
 from .secret_connection import DATA_MAX_SIZE, SecretConnection
 
 PACKET_PING = 0x01
@@ -65,8 +66,10 @@ class ChannelState:
     chan_id: int
     priority: int = 1
     max_msg_size: int = DEFAULT_MAX_MSG_SIZE
-    queue: asyncio.Queue = field(
-        default_factory=lambda: asyncio.Queue(DEFAULT_SEND_QUEUE_CAPACITY)
+    queue: InstrumentedQueue = field(
+        default_factory=lambda: InstrumentedQueue(
+            DEFAULT_SEND_QUEUE_CAPACITY, name="p2p.send"
+        )
     )
     sending: bytes = b""  # remainder of the message currently chunking
     recv_buf: bytearray = field(default_factory=bytearray)
@@ -101,6 +104,7 @@ class MConnection:
         for desc in channels:
             cid, prio = desc[0], desc[1]
             cs = ChannelState(cid, prio)
+            cs.queue.name = f"p2p.send.{cid:#04x}"
             if len(desc) > 2:
                 cs.max_msg_size = desc[2]
             self.channels[cid] = cs
@@ -173,6 +177,7 @@ class MConnection:
         try:
             ch.queue.put_nowait(bytes(msg))
         except asyncio.QueueFull:
+            ch.queue.count_drop()  # shed under overload, counted
             return False
         self._send_wake.set()
         return True
@@ -293,3 +298,25 @@ class MConnection:
             ChannelStatus(c.chan_id, c.queue.qsize(), c.priority)
             for c in self.channels.values()
         ]
+
+    def send_queue_stats(self) -> dict:
+        """Aggregate backpressure telemetry over every channel's send
+        queue (obs/queues.py semantics: depth summed, watermark is
+        the worst single channel, drops summed)."""
+        depth = hwm = dropped = enqueued = 0
+        for ch in self.channels.values():
+            q = ch.queue
+            depth += q.qsize()
+            hwm = max(hwm, q.high_watermark)
+            dropped += q.dropped
+            enqueued += q.enqueued
+        # aggregate entry: no "maxsize" (summed depth must not be
+        # compared against the per-channel bound by health's
+        # full-queue check)
+        return {
+            "depth": depth,
+            "high_watermark": hwm,
+            "dropped": dropped,
+            "enqueued": enqueued,
+            "per_channel_maxsize": DEFAULT_SEND_QUEUE_CAPACITY,
+        }

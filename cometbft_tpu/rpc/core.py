@@ -81,8 +81,76 @@ def _norm_height(env, height) -> int:
 # --- info routes --------------------------------------------------------
 
 
+# loop-lag p95 above this marks the node degraded: a loop that takes
+# a quarter second to schedule a ready callback is serving tails, not
+# traffic (half the default stall threshold, config loop_stall_ms)
+_HEALTH_LAG_P95_MS = 250.0
+# a flight-recorded stall within this window marks the node degraded
+_HEALTH_STALL_RECENT_S = 60.0
+
+
 def health(env) -> Dict[str, Any]:
-    return {}
+    """Runtime health verdict (docs/OBS.md): loop responsiveness,
+    commit freshness and queue backpressure, with a degraded/ok
+    verdict + reasons. The reference returns {} here; every field is
+    additive so `health == ok` probes keep working."""
+    reasons: List[str] = []
+    out: Dict[str, Any] = {}
+    wd = env.loop_watchdog
+    if wd is not None:
+        lag = wd.lag_stats()
+        out["loop_lag_ms"] = {
+            k: lag[k] for k in ("p50_ms", "p95_ms", "p99_ms", "max_ms")
+        }
+        out["loop_stalls"] = wd.stall_count
+        if lag["samples"] >= 20 and lag["p95_ms"] > _HEALTH_LAG_P95_MS:
+            reasons.append(
+                f"loop lag p95 {lag['p95_ms']}ms > "
+                f"{_HEALTH_LAG_P95_MS}ms"
+            )
+        ago = wd.last_stall_ago_s()
+        if ago is not None and ago < _HEALTH_STALL_RECENT_S:
+            reasons.append(
+                f"loop stall flight-recorded {ago:.0f}s ago "
+                f"(see dump_tasks / the trace ring)"
+            )
+    latest = env.block_store.height()
+    out["latest_block_height"] = str(latest)
+    meta = env.block_store.load_block_meta(latest) if latest else None
+    if meta is not None:
+        age_s = max(0.0, (time.time_ns() - meta.header.time_ns) / 1e9)
+        out["last_commit_age_s"] = round(age_s, 3)
+    if env.queues is not None:
+        # ONE registry pass per request (every stats_fn walks live
+        # structures — p2p.send iterates all peers' channels)
+        snap = env.queues.snapshot()
+        out["queue_high_watermarks"] = {
+            name: int(s.get("high_watermark", 0))
+            for name, s in snap.items()
+        }
+        out["queue_dropped_total"] = sum(
+            int(s.get("dropped", 0)) for s in snap.values()
+        )
+        for name, s in snap.items():
+            # only single bounded queues report "maxsize"; aggregate
+            # entries and soft targets use other field names exactly
+            # so this check cannot misread a summed depth
+            maxsize = int(s.get("maxsize", 0) or 0)
+            if maxsize and int(s.get("depth", 0)) >= maxsize:
+                reasons.append(f"queue {name} is full ({maxsize})")
+    out["status"] = "degraded" if reasons else "ok"
+    if reasons:
+        out["reasons"] = reasons
+    return out
+
+
+def dump_tasks(env) -> Dict[str, Any]:
+    """Debug route: every asyncio task's stack (the goroutine-dump
+    analog, scoped to the loop serving this RPC)."""
+    from ..obs.watchdog import all_task_stacks
+
+    tasks = all_task_stacks()
+    return {"n_tasks": str(len(tasks)), "tasks": tasks}
 
 
 def status(env) -> Dict[str, Any]:
@@ -707,6 +775,7 @@ UNSAFE_ROUTES = {
 
 ROUTES = {
     "health": health,
+    "dump_tasks": dump_tasks,
     "status": status,
     "net_info": net_info,
     "genesis": genesis,

@@ -25,6 +25,10 @@ class Environment:
     privval_pubkey: object = None
     config: object = None
     mempool_reactor: object = None  # for app-mempool local submission
+    # runtime health plane handles (obs/, docs/OBS.md); may be None
+    # (inspect mode / watchdog disabled)
+    loop_watchdog: object = None
+    queues: object = None  # obs.QueueRegistry
 
     def submit_tx(self, tx: bytes):
         """CheckTx + (app-mempool) gossip: RPC broadcast entry point
@@ -93,4 +97,6 @@ class Environment:
             ),
             config=node.config,
             mempool_reactor=node.mempool_reactor,
+            loop_watchdog=getattr(node, "loop_watchdog", None),
+            queues=getattr(node, "queues", None),
         )

@@ -45,6 +45,15 @@ def main(argv=None) -> int:
     p_sum.add_argument(
         "--json", action="store_true", help="machine-readable output"
     )
+    p_sum.add_argument(
+        "--budget",
+        nargs="?",
+        const="",
+        default=None,
+        metavar="FILE",
+        help="evaluate span budgets (obs/budget.py; default file "
+        "tools/span_budgets.toml) and exit 2 on any violation",
+    )
 
     args = ap.parse_args(argv)
     events = read_jsonl(args.paths)
@@ -79,8 +88,31 @@ def main(argv=None) -> int:
             print()
     else:  # summarize
         s = summarize(events)
+        verdicts = None
+        if args.budget is not None:
+            # late import: the budget engine pulls tomllib; plain
+            # summarize must keep working without it
+            from ..obs.budget import (
+                budgets_ok,
+                default_budget_file,
+                evaluate_budgets,
+                format_verdicts,
+                load_budgets,
+            )
+
+            budget_path = args.budget or default_budget_file()
+            budgets = load_budgets(budget_path)
+            verdicts = evaluate_budgets(s, budgets)
         if args.json:
-            print(json.dumps(s, indent=2))
+            doc = dict(s)
+            if verdicts is not None:
+                doc = {"summary": s, "budget_verdicts": verdicts}
+            print(json.dumps(doc, indent=2))
         else:
             print(format_summary(s))
+            if verdicts is not None:
+                print()
+                print(format_verdicts(verdicts))
+        if verdicts is not None and not budgets_ok(verdicts):
+            return 2
     return 0

@@ -166,6 +166,17 @@ class Tracer:
             return NOOP_SPAN
         return _Span(self, name, tid, args, _monotonic_ns())
 
+    def complete(
+        self, name: str, ts_ns: int, dur_ns: int,
+        tid: Optional[str] = None, **args,
+    ) -> None:
+        """Record an already-measured complete span (callers that
+        timed the work themselves, e.g. the loop watchdog's lag
+        beats); observers fire exactly as for span().end()."""
+        if not self.enabled:
+            return
+        self._append(name, "X", ts_ns, dur_ns, tid, args)
+
     def instant(self, name: str, tid: Optional[str] = None, **args) -> None:
         if not self.enabled:
             return

@@ -12,6 +12,14 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from ..obs.queues import InstrumentedQueue
+
+# per-subscriber queue bound: a subscriber that stops draining sheds
+# (events dropped + counted) instead of growing the queue without
+# bound until the process dies — the outbound analog of the mempool
+# ingest queue's overload policy (ROADMAP item 4; bftlint ASY109)
+SUBSCRIPTION_QUEUE_SIZE = 2048
+
 EVENT_NEW_BLOCK = "NewBlock"
 EVENT_NEW_BLOCK_HEADER = "NewBlockHeader"
 EVENT_NEW_BLOCK_EVENTS = "NewBlockEvents"
@@ -36,10 +44,30 @@ class Event:
 
 
 class Subscription:
-    def __init__(self, bus: "EventBus", match: Callable[[Event], bool]):
+    def __init__(
+        self,
+        bus: "EventBus",
+        match: Callable[[Event], bool],
+        queue_size: int = SUBSCRIPTION_QUEUE_SIZE,
+    ):
         self._bus = bus
         self._match = match
-        self.queue: "asyncio.Queue[Event]" = asyncio.Queue()
+        self.queue: InstrumentedQueue = InstrumentedQueue(
+            queue_size, name="events.sub"
+        )
+
+    def _offer(self, event: "Event") -> None:
+        """Non-blocking delivery with shed-and-count overflow: when a
+        subscriber stops draining, NEW events are dropped (counted on
+        its queue + the bus) and the backlog it already holds stays
+        intact — publishers and other subscribers never block behind
+        it, and a resumed drainer sees a gap-free prefix followed by
+        a counted gap."""
+        try:
+            self.queue.put_nowait(event)
+        except asyncio.QueueFull:
+            self.queue.count_drop()
+            self._bus.dropped += 1
 
     def unsubscribe(self):
         self._bus._remove(self)
@@ -53,6 +81,7 @@ class EventBus:
         self._sync_listeners: List[Callable[[Event], None]] = []
         self._lock = threading.Lock()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self.dropped = 0  # events shed across all subscribers
 
     def set_loop(self, loop: asyncio.AbstractEventLoop):
         self._loop = loop
@@ -86,11 +115,34 @@ class EventBus:
         for sub in subs:
             if sub._match(event):
                 if self._loop is not None and not self._loop.is_closed():
-                    self._loop.call_soon_threadsafe(
-                        sub.queue.put_nowait, event
-                    )
+                    self._loop.call_soon_threadsafe(sub._offer, event)
                 else:
-                    sub.queue.put_nowait(event)
+                    sub._offer(event)
+
+    def queue_stats(self) -> dict:
+        """Aggregate subscriber-queue backpressure (obs registry):
+        depth summed, watermark = worst subscriber, drops bus-wide."""
+        with self._lock:
+            subs = list(self._subs)
+        depth = hwm = enqueued = 0
+        for sub in subs:
+            q = sub.queue
+            depth += q.qsize()
+            hwm = max(hwm, q.high_watermark)
+            enqueued += q.enqueued
+        # no "maxsize": this entry AGGREGATES over subscribers, and
+        # the health route's full-queue check compares depth against
+        # maxsize — a per-subscriber bound must not be compared with
+        # a summed depth (obs/queues.py convention: aggregates and
+        # soft targets use a differently-named field)
+        return {
+            "depth": depth,
+            "high_watermark": hwm,
+            "enqueued": enqueued,
+            "dropped": self.dropped,
+            "subscribers": len(subs),
+            "subscriber_maxsize": SUBSCRIPTION_QUEUE_SIZE,
+        }
 
     # convenience publishers (reference event_bus.go PublishEventX)
     def publish_type(self, type_: str, data: Any, **attrs) -> None:

@@ -31,8 +31,12 @@ def ids_of(src: str, path: str = "x.py"):
 
 
 # path-scoped rules need their fixtures analyzed under an in-scope
-# path (ASY107 only applies inside the tracing plane)
-FIXTURE_PATHS = {"ASY107": "cometbft_tpu/trace/x.py"}
+# path (ASY107 only applies inside the tracing plane, ASY109 inside
+# the hot planes)
+FIXTURE_PATHS = {
+    "ASY107": "cometbft_tpu/trace/x.py",
+    "ASY109": "cometbft_tpu/mempool/x.py",
+}
 
 
 # --- 1. rule fixtures -------------------------------------------------
@@ -302,6 +306,27 @@ FIXTURES = [
         """,
     ),
     (
+        "ASY109",  # unbounded-queue-in-hot-plane (FIXTURE_PATHS)
+        """
+        import asyncio
+        def build():
+            a = asyncio.Queue()
+            b = asyncio.Queue(maxsize=0)
+            c = InstrumentedQueue(name="x")
+            return a, b, c
+        """,
+        """
+        import asyncio, queue
+        def build():
+            a = asyncio.Queue(100)
+            b = asyncio.Queue(maxsize=256)
+            c = InstrumentedQueue(512, name="x")
+            d = queue.Queue()      # sync stdlib queue: not this rule
+            e = Queue()            # ambiguous bare spelling: not ours
+            return a, b, c, d, e
+        """,
+    ),
+    (
         "SYN000",  # syntax errors are findings, not crashes
         """
         def f(:
@@ -327,6 +352,20 @@ def test_rule_fixture(rule_id, bad, good):
     assert rule_id not in ids_of(good, path), (
         f"{rule_id} false-positived on its negative"
     )
+
+
+def test_asy109_scoped_to_hot_planes():
+    src = """
+    import asyncio
+    def f():
+        return asyncio.Queue()
+    """
+    # tools / tests / utils are out of scope: an unbounded queue in a
+    # CLI helper is not a hot-plane OOM hazard
+    assert "ASY109" not in ids_of(src)
+    assert "ASY109" not in ids_of(src, "cometbft_tpu/utils/x.py")
+    for pkg in ("p2p", "consensus", "types", "obs", "rpc"):
+        assert "ASY109" in ids_of(src, f"cometbft_tpu/{pkg}/x.py"), pkg
 
 
 def test_asy107_scoped_to_trace_package():

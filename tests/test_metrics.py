@@ -61,6 +61,81 @@ def test_metrics_shim_without_prometheus():
     assert metrics_mod.HAVE_PROMETHEUS == bool(saved)
 
 
+def test_metrics_server_endpoint_shim_tier():
+    """The /metrics HTTP endpoint itself must serve under the no-wheel
+    shim tier (ISSUE 6 satellite): same aiohttp server, placeholder
+    body, correct content type — a node with prometheus = true and no
+    wheel still answers scrapes instead of 500ing."""
+    saved = {
+        k: v for k, v in sys.modules.items()
+        if k == "prometheus_client" or k.startswith("prometheus_client.")
+    }
+    for k in saved:
+        sys.modules[k] = None
+    sys.modules["prometheus_client"] = None
+    try:
+        shimmed = importlib.reload(metrics_mod)
+        assert not shimmed.HAVE_PROMETHEUS
+
+        async def main():
+            m = shimmed.NodeMetrics("shim-srv")
+            srv = shimmed.MetricsServer(m)
+            await srv.start("127.0.0.1:0")
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(
+                        f"http://{srv.listen_addr}/metrics"
+                    ) as resp:
+                        assert resp.status == 200
+                        assert resp.content_type == "text/plain"
+                        text = await resp.text()
+                assert "unavailable" in text
+            finally:
+                await srv.stop()
+
+        run(main())
+    finally:
+        for k in list(sys.modules):
+            if k == "prometheus_client" or k.startswith(
+                "prometheus_client."
+            ):
+                del sys.modules[k]
+        sys.modules.update(saved)
+        importlib.reload(metrics_mod)
+    assert metrics_mod.HAVE_PROMETHEUS == bool(saved)
+
+
+@needs_prometheus
+def test_metrics_server_endpoint_real_tier_standalone():
+    """Real-wheel twin of the shim test: a bare NodeMetrics (no node
+    attached) serves the registered metric families over HTTP."""
+
+    async def main():
+        m = metrics_mod.NodeMetrics("real-srv")
+        m.height.set(7)
+        srv = metrics_mod.MetricsServer(m)
+        await srv.start("127.0.0.1:0")
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://{srv.listen_addr}/metrics"
+                ) as resp:
+                    assert resp.status == 200
+                    text = await resp.text()
+            line = [
+                ln for ln in text.splitlines()
+                if ln.startswith('cometbft_consensus_height{')
+            ][0]
+            assert float(line.split()[-1]) == 7
+            # health-plane families registered even before attach
+            assert "cometbft_loop_lag_seconds" in text
+            assert "cometbft_loop_stalls_total" in text
+        finally:
+            await srv.stop()
+
+    run(main())
+
+
 @needs_prometheus
 def test_prometheus_metrics_endpoint():
     gen, pvs = make_genesis(1, chain_id="metrics-chain")
@@ -104,6 +179,25 @@ def test_prometheus_metrics_endpoint():
         ), step_counts
         assert "cometbft_consensus_wal_fsync_seconds" in text
         assert "cometbft_blocksync_window_blocks_per_s" in text
+        # runtime health plane (docs/OBS.md): watchdog lag beats have
+        # landed in the histogram by height 3, queue gauges labeled
+        lag_counts = [
+            ln
+            for ln in text.splitlines()
+            if ln.startswith("cometbft_loop_lag_seconds_count{")
+        ]
+        assert lag_counts and any(
+            float(ln.split()[-1]) > 0 for ln in lag_counts
+        ), lag_counts
+        q_depth = [
+            ln
+            for ln in text.splitlines()
+            if ln.startswith("cometbft_queue_depth{")
+        ]
+        assert any('queue="consensus.inbox"' in ln for ln in q_depth)
+        assert any('queue="mempool.ingest"' in ln for ln in q_depth)
+        assert "cometbft_queue_high_watermark{" in text
+        assert "cometbft_queue_dropped_total{" in text
         await node.stop()
 
     run(main())

@@ -121,7 +121,21 @@ def test_ws_subscription_new_block():
 def test_misc_routes():
     async def main():
         node, cli = await _single_node()
-        assert await cli.call("health") == {}
+        # health is the obs plane's verdict now (docs/OBS.md): a
+        # freshly committing single node must read ok with live lag
+        # + queue telemetry attached
+        h = await cli.call("health")
+        assert h["status"] in ("ok", "degraded")
+        assert "loop_lag_ms" in h and "p95_ms" in h["loop_lag_ms"]
+        assert "queue_high_watermarks" in h
+        assert int(h["latest_block_height"]) >= 1
+        dt = await cli.call("dump_tasks")
+        assert int(dt["n_tasks"]) >= 1
+        assert any(
+            "consensus" in t["name"] or "receive" in t["name"]
+            or t["stack"]
+            for t in dt["tasks"]
+        )
         gen = await cli.call("genesis")
         assert gen["genesis"]["chain_id"] == "rpc-chain"
         ni = await cli.call("net_info")

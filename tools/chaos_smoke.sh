@@ -18,12 +18,31 @@ SEED="${CHAOS_SEED:-1337}"
 TRACE_DIR="$(mktemp -d -t chaos_smoke_trace.XXXXXX)"
 trap 'rm -rf "$TRACE_DIR"' EXIT
 
-echo "== chaos smoke: invariants must hold (seed=$SEED) =="
+echo "== chaos smoke: invariants + span budgets must hold (seed=$SEED) =="
+# --budget evaluates tools/span_budgets.toml over the run's rings and
+# prints the verdict table in the report (docs/OBS.md); a breach exits 2
 JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" \
-    --trace-dump "$TRACE_DIR"
+    --trace-dump "$TRACE_DIR" --budget
 
-echo "== chaos smoke: per-node span summary (docs/TRACE.md) =="
-python -m cometbft_tpu.trace summarize "$TRACE_DIR"
+echo "== chaos smoke: per-node span summary + budget table (docs/TRACE.md) =="
+# note: paths BEFORE --budget (its optional FILE value would swallow
+# a trailing path)
+python -m cometbft_tpu.trace summarize "$TRACE_DIR" --budget
+
+echo "== chaos smoke: forced loop stall must be flight-recorded =="
+# one seeded stall scenario: the nemesis blocks the loop for 1.2s at
+# height 2; the obs watchdog's monitor thread must snapshot the
+# offending chaos_stall frame mid-flight (exit 1 on a miss)
+cat > "$TRACE_DIR/stall_schedule.json" <<'EOF'
+[
+  {"action": "stall", "at_height": 2, "duration_s": 1.2},
+  {"action": "crash", "at_height": 3, "node": 1},
+  {"action": "restart", "after_s": 0.5, "node": 1}
+]
+EOF
+JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" \
+    --schedule "$TRACE_DIR/stall_schedule.json" --expect-stall \
+    --trace-dump "$TRACE_DIR/stall"
 
 echo "== chaos smoke: byzantine corruption must be DETECTED =="
 # --trace-dump keeps the EXPECTED violation's auto-dump inside the
