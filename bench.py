@@ -496,6 +496,30 @@ def _budget_verdicts(tsum):
         return [{"error": repr(e)[:200], "ok": True}]
 
 
+def _quorum_summary(tsum):
+    """Quorum-latency rows (ISSUE 7 cross-node tracing) pulled out of
+    a trace summary: the consensus.quorum.* waterfall legs plus live
+    p2p propagation, surfaced next to the budget verdicts so perf PRs
+    diff the commit-latency attribution, not just span totals. Replay
+    configs have no live consensus — the note says so explicitly
+    instead of the key silently vanishing."""
+    if not tsum:
+        return None
+    out = {}
+    for node, kinds in tsum.items():
+        rows = {
+            k: v
+            for k, v in kinds.items()
+            if k.startswith("consensus.quorum.")
+            or k == "p2p.msg.propagation"
+        }
+        if rows:
+            out[node] = rows
+    return out or {
+        "note": "no live-consensus quorum spans in this config"
+    }
+
+
 # --- corpus: 150-validator chain (cached across rounds) ----------------
 
 
@@ -1046,7 +1070,8 @@ def bench_replay(gen, parts, n_blocks: int) -> dict:
                 "per-block sequential baseline)"
             ),
             **({"trace_summary": tsum,
-    "budget_verdicts": _budget_verdicts(tsum)} if tsum else {}),
+    "budget_verdicts": _budget_verdicts(tsum),
+    "quorum_latency": _quorum_summary(tsum)} if tsum else {}),
             **seq,
         }
 
@@ -1077,7 +1102,8 @@ def bench_replay(gen, parts, n_blocks: int) -> dict:
         # the lookahead overlap genuinely engaged during the run
         "pipeline": pipe_stats,
         **({"trace_summary": tsum,
-    "budget_verdicts": _budget_verdicts(tsum)} if tsum else {}),
+    "budget_verdicts": _budget_verdicts(tsum),
+    "quorum_latency": _quorum_summary(tsum)} if tsum else {}),
     }
 
 

@@ -36,18 +36,22 @@ STATUS_POLL_INTERVAL_S = 2.0
 class NetPeerClient:
     """Adapts one remote peer to the pool's request_block interface."""
 
-    def __init__(self, peer):
+    def __init__(self, peer, switch=None):
         self.peer = peer
+        self.switch = switch  # trace stamping (stamp_msg); may be None
         self.pending: Dict[int, asyncio.Future] = {}
 
     async def request_block(self, height: int):
         fut = asyncio.get_running_loop().create_future()
         self.pending[height] = fut
         try:
-            await self.peer.send(
-                BLOCKSYNC_CHANNEL,
-                bytes([MSG_BLOCK_REQUEST]) + struct.pack(">q", height),
-            )
+            msg = bytes([MSG_BLOCK_REQUEST]) + struct.pack(">q", height)
+            if self.switch is not None:
+                msg = self.switch.stamp_msg(
+                    BLOCKSYNC_CHANNEL, msg, "bs.request", height=height,
+                    peer=self.peer.peer_id,
+                )
+            await self.peer.send(BLOCKSYNC_CHANNEL, msg)
             return await fut
         finally:
             self.pending.pop(height, None)
@@ -133,7 +137,9 @@ class BlockSyncNetReactor(Reactor):
             while True:
                 if self.active and self.switch is not None:
                     self.switch.broadcast(
-                        BLOCKSYNC_CHANNEL, bytes([MSG_STATUS_REQUEST])
+                        BLOCKSYNC_CHANNEL,
+                        bytes([MSG_STATUS_REQUEST]),
+                        tkind="bs.status",
                     )
                 await asyncio.sleep(STATUS_POLL_INTERVAL_S)
         except asyncio.CancelledError:
@@ -142,7 +148,7 @@ class BlockSyncNetReactor(Reactor):
     # --- peers --------------------------------------------------------
 
     def add_peer(self, peer) -> None:
-        self.clients[peer.peer_id] = NetPeerClient(peer)
+        self.clients[peer.peer_id] = NetPeerClient(peer, self.switch)
         # announce our status so the peer can request from us
         peer.try_send(BLOCKSYNC_CHANNEL, self._status_response())
         if self.active:
@@ -188,11 +194,14 @@ class BlockSyncNetReactor(Reactor):
             ec = self.block_store.load_extended_commit(height)
             if ec:
                 payload += proto.field_bytes(2, ec)
+            resp = bytes([MSG_BLOCK_RESPONSE]) + payload
+            if self.switch is not None:
+                resp = self.switch.stamp_msg(
+                    BLOCKSYNC_CHANNEL, resp, "bs.block",
+                    height=height, peer=peer.peer_id,
+                )
             spawn(
-                peer.send(
-                    BLOCKSYNC_CHANNEL,
-                    bytes([MSG_BLOCK_RESPONSE]) + payload,
-                ),
+                peer.send(BLOCKSYNC_CHANNEL, resp),
                 name="blocksync-block-response",
             )
         elif mtype == MSG_BLOCK_RESPONSE:

@@ -28,6 +28,7 @@ from ..node.node import Node
 from ..p2p import MemoryTransport, NodeInfo, NodeKey
 from ..store.block_store import _hkey
 from ..trace import global_tracer, write_chrome, write_jsonl
+from ..trace import rebase as timeline_rebase
 from ..utils.log import get_logger
 from ..utils.tasks import spawn
 from .invariants import (
@@ -328,6 +329,35 @@ class ChaosNet:
         out.sort(key=lambda r: r.get("ts_ns", 0))
         return out
 
+    @staticmethod
+    def _anchored(tr) -> list:
+        """Ring snapshot with its monotonic→wall clock anchor
+        guaranteed present: a lapped ring drops the ``clock.anchor``
+        instant, but the anchor also rides ``Tracer.meta`` (recorded
+        at build, node/inprocess.record_clock_anchor), so it is
+        re-synthesized here — the cross-node timeline rebase must
+        never lose a ring's clock alignment to ring churn."""
+        events = tr.snapshot()
+        mono = tr.meta.get("anchor_mono_ns")
+        if (
+            events
+            and mono
+            and not any(e["name"] == "clock.anchor" for e in events)
+        ):
+            events.insert(
+                0,
+                {
+                    "seq": -1,
+                    "name": "clock.anchor",
+                    "ph": "i",
+                    "ts_ns": mono,
+                    "dur_ns": 0,
+                    "tid": "main",
+                    "args": {"wall_ns": tr.meta["anchor_wall_ns"]},
+                },
+            )
+        return events
+
     def ring_snapshots(self) -> Dict[str, list]:
         """{label: events} over every incarnation's ring plus the
         process ring — the in-memory form dump_traces writes out and
@@ -335,7 +365,7 @@ class ChaosNet:
         by_node: Dict[str, list] = {}
         for cn in self.nodes:
             for gen, tr in enumerate(cn.tracers):
-                events = tr.snapshot()
+                events = self._anchored(tr)
                 if not events:
                     continue
                 label = (
@@ -343,7 +373,7 @@ class ChaosNet:
                     else f"{cn.name}.{gen}"
                 )
                 by_node[label] = events
-        proc = global_tracer().snapshot()
+        proc = self._anchored(global_tracer())
         if proc:
             by_node["process"] = proc
         return by_node
@@ -352,7 +382,13 @@ class ChaosNet:
         """Write every node's trace ring (one JSONL per incarnation —
         restarts get a fresh ring, so n1 that crashed and came back
         dumps n1.0 and n1.1) plus the crypto plane's process ring and
-        one merged Perfetto-loadable trace.json. Returns the files."""
+        one merged Perfetto-loadable trace.json. Returns the files.
+
+        Per-ring JSONL keeps raw monotonic timestamps (each carries
+        its ``clock.anchor``); the MERGED trace.json is rebased via
+        those anchors and stable-sorted per ring, so node timelines
+        line up in Perfetto instead of landing at arbitrary
+        monotonic offsets (docs/TRACE.md "Cross-node timelines")."""
         os.makedirs(out_dir, exist_ok=True)
         files: List[str] = []
         by_node = self.ring_snapshots()
@@ -365,9 +401,10 @@ class ChaosNet:
                 )
             )
         if by_node:
+            rebased, _offsets, _base = timeline_rebase(by_node)
             files.append(
                 write_chrome(
-                    os.path.join(out_dir, "trace.json"), by_node
+                    os.path.join(out_dir, "trace.json"), rebased
                 )
             )
         return files

@@ -178,6 +178,15 @@ class InstrumentationConfig:
     # events retained per node (ring slots, preallocated; oldest
     # events are overwritten once the ring laps)
     trace_ring_size: int = 16384
+    # cross-node causal tracing (docs/TRACE.md "Cross-node
+    # timelines"): consensus/mempool/blocksync p2p messages carry a
+    # compact trace-context stamp (origin, height/round/kind, send
+    # instant) so receivers record correlated recv instants and the
+    # `trace timeline` CLI can stitch all rings into one view.
+    # Decoding and receive-side arrival recording are always on
+    # (while the tracer is enabled); this only gates the OUTBOUND
+    # stamp — and is moot while trace_enabled is false.
+    trace_msg_stamp: bool = True
     # runtime health plane (cometbft_tpu/obs, docs/OBS.md): the
     # event-loop watchdog measures scheduling lag via a monotonic
     # heartbeat and fires the loop-stall flight recorder (thread +

@@ -180,9 +180,15 @@ class ConsensusReactor(Reactor):
     def _on_cs_broadcast(self, kind: str, payload) -> None:
         if self.switch is None or self.wait_sync:
             return
+        # proposal/part/vote broadcasts carry a trace-context stamp
+        # (cross-node causal tracing, p2p/tracewire.py); has_vote/
+        # has_part acks and round-step announcements stay raw — they
+        # are not part of the commit-latency attribution chain
         if kind == "proposal":
+            p = payload.proposal
             self.switch.broadcast(
-                DATA_CHANNEL, encode_proposal_msg(payload.proposal)
+                DATA_CHANNEL, encode_proposal_msg(p),
+                tkind="proposal", height=p.height, round_=p.round,
             )
         elif kind == "block_part":
             self.switch.broadcast(
@@ -190,6 +196,8 @@ class ConsensusReactor(Reactor):
                 encode_block_part_msg(
                     payload.height, payload.round, payload.part
                 ),
+                tkind="block_part",
+                height=payload.height, round_=payload.round,
             )
             # tell peers we have it so they stop retransmitting to us
             self.switch.broadcast(
@@ -199,8 +207,10 @@ class ConsensusReactor(Reactor):
                 ),
             )
         elif kind == "vote":
+            v = payload.vote
             self.switch.broadcast(
-                VOTE_CHANNEL, encode_vote_msg(payload.vote)
+                VOTE_CHANNEL, encode_vote_msg(v),
+                tkind="vote", height=v.height, round_=v.round,
             )
             self.switch.broadcast(
                 STATE_CHANNEL, encode_has_vote(*_vote_key(payload.vote))
@@ -318,12 +328,17 @@ class ConsensusReactor(Reactor):
                             sent_at[ckey] = now
                             await peer.send(
                                 DATA_CHANNEL,
-                                encode_commit_block(
-                                    block,
-                                    commit,
-                                    self.block_store.load_extended_commit(
-                                        prs.height
+                                self.switch.stamp_msg(
+                                    DATA_CHANNEL,
+                                    encode_commit_block(
+                                        block,
+                                        commit,
+                                        self.block_store
+                                        .load_extended_commit(prs.height),
                                     ),
+                                    "commit_block",
+                                    height=prs.height,
+                                    peer=peer.peer_id,
                                 ),
                             )
                     continue
@@ -335,7 +350,14 @@ class ConsensusReactor(Reactor):
                     key = ("prop", rs.height, rs.round)
                     if due(key):
                         peer.try_send(
-                            DATA_CHANNEL, encode_proposal_msg(rs.proposal)
+                            DATA_CHANNEL,
+                            self.switch.stamp_msg(
+                                DATA_CHANNEL,
+                                encode_proposal_msg(rs.proposal),
+                                "proposal",
+                                height=rs.height, round_=rs.round,
+                                peer=peer.peer_id,
+                            ),
                         )
                         sent_at[key] = now
                 if rs.proposal_block_parts is not None:
@@ -350,8 +372,14 @@ class ConsensusReactor(Reactor):
                             continue
                         peer.try_send(
                             DATA_CHANNEL,
-                            encode_block_part_msg(
-                                rs.height, rs.round, part
+                            self.switch.stamp_msg(
+                                DATA_CHANNEL,
+                                encode_block_part_msg(
+                                    rs.height, rs.round, part
+                                ),
+                                "block_part",
+                                height=rs.height, round_=rs.round,
+                                peer=peer.peer_id,
                             ),
                         )
                         sent_at[("part",) + pkey] = now
@@ -367,7 +395,14 @@ class ConsensusReactor(Reactor):
                         continue
                     if not due(("vote",) + vkey):
                         continue
-                    peer.try_send(VOTE_CHANNEL, encode_vote_msg(vote))
+                    peer.try_send(
+                        VOTE_CHANNEL,
+                        self.switch.stamp_msg(
+                            VOTE_CHANNEL, encode_vote_msg(vote), "vote",
+                            height=vote.height, round_=vote.round,
+                            peer=peer.peer_id,
+                        ),
+                    )
                     sent_at[("vote",) + vkey] = now
                     sent_votes += 1
                     if sent_votes >= MAX_GOSSIP_VOTES_PER_TICK:

@@ -19,7 +19,7 @@ import asyncio
 import time
 import traceback
 from dataclasses import dataclass
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import types as T
 from ..config import ConsensusConfig
@@ -106,6 +106,19 @@ class ConsensusState:
         self._sp_height = None
         self._sp_round = None
         self._sp_step = None
+        # commit-latency attribution (ISSUE 7, docs/TRACE.md
+        # "Cross-node timelines"): per-height monotonic marks the
+        # quorum spans and the last-commit breakdown are computed
+        # from. All reset by update_to_state.
+        self._round_t0_ns = 0
+        self._proposal_complete_ns = 0
+        self._verify_ns = 0
+        self._quorum_at: Dict = {}  # (round, "prevote"|"precommit") -> ns
+        self._vote_first: Dict = {}  # (round, vote type) -> first-arrival ns
+        # {"height", "phases": {...}, "dominant"} for the last height
+        # this node committed — served by RPC health so a degraded
+        # verdict can cite the dominant phase
+        self.last_commit_breakdown: Optional[Dict] = None
 
         self.update_to_state(state)
 
@@ -189,6 +202,12 @@ class ConsensusState:
             last_precommits = self.rs.votes.precommits(
                 self.rs.commit_round
             ) if self.rs.votes and self.rs.commit_round >= 0 else None
+        # fresh height: reset the commit-latency attribution marks
+        self._round_t0_ns = time.monotonic_ns()
+        self._proposal_complete_ns = 0
+        self._verify_ns = 0
+        self._quorum_at = {}
+        self._vote_first = {}
         self.rs = RoundState(
             height=height,
             round=0,
@@ -238,6 +257,8 @@ class ConsensusState:
                 traceback.print_exc()
 
     def _handle_msg(self, kind: str, payload, peer_id: str) -> None:
+        if self.tracer.enabled:
+            self._trace_handle(kind, payload, peer_id)
         if kind == "proposal":
             if self._set_proposal(payload.proposal) and peer_id != "":
                 self._broadcast("proposal", payload)
@@ -259,6 +280,26 @@ class ConsensusState:
         elif kind == "signed_proposal":
             prop, parts = payload
             self._publish_own_proposal(prop, parts)
+
+    def _trace_handle(self, kind: str, payload, peer_id: str) -> None:
+        """Correlated handling instant (ISSUE 7): the state-machine
+        side of the p2p.msg.recv instants — the gap between the two is
+        the consensus-inbox queue wait."""
+        h = r = None
+        if kind == "proposal":
+            h, r = payload.proposal.height, payload.proposal.round
+        elif kind == "block_part":
+            h, r = payload.height, payload.round
+        elif kind in ("vote", "signed_vote"):
+            h, r = payload.vote.height, payload.vote.round
+        elif kind == "commit_block":
+            h = payload.block.height
+        else:
+            return
+        self.tracer.instant(
+            "consensus.msg.handle", tid="consensus", kind=kind,
+            h=h, r=r, peer=peer_id[:12] if peer_id else "self",
+        )
 
     def _handle_commit_block(self, payload, peer_id: str) -> None:
         """Catch-up: a peer sent us a committed block + its commit
@@ -369,18 +410,22 @@ class ConsensusState:
         """Shared tail of _finalize_commit and ingest_verified_block:
         persist, WAL-barrier, apply, advance to the next height."""
         height = block.height
+        t_fin = time.monotonic_ns()
         fail_point("cs-before-save-block")  # reference state.go:1769
         if self.block_store.height() < height:
             self.block_store.save_block(block, parts, commit)
         else:
             self.block_store.save_seen_commit(height, commit)
+        t_persist = time.monotonic_ns()
         fail_point("cs-after-save-block")  # :1786
         if self.wal:
             self.wal.write_end_height(height)
+        t_wal = time.monotonic_ns()
         fail_point("cs-after-wal-end-height")  # :1809
         new_state = self.block_exec.apply_verified_block(
             self.state, bid, block
         )
+        t_apply = time.monotonic_ns()
         fail_point("cs-after-apply")  # :1837
         _log.info(
             "finalized block",
@@ -390,6 +435,16 @@ class ConsensusState:
             app_hash=Lazy(lambda: new_state.app_hash[:8].hex()),
         )
         self.decided_heights += 1
+        # finalize leg of the commit waterfall (recorded before the
+        # height span closes below, so it nests in Perfetto)
+        self.tracer.complete(
+            "consensus.finalize", t_fin, t_apply - t_fin,
+            tid="consensus", height=height,
+            persist_ms=round((t_persist - t_fin) / 1e6, 3),
+            wal_ms=round((t_wal - t_persist) / 1e6, 3),
+            apply_ms=round((t_apply - t_wal) / 1e6, 3),
+        )
+        self._note_commit_breakdown(height, t_fin, t_persist, t_wal, t_apply)
         # close the height's span stack and stamp the commit;
         # ingest-path commits may have no open round/step spans
         self._close_trace_spans()
@@ -556,6 +611,7 @@ class ConsensusState:
         # so the new round's spans nest cleanly under the height span
         self._close_trace_spans("_sp_step", "_sp_round")
         _log.debug("entering new round", height=height, round=round_)
+        self._round_t0_ns = time.monotonic_ns()
         rs.round = round_
         rs.step = Step.NEW_ROUND
         if round_ > 0:
@@ -741,6 +797,12 @@ class ConsensusState:
             data = rs.proposal_block_parts.assemble()
             block = codec.decode_block(data)
             rs.proposal_block = block
+            # attribution mark: proposal fully propagated to this node
+            self._proposal_complete_ns = time.monotonic_ns()
+            self.tracer.instant(
+                "consensus.proposal.complete", tid="consensus",
+                height=height, round=rs.round,
+            )
             self.event_bus.publish_type(
                 ev.EVENT_COMPLETE_PROPOSAL,
                 {"height": height, "block_id": rs.proposal.block_id if rs.proposal else None},
@@ -785,7 +847,9 @@ class ConsensusState:
         if rs.proposal_block is None:
             self._sign_add_vote(T.PREVOTE, None, None)
             return
-        # validate
+        # validate (spanned: the "verify" leg of the per-height
+        # commit-latency waterfall, docs/TRACE.md)
+        t_verify = time.monotonic_ns()
         try:
             self.block_exec.validate_block(self.state, rs.proposal_block)
             accepted = self.block_exec.process_proposal(
@@ -793,6 +857,12 @@ class ConsensusState:
             )
         except Exception:
             accepted = False
+        self._verify_ns = time.monotonic_ns() - t_verify
+        self.tracer.complete(
+            "consensus.verify", t_verify, self._verify_ns,
+            tid="consensus", height=height, round=round_,
+            accepted=accepted,
+        )
         if accepted:
             self._sign_add_vote(
                 T.PREVOTE,
@@ -1208,6 +1278,8 @@ class ConsensusState:
             )
             return
         self.event_bus.publish_type(ev.EVENT_VOTE, vote)
+        if self.tracer.enabled and vote.height == rs.height:
+            self._record_vote_arrival(vote, peer_id)
         if peer_id != "":
             self._broadcast("vote", VoteMessage(vote))
         height, round_ = rs.height, rs.round
@@ -1215,6 +1287,7 @@ class ConsensusState:
             prevotes = rs.votes.prevotes(vote.round)
             bid = prevotes.two_thirds_majority()
             if bid is not None and not bid.is_nil():
+                self._record_quorum(vote.round, "prevote")
                 # unlock if POL for something else (reference :2274)
                 if (
                     rs.locked_block is not None
@@ -1248,6 +1321,8 @@ class ConsensusState:
             precommits = rs.votes.precommits(vote.round)
             bid = precommits.two_thirds_majority()
             if bid is not None:
+                if not bid.is_nil():
+                    self._record_quorum(vote.round, "precommit")
                 self._enter_new_round(height, vote.round)
                 self._enter_precommit(height, vote.round)
                 if not bid.is_nil():
@@ -1260,6 +1335,90 @@ class ConsensusState:
             elif vote.round >= round_ and precommits.has_two_thirds_any():
                 self._enter_new_round(height, vote.round)
                 self._enter_precommit_wait(height, vote.round)
+
+    # --- commit-latency attribution (ISSUE 7) -------------------------
+
+    def _record_quorum(self, round_: int, step: str) -> None:
+        """First time ⅔ of voting power lands on a non-nil block for
+        (height, round, step): record a pre-measured span from round
+        entry to now — the time-to-quorum leg of the commit waterfall
+        (rides the span→metrics bridge into
+        consensus_quorum_latency_seconds{step})."""
+        key = (round_, step)
+        if key in self._quorum_at:
+            return
+        now = time.monotonic_ns()
+        self._quorum_at[key] = now
+        t0 = self._round_t0_ns or now
+        self.tracer.complete(
+            f"consensus.quorum.{step}", t0, max(0, now - t0),
+            tid="consensus", height=self.rs.height, round=round_,
+            step=step,
+        )
+
+    def _note_commit_breakdown(
+        self, height: int, t_fin: int, t_persist: int, t_wal: int,
+        t_apply: int,
+    ) -> None:
+        """Phase attribution for the height just committed, measured
+        from this round's entry (monotonic, this node's clock). Phases
+        that never happened on this node (ingest path, nil rounds) are
+        simply absent. ``dominant`` names the largest DISJOINT segment
+        of the commit timeline — what RPC health cites when latency
+        degrades."""
+        t0 = self._round_t0_ns or t_fin
+        ms = 1e6
+        rs = self.rs
+        segments: Dict[str, float] = {}
+        prop = self._proposal_complete_ns
+        if prop >= t0:
+            segments["proposal_ms"] = (prop - t0) / ms
+        pv = self._quorum_at.get((rs.commit_round, "prevote"))
+        pc = self._quorum_at.get((rs.commit_round, "precommit"))
+        if pv is not None:
+            segments["prevote_wait_ms"] = (
+                pv - (prop if prop >= t0 else t0)
+            ) / ms
+        if pc is not None:
+            segments["precommit_wait_ms"] = (pc - (pv or t0)) / ms
+        segments["persist_ms"] = (t_persist - t_fin) / ms
+        segments["wal_ms"] = (t_wal - t_persist) / ms
+        segments["apply_ms"] = (t_apply - t_wal) / ms
+        phases = {k: round(max(0.0, v), 3) for k, v in segments.items()}
+        if pv is not None:
+            phases["prevote_quorum_ms"] = round(max(0.0, (pv - t0) / ms), 3)
+        if pc is not None:
+            phases["precommit_quorum_ms"] = round(
+                max(0.0, (pc - t0) / ms), 3
+            )
+        if self._verify_ns:
+            # overlaps the prevote segment (it IS part of forming our
+            # prevote); reported but excluded from `dominant`
+            phases["verify_ms"] = round(self._verify_ns / ms, 3)
+        phases["total_ms"] = round(max(0.0, (t_apply - t0) / ms), 3)
+        dominant = max(segments, key=lambda k: segments[k])
+        self.last_commit_breakdown = {
+            "height": height,
+            "round": rs.commit_round,
+            "phases": phases,
+            "dominant": dominant,
+        }
+
+    def _record_vote_arrival(self, vote, peer_id: str) -> None:
+        """Per-peer vote-arrival skew: a span from the FIRST vote of
+        this (round, type) wave to this vote's arrival, labeled by the
+        delivering peer (self for our own votes). The bridge surfaces
+        the latest value as a per-peer gauge."""
+        now = time.monotonic_ns()
+        fkey = (vote.round, vote.type_)
+        first = self._vote_first.setdefault(fkey, now)
+        self.tracer.complete(
+            "consensus.vote.skew", first, max(0, now - first),
+            tid="consensus",
+            peer=peer_id[:12] if peer_id else "self",
+            step="prevote" if vote.type_ == T.PREVOTE else "precommit",
+            height=vote.height, round=vote.round,
+        )
 
     # --- misc ---------------------------------------------------------
 

@@ -18,6 +18,7 @@ import traceback
 from collections import OrderedDict
 from typing import Dict, List
 
+from ..p2p import tracewire
 from ..p2p.node_info import ChannelDescriptor
 from ..p2p.reactor import Reactor
 from ..utils.tasks import spawn
@@ -92,12 +93,21 @@ class MempoolReactor(Reactor):
     async def _send_txs(self, peer, txs: List[bytes]) -> None:
         msg = codec.encode_txs(txs)
         if len(txs) == 1 and len(msg) > MAX_FRAME_BYTES:
-            # a magic-prefixed tx so large that the batch-of-one
-            # escape crosses the channel cap: send the RAW bytes (the
-            # pre-batching wire form, <= max_tx_bytes <= channel cap);
-            # the receiver's decode falls back to single-tx on the
-            # inevitable parse failure
-            msg = txs[0]
+            # a tx so large that the batch-of-one framing crosses the
+            # channel cap: send the pre-batching wire form (raw tx,
+            # <= max_tx_bytes <= channel cap); the receiver's decode
+            # falls back to single-tx on the inevitable parse failure.
+            # encode_plain still escapes a stamp-magic-prefixed tx so
+            # the receiver's always-on peel cannot mutate it (raw only
+            # when even the 3-byte escape would cross the cap)
+            msg = tracewire.encode_plain(txs[0], MAX_FRAME_BYTES)
+        elif self.switch is not None:
+            # cross-node tracing: gossip batches carry the trace
+            # stamp OUTSIDE the tx framing (stamp_msg skips payloads
+            # too close to the channel cap)
+            msg = self.switch.stamp_msg(
+                MEMPOOL_CHANNEL, msg, "txs", peer=peer.peer_id
+            )
         await peer.send(MEMPOOL_CHANNEL, msg)
 
     async def _broadcast_tx_routine(self, peer) -> None:
@@ -211,7 +221,7 @@ class AppMempoolReactor(Reactor):
         """Entry for locally-submitted txs (RPC broadcast_tx path)."""
         res = self.mempool.check_tx(tx)
         if res.is_ok() and self.broadcast and self.switch is not None:
-            self.switch.broadcast(MEMPOOL_CHANNEL, tx)
+            self.switch.broadcast(MEMPOOL_CHANNEL, tx, tkind="txs")
         return res
 
     def receive(self, chan_id: int, peer, msg: bytes) -> None:
@@ -232,7 +242,10 @@ class AppMempoolReactor(Reactor):
         except Exception:
             return
         if res.is_ok() and self.broadcast and self.switch is not None:
-            # forward to everyone but the sender (guard stops loops)
+            # forward to everyone but the sender (guard stops loops);
+            # encode once — stamp_msg escapes a magic-prefixed raw tx
+            # (attacker-shaped bytes) so receivers never mutate it
+            wire = self.switch.stamp_msg(MEMPOOL_CHANNEL, msg, "txs")
             for p in self.switch.peers.values():
                 if p.peer_id != sender:
-                    p.try_send(MEMPOOL_CHANNEL, msg)
+                    p.try_send(MEMPOOL_CHANNEL, wire)

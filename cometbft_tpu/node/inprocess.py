@@ -36,6 +36,28 @@ from ..types.genesis import GenesisDoc
 from ..utils import kv
 
 
+def record_clock_anchor(tracer) -> None:
+    """Stamp a monotonic→wall clock anchor on a freshly-built ring.
+
+    The pair (one monotonic_ns and one time_ns read back-to-back)
+    lets the cross-node timeline tool (trace/timeline.py) rebase
+    rings from different processes onto one wall-clock axis. It lives
+    HERE — in node assembly, not in trace/ — because ASY107 bans
+    wall-clock reads inside the tracing plane; the anchor rides
+    ``tracer.meta`` (authoritative, survives ring laps) plus a
+    best-effort ``clock.anchor`` instant for raw-event consumers.
+    Idempotent per tracer."""
+    if not getattr(tracer, "enabled", False) or tracer.meta.get(
+        "anchor_mono_ns"
+    ):
+        return
+    mono = time.monotonic_ns()
+    wall = time.time_ns()
+    tracer.meta["anchor_mono_ns"] = mono
+    tracer.meta["anchor_wall_ns"] = wall
+    tracer.instant_at("clock.anchor", mono, tid="main", wall_ns=wall)
+
+
 @dataclass
 class NodeParts:
     """Everything a running node is made of (pre-networking)."""
@@ -96,7 +118,8 @@ def build_node(
             name=config.base.moniker or "node",
             size=config.instrumentation.trace_ring_size,
         )
-        enable_global()
+        record_clock_anchor(tracer)
+        record_clock_anchor(enable_global())
     if config.crypto.batch_backend:
         # operator-selected verifier backend (config.toml [crypto]
         # batch_backend); empty inherits the process-wide default so

@@ -169,6 +169,18 @@ class Node:
         # (consensus/mempool/WAL got theirs in build_node)
         self.switch.tracer = self.parts.tracer
         self.blocksync_reactor.inner.tracer = self.parts.tracer
+        # cross-node causal tracing (docs/TRACE.md): stamp outbound
+        # consensus/mempool/blocksync messages with a trace context so
+        # peers record correlated receive instants. Origin is the
+        # moniker (matches the ring label chaos dumps use).
+        # trace_msg_stamp gates only the OUTBOUND stamp — a node with
+        # it off still records arrivals from stamping peers.
+        if self.parts.tracer.enabled:
+            self.switch.enable_stamping(
+                self.parts.tracer,
+                config.base.moniker or self.node_key.node_id[:8],
+                outbound=config.instrumentation.trace_msg_stamp,
+            )
         self._adaptive = adaptive
         self._cs_started = False
         self.rpc_server = None

@@ -21,6 +21,7 @@ from ..trace import NOOP as TRACE_NOOP
 from ..utils.backoff import Backoff
 from ..utils.log import get_logger
 from ..utils.tasks import spawn
+from . import tracewire
 from .node_info import ChannelDescriptor, NodeInfo
 from .peer import Peer
 from .reactor import Reactor
@@ -52,6 +53,7 @@ class Switch:
         self.reactors: Dict[str, Reactor] = {}
         self.chan_to_reactor: Dict[int, Reactor] = {}
         self.channel_descs: List[ChannelDescriptor] = []
+        self._chan_caps: Dict[int, int] = {}
         self.peers: Dict[str, Peer] = {}
         self.persistent_addrs: Dict[str, str] = {}  # id -> addr
         self.banned: set = set()
@@ -63,6 +65,12 @@ class Switch:
         # tracing plane (trace/): node wiring swaps in the per-node
         # tracer; peer-count changes land as counter events
         self.tracer = TRACE_NOOP
+        # cross-node causal tracing (p2p/tracewire.py): when the node
+        # wiring enables stamping, outbound consensus/mempool/
+        # blocksync messages carry a trace context and every stamped
+        # receive records a correlated instant. None = fully off
+        # (one attribute check per send, startswith per receive).
+        self.stamper = None
 
     # --- reactor registry ---------------------------------------------
 
@@ -75,6 +83,7 @@ class Switch:
                 )
             self.chan_to_reactor[desc.chan_id] = reactor
             self.channel_descs.append(desc)
+            self._chan_caps[desc.chan_id] = desc.max_msg_size
             self.node_info.channels.append(desc.chan_id)
         reactor.set_switch(self)
         return reactor
@@ -228,6 +237,15 @@ class Switch:
         return peer
 
     def _on_peer_msg(self, chan_id: int, msg: bytes, peer: Peer) -> None:
+        # cross-node tracing: peel an optional trace-context stamp
+        # (tracewire) before channel dispatch, recording the
+        # correlated receive instant. Decoding is ALWAYS on — stamped
+        # traffic from tracing peers must interop with nodes whose own
+        # stamping (or whole tracer) is off.
+        if msg[:2] == tracewire.MAGIC:
+            ctx, msg = tracewire.unstamp(msg)
+            if ctx is not None and self.stamper is not None:
+                self.stamper.on_receive(ctx, peer.peer_id)
         reactor = self.chan_to_reactor.get(chan_id)
         if reactor is None:
             self.stop_peer_for_error(
@@ -324,9 +342,67 @@ class Switch:
 
         self._reconnect_tasks[peer_id] = asyncio.create_task(routine())
 
-    # --- broadcast ----------------------------------------------------
+    # --- broadcast / trace stamping -----------------------------------
 
-    def broadcast(self, chan_id: int, msg: bytes) -> None:
+    def enable_stamping(
+        self, tracer, origin: str, outbound: bool = True
+    ) -> None:
+        """Turn on the cross-node tracing plane (node wiring).
+        ``outbound=False`` ([instrumentation] trace_msg_stamp off)
+        keeps receive-side correlation recording while this node's
+        own sends go out unstamped."""
+        self.stamper = tracewire.TraceStamper(tracer, origin, outbound)
+
+    def stamp_msg(
+        self,
+        chan_id: int,
+        msg: bytes,
+        kind: str,
+        height: int = 0,
+        round_: int = -1,
+        peer: str = "",
+    ) -> bytes:
+        """Wire form for a single traced send (the per-peer gossip
+        routines): stamped when the stamping plane is on; otherwise
+        the message unchanged — except a payload that happens to
+        begin with the stamp magic, which is escaped either way
+        (receive-side peel is ALWAYS on, so a raw magic-prefixed
+        payload — e.g. an adversarial tx — would otherwise be
+        mutated by the receiver)."""
+        st = self.stamper
+        if st is None or not st.outbound:
+            return tracewire.encode_plain(
+                msg, self._chan_caps.get(chan_id, 0)
+            )
+        return st.wrap(
+            msg, kind, height=height, round_=round_,
+            cap=self._chan_caps.get(chan_id, 0), peer=peer[:12],
+        )
+
+    def broadcast(
+        self,
+        chan_id: int,
+        msg: bytes,
+        tkind: Optional[str] = None,
+        height: int = 0,
+        round_: int = -1,
+    ) -> None:
+        """Send to every peer; with ``tkind`` set and stamping on, the
+        message is stamped ONCE with a trace context (ISSUE 7: one
+        encode per broadcast, one send instant carrying the fan-out).
+        Unstamped broadcasts still escape a magic-prefixed payload
+        (see ``stamp_msg``) — raw txs are attacker-shaped bytes."""
+        st = self.stamper
+        if st is not None and st.outbound and tkind is not None:
+            msg = st.wrap(
+                msg, tkind, height=height, round_=round_,
+                cap=self._chan_caps.get(chan_id, 0),
+                npeers=len(self.peers),
+            )
+        else:
+            msg = tracewire.encode_plain(
+                msg, self._chan_caps.get(chan_id, 0)
+            )
         for p in list(self.peers.values()):
             p.try_send(chan_id, msg)
 

@@ -138,8 +138,23 @@ def health(env) -> Dict[str, Any]:
             maxsize = int(s.get("maxsize", 0) or 0)
             if maxsize and int(s.get("depth", 0)) >= maxsize:
                 reasons.append(f"queue {name} is full ({maxsize})")
+    bd = getattr(env.consensus_state, "last_commit_breakdown", None)
+    if bd is not None:
+        # per-phase attribution of the last committed height (ISSUE 7
+        # cross-node tracing, docs/TRACE.md "Cross-node timelines"):
+        # proposal wait, quorum waits, verify, persist/wal/apply, plus
+        # the dominant disjoint segment
+        out["last_height_commit_breakdown"] = bd
     out["status"] = "degraded" if reasons else "ok"
     if reasons:
+        if bd is not None:
+            # a degraded verdict cites WHERE the last commit spent
+            # its time, so the operator starts at the right phase
+            reasons.append(
+                f"last commit h={bd['height']} dominated by "
+                f"{bd['dominant']} "
+                f"({bd['phases'].get(bd['dominant'], '?')}ms)"
+            )
         out["reasons"] = reasons
     return out
 

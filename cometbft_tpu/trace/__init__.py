@@ -24,7 +24,19 @@ attribute check (tests/test_trace.py bounds it).
 
 from .bridge import SpanMetricsBridge
 from .export import chrome_trace, read_jsonl, write_chrome, write_jsonl
-from .summary import format_summary, percentile, summarize
+from .summary import (
+    format_summary,
+    percentile,
+    summarize,
+    summarize_by_height,
+)
+from .timeline import (
+    attribute_heights,
+    attribution_key,
+    format_waterfall,
+    merge_events,
+    rebase,
+)
 from .tracer import NOOP, NOOP_SPAN, Tracer
 
 __all__ = [
@@ -32,13 +44,19 @@ __all__ = [
     "NOOP_SPAN",
     "SpanMetricsBridge",
     "Tracer",
+    "attribute_heights",
+    "attribution_key",
     "chrome_trace",
     "enable_global",
     "format_summary",
+    "format_waterfall",
     "global_tracer",
+    "merge_events",
     "percentile",
     "read_jsonl",
+    "rebase",
     "summarize",
+    "summarize_by_height",
     "write_chrome",
     "write_jsonl",
 ]

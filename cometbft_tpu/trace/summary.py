@@ -58,6 +58,61 @@ def summarize(events_by_node: Dict[str, List[dict]]) -> Dict:
     return out
 
 
+def summarize_by_height(events_by_node: Dict[str, List[dict]]) -> Dict:
+    """{height: {span_name: {count, p50_ms, max_ms, total_ms}}} over
+    the complete spans that carry a height arg (``height`` on the
+    consensus spans, ``h`` on the compact p2p events), aggregated
+    ACROSS nodes — the per-height grouping behind
+    ``summarize --by-height`` (docs/TRACE.md)."""
+    per_h: Dict[int, Dict[str, List[int]]] = {}
+    for events in events_by_node.values():
+        for e in events:
+            if e.get("ph", "X") != "X":
+                continue
+            a = e.get("args") or {}
+            h = a.get("height", a.get("h"))
+            if h in (None, 0):
+                continue
+            per_h.setdefault(int(h), {}).setdefault(
+                e["name"], []
+            ).append(e.get("dur_ns", 0))
+    ms = 1e6
+    out: Dict = {}
+    for h in sorted(per_h):
+        spans: Dict = {}
+        for name in sorted(per_h[h]):
+            ds = sorted(per_h[h][name])
+            spans[name] = {
+                "count": len(ds),
+                "p50_ms": round(percentile(ds, 0.50) / ms, 3),
+                "max_ms": round(ds[-1] / ms, 3),
+                "total_ms": round(sum(ds) / ms, 3),
+            }
+        out[h] = spans
+    return out
+
+
+def format_by_height(by_height: Dict) -> str:
+    """One block per height, aggregated across nodes."""
+    if not by_height:
+        return "no height-tagged spans found"
+    lines: List[str] = []
+    hdr = (
+        f"{'span':<34} {'count':>7} {'p50ms':>9} {'max ms':>9} "
+        f"{'total ms':>10}"
+    )
+    for h, spans in by_height.items():
+        lines.append(f"== height {h} ==")
+        lines.append(hdr)
+        for name, s in spans.items():
+            lines.append(
+                f"{name:<34} {s['count']:>7} {s['p50_ms']:>9} "
+                f"{s['max_ms']:>9} {s['total_ms']:>10}"
+            )
+        lines.append("")
+    return "\n".join(lines).rstrip()
+
+
 def format_summary(summary: Dict) -> str:
     """Aligned text table, one block per node."""
     lines: List[str] = []

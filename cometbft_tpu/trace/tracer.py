@@ -115,6 +115,7 @@ class Tracer:
 
     __slots__ = (
         "enabled", "name", "_n", "_ring", "_count", "_observers",
+        "meta",
     )
 
     def __init__(
@@ -131,6 +132,11 @@ class Tracer:
         ]
         self._count = itertools.count()
         self._observers: List[Callable] = []
+        # ring-level metadata set by the BUILDER (node code), never by
+        # this plane: the monotonic→wall clock anchor lives here so
+        # cross-node timelines can rebase rings from different
+        # processes (ASY107 keeps wall-clock reads out of trace/)
+        self.meta: Dict = {}
 
     # --- append paths -------------------------------------------------
 
@@ -181,6 +187,16 @@ class Tracer:
         if not self.enabled:
             return
         self._append(name, "i", _monotonic_ns(), 0, tid, args)
+
+    def instant_at(
+        self, name: str, ts_ns: int, tid: Optional[str] = None, **args
+    ) -> None:
+        """Instant with a caller-supplied monotonic timestamp (the
+        p2p stamping plane records send instants at the exact instant
+        baked into the wire stamp)."""
+        if not self.enabled:
+            return
+        self._append(name, "i", ts_ns, 0, tid, args)
 
     def counter(self, name: str, value, tid: Optional[str] = None) -> None:
         if not self.enabled:

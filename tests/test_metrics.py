@@ -130,6 +130,12 @@ def test_metrics_server_endpoint_real_tier_standalone():
             # health-plane families registered even before attach
             assert "cometbft_loop_lag_seconds" in text
             assert "cometbft_loop_stalls_total" in text
+            # cross-node tracing families (ISSUE 7) likewise
+            assert "cometbft_consensus_quorum_latency_seconds" in text
+            assert "cometbft_p2p_msg_propagation_seconds" in text
+            assert (
+                "cometbft_consensus_vote_arrival_skew_seconds" in text
+            )
         finally:
             await srv.stop()
 
@@ -198,6 +204,35 @@ def test_prometheus_metrics_endpoint():
         assert any('queue="mempool.ingest"' in ln for ln in q_depth)
         assert "cometbft_queue_high_watermark{" in text
         assert "cometbft_queue_dropped_total{" in text
+        # cross-node tracing bridge (ISSUE 7): even a single-node
+        # chain observes its own 2/3 quorum (it IS 2/3), so the
+        # quorum-latency histogram must carry samples for both steps
+        # by height 3, and the vote-skew gauge a peer="self" series
+        q_counts = {
+            ln
+            for ln in text.splitlines()
+            if ln.startswith(
+                "cometbft_consensus_quorum_latency_seconds_count{"
+            )
+        }
+        for step in ("prevote", "precommit"):
+            lns = [ln for ln in q_counts if f'step="{step}"' in ln]
+            assert lns and float(lns[0].split()[-1]) > 0, (step, q_counts)
+        assert (
+            "cometbft_consensus_vote_arrival_skew_seconds{"
+            in text
+        )
+        skew = [
+            ln
+            for ln in text.splitlines()
+            if ln.startswith(
+                "cometbft_consensus_vote_arrival_skew_seconds{"
+            )
+        ]
+        assert any('peer="self"' in ln for ln in skew), skew
+        # no peers on a 1-node net: the propagation family is
+        # registered but empty
+        assert "cometbft_p2p_msg_propagation_seconds" in text
         await node.stop()
 
     run(main())
@@ -235,6 +270,20 @@ def test_prometheus_metrics_over_lp2p():
             if ln.startswith("cometbft_p2p_message_receive_bytes_total{")
         ]
         assert recv and float(recv[0].split()[-1]) > 0
+        # cross-node tracing over the lp2p switcher (ISSUE 7): the
+        # stamping plane rides the shared Switch base, so stamped
+        # consensus traffic between two same-process nodes lands live
+        # propagation samples in the bridge histogram
+        prop = [
+            ln
+            for ln in text.splitlines()
+            if ln.startswith(
+                "cometbft_p2p_msg_propagation_seconds_count{"
+            )
+        ]
+        assert prop and any(
+            float(ln.split()[-1]) > 0 for ln in prop
+        ), prop
         for n in nodes:
             await n.stop()
 

@@ -129,6 +129,15 @@ def test_misc_routes():
         assert "loop_lag_ms" in h and "p95_ms" in h["loop_lag_ms"]
         assert "queue_high_watermarks" in h
         assert int(h["latest_block_height"]) >= 1
+        # ISSUE 7: per-phase attribution of the last committed height
+        # so a degraded verdict can cite the dominant phase
+        bd = h["last_height_commit_breakdown"]
+        assert bd["height"] >= 1
+        assert bd["dominant"] in bd["phases"]
+        assert {"persist_ms", "wal_ms", "apply_ms", "total_ms"} <= set(
+            bd["phases"]
+        )
+        assert all(v >= 0 for v in bd["phases"].values())
         dt = await cli.call("dump_tasks")
         assert int(dt["n_tasks"]) >= 1
         assert any(

@@ -9,7 +9,11 @@ Layers:
      parallel-verify chunk spans on the process tracer;
   4. the ISSUE 4 acceptance scenario: a 4-node in-process chaos run
      with tracing enabled produces a Perfetto-loadable trace whose
-     consensus step spans nest correctly per height/round.
+     consensus step spans nest correctly per height/round;
+  5. ISSUE 7 cross-node timelines: clock-anchor rebase, per-height
+     commit-latency attribution, stamp/correlate overhead guards,
+     and the 4-node acceptance (complete attribution chain per
+     committed height, same-seed structural determinism).
 """
 
 import asyncio
@@ -22,10 +26,16 @@ from cometbft_tpu.trace import (
     NOOP,
     SpanMetricsBridge,
     Tracer,
+    attribute_heights,
+    attribution_key,
     chrome_trace,
+    format_waterfall,
+    merge_events,
     percentile,
     read_jsonl,
+    rebase,
     summarize,
+    summarize_by_height,
     write_jsonl,
 )
 from cometbft_tpu.trace.cli import main as trace_cli
@@ -418,6 +428,421 @@ def test_chaos_run_traced_perfetto_loadable(tmp_path):
         names = {e["name"] for e in events}
         # chaos homes persist a WAL: the fsync barrier must be spanned
         assert "wal.fsync" in names, (node, sorted(names))
+        # ISSUE 7 cross-node tracing: every ring carries its clock
+        # anchor and the stamped-correlation instants
+        assert "clock.anchor" in names, (node, sorted(names))
+        assert {"p2p.msg.send", "p2p.msg.recv"} <= names, node
+        assert {
+            "consensus.quorum.prevote", "consensus.quorum.precommit",
+            "consensus.finalize",
+        } <= names, (node, sorted(names))
     # and the summary machinery digests the whole dump
     s = summarize(by_node)
     assert all("consensus.step" in kinds for kinds in s.values())
+
+    # ISSUE 7 acceptance: every committed height carries a COMPLETE
+    # attribution chain — the proposer's proposal send correlated to
+    # arrival instants on all committing peers, both quorum legs
+    # measured per height
+    rebased, offsets, _base = rebase(by_node)
+    assert all(o is not None for o in offsets.values()), offsets
+    heights = attribute_heights(rebased)
+    assert len(heights) >= 2, sorted(heights)
+    for h, rec in heights.items():
+        assert rec["complete"], (h, rec)
+        assert rec["proposer"] in rec["committed"], rec
+        assert rec["quorum_prevote_ms"] and rec["quorum_precommit_ms"]
+        for n, f in rec["finalize"].items():
+            assert f["total_ms"] >= 0 and f["wal_ms"] is not None
+    # non-proposer nodes saw the proposal propagate (positive delta
+    # on the shared in-process clock)
+    any_prop = [
+        v for rec in heights.values()
+        for v in rec["propagation_ms"].values()
+    ]
+    assert any_prop and all(v >= 0 for v in any_prop)
+    # the waterfall table renders one row per height
+    table = format_waterfall(heights)
+    assert "complete" in table and "PARTIAL" not in table
+
+    # the timeline CLI digests the same dump: --strict passes, -o
+    # writes a Perfetto-loadable merged view on one rebased axis
+    out = tmp_path / "timeline.json"
+    assert (
+        trace_cli(
+            ["timeline", str(tmp_path / "traces"), "--strict",
+             "-o", str(out)]
+        )
+        == 0
+    )
+    with open(out) as f:
+        tl = json.load(f)
+    assert tl["traceEvents"]
+
+
+def test_chaos_same_seed_attribution_is_deterministic(tmp_path):
+    """Same-seed chaos runs replay the same message decision stream,
+    so the attribution table's STRUCTURE — committed heights, the
+    proposer per height, chain completeness — reproduces exactly
+    (latency columns are wall-clock and jitter run to run; the common
+    committed prefix is compared because wall time decides how many
+    heights land before the schedule ends)."""
+    from cometbft_tpu.chaos import FaultSchedule, run_schedule
+
+    async def one(i):
+        return await run_schedule(
+            FaultSchedule([]),
+            seed=909,
+            base_dir=str(tmp_path / f"net{i}"),
+            n_nodes=4,
+            settle_heights=2,
+            liveness_bound_s=120.0,
+            trace_dir=str(tmp_path / f"traces{i}"),
+            profile_hz=0,
+        )
+
+    keys = []
+    for i in range(2):
+        report = run(one(i))
+        assert report.ok, report.format()
+        by_node = read_jsonl(
+            [p for p in report.trace_files if "/n" in p]
+        )
+        rebased, _, _ = rebase(by_node)
+        heights = attribute_heights(rebased)
+        assert heights
+        keys.append(
+            {
+                h: (rec["proposer"], rec["complete"])
+                for h, rec in heights.items()
+            }
+        )
+    common = sorted(set(keys[0]) & set(keys[1]))
+    assert common, (sorted(keys[0]), sorted(keys[1]))
+    for h in common:
+        assert keys[0][h] == keys[1][h], (h, keys[0][h], keys[1][h])
+
+
+# --- 5. ISSUE 7: cross-node timelines -----------------------------------
+
+
+def _mk_ring(node, anchor_mono, anchor_wall, events):
+    """Synthetic ring: a clock.anchor instant + the given events
+    (ts_ns are monotonic in this ring's private clock domain)."""
+    out = [
+        {
+            "seq": -1, "name": "clock.anchor", "ph": "i",
+            "ts_ns": anchor_mono, "dur_ns": 0, "tid": "main",
+            "args": {"wall_ns": anchor_wall},
+        }
+    ]
+    for i, e in enumerate(events):
+        out.append(
+            {
+                "seq": i, "ph": e.get("ph", "i"), "tid": "t",
+                "dur_ns": e.get("dur_ns", 0),
+                **{
+                    k: e[k] for k in ("name", "ts_ns", "args")
+                },
+            }
+        )
+    return {node: out}
+
+
+def test_rebase_aligns_rings_across_clock_domains():
+    """Two rings whose monotonic clocks are wildly offset but whose
+    anchors map to the same wall instant must land on ONE axis: an
+    event stamped 5ms after n0's anchor and one 6ms after n1's anchor
+    come out exactly 1ms apart."""
+    WALL = 1_700_000_000_000_000_000
+    by_node = {}
+    by_node.update(_mk_ring("n0", 10_000_000, WALL, [
+        {"name": "a", "ts_ns": 15_000_000, "args": {}},
+    ]))
+    by_node.update(_mk_ring("n1", 999_000_000_000, WALL, [
+        {"name": "b", "ts_ns": 999_006_000_000, "args": {}},
+    ]))
+    rebased, offsets, base = rebase(by_node)
+    assert offsets["n0"] != offsets["n1"]  # different mono domains
+    ts = {
+        e["name"]: e["ts_ns"]
+        for evs in rebased.values()
+        for e in evs
+        if e["name"] in ("a", "b")
+    }
+    assert ts["b"] - ts["a"] == 1_000_000
+    # zeroed at the earliest event (the anchors themselves)
+    assert min(
+        e["ts_ns"] for evs in rebased.values() for e in evs
+    ) == 0
+    # merged view is stable-sorted on the shared axis, nodes tagged
+    flat = merge_events(rebased)
+    assert [e["ts_ns"] for e in flat] == sorted(
+        e["ts_ns"] for e in flat
+    )
+    assert all("node" in e for e in flat)
+
+
+def test_rebase_unanchored_ring_borrows_median_offset():
+    by_node = {}
+    by_node.update(_mk_ring("n0", 100, 1_000_100, [
+        {"name": "a", "ts_ns": 200, "args": {}},
+    ]))
+    # no anchor at all in n1's ring
+    by_node["n1"] = [
+        {"seq": 0, "name": "b", "ph": "i", "ts_ns": 250, "dur_ns": 0,
+         "tid": "t", "args": {}},
+    ]
+    rebased, offsets, _ = rebase(by_node)
+    assert offsets["n1"] is None
+    ts = {
+        e["name"]: e["ts_ns"]
+        for evs in rebased.values() for e in evs
+    }
+    # borrowed n0's offset: raw deltas preserved on the shared axis
+    assert ts["b"] - ts["a"] == 50
+
+
+def test_attribute_heights_waterfall_and_completeness():
+    """Synthetic 2-node height: proposal send on n0 correlates to
+    n1's recv; quorum/verify/finalize legs land in the waterfall;
+    dropping the peer's arrival flips the chain to PARTIAL."""
+    W = 1_000_000_000
+
+    def ring(node, send_recv):
+        evs = [
+            {"name": "consensus.quorum.prevote", "ph": "X",
+             "ts_ns": 10_000_000, "dur_ns": 3_000_000,
+             "args": {"height": 5, "round": 0, "step": "prevote"}},
+            {"name": "consensus.quorum.precommit", "ph": "X",
+             "ts_ns": 10_000_000, "dur_ns": 5_000_000,
+             "args": {"height": 5, "round": 0, "step": "precommit"}},
+            {"name": "consensus.verify", "ph": "X",
+             "ts_ns": 11_000_000, "dur_ns": 400_000,
+             "args": {"height": 5, "round": 0, "accepted": True}},
+            {"name": "consensus.finalize", "ph": "X",
+             "ts_ns": 16_000_000, "dur_ns": 2_000_000,
+             "args": {"height": 5, "persist_ms": 0.5, "wal_ms": 1.0,
+                      "apply_ms": 0.5}},
+        ] + send_recv
+        return _mk_ring(node, 0, W, evs)
+
+    by_node = {}
+    by_node.update(ring("n0", [
+        {"name": "p2p.msg.send", "ph": "i", "ts_ns": 9_000_000,
+         "args": {"kind": "proposal", "h": 5, "r": 0, "seq": 1}},
+    ]))
+    by_node.update(ring("n1", [
+        {"name": "p2p.msg.recv", "ph": "i", "ts_ns": 9_800_000,
+         "args": {"kind": "proposal", "h": 5, "r": 0, "seq": 1,
+                  "origin": "n0"}},
+        {"name": "consensus.proposal.complete", "ph": "i",
+         "ts_ns": 9_900_000, "args": {"height": 5, "round": 0}},
+    ]))
+    heights = attribute_heights(rebase(by_node)[0])
+    assert sorted(heights) == [5]
+    rec = heights[5]
+    assert rec["proposer"] == "n0"
+    assert rec["committed"] == ["n0", "n1"]
+    assert rec["complete"]
+    assert rec["propagation_ms"] == {"n1": 0.8}
+    assert rec["parts_ms"] == {"n1": 0.9}
+    assert rec["quorum_prevote_ms"] == {"n0": 3.0, "n1": 3.0}
+    assert rec["quorum_precommit_ms"] == {"n0": 5.0, "n1": 5.0}
+    assert rec["verify_ms"] == {"n0": 0.4, "n1": 0.4}
+    assert rec["finalize"]["n1"]["wal_ms"] == 1.0
+    key = attribution_key(heights)
+    assert key == [(5, "n0", ("n0", "n1"), True)]
+    assert "complete" in format_waterfall(heights)
+
+    # peel n1's arrival instants: the chain is no longer complete
+    by_node["n1"] = [
+        e for e in by_node["n1"]
+        if e["name"] not in (
+            "p2p.msg.recv", "consensus.proposal.complete"
+        )
+    ]
+    heights = attribute_heights(rebase(by_node)[0])
+    assert not heights[5]["complete"]
+    assert heights[5]["missing_arrival"] == ["n1"]
+    assert "PARTIAL" in format_waterfall(heights)
+
+    # ...unless the node caught up via commit_block gossip, which is
+    # its own causal chain (recv instant on the stamped catch-up)
+    by_node["n1"].append(
+        {"seq": 99, "name": "p2p.msg.recv", "ph": "i",
+         "ts_ns": 15_000_000, "dur_ns": 0, "tid": "t",
+         "args": {"kind": "commit_block", "h": 5, "seq": 9,
+                  "origin": "n0"}}
+    )
+    heights = attribute_heights(rebase(by_node)[0])
+    assert heights[5]["complete"]
+
+
+def test_timeline_cli_json_and_strict(tmp_path):
+    W = 2_000_000_000
+    ring = _mk_ring("n0", 0, W, [
+        {"name": "consensus.finalize", "ph": "X", "ts_ns": 5_000_000,
+         "dur_ns": 1_000_000,
+         "args": {"height": 3, "persist_ms": 0.1, "wal_ms": 0.2,
+                  "apply_ms": 0.3}},
+    ])
+    p = write_jsonl(str(tmp_path / "n0.trace.jsonl"), "n0", ring["n0"])
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = trace_cli(["timeline", p, "--json"])
+    assert rc == 0
+    doc = json.loads(buf.getvalue())
+    assert doc["offsets_ns"]["n0"] == W
+    assert doc["heights"]["3"]["committed"] == ["n0"]
+    # no proposal send anywhere: the chain is incomplete => --strict
+    # exits 3 (and an empty dump is also strict-fatal)
+    assert not doc["heights"]["3"]["complete"]
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = trace_cli(["timeline", p, "--strict"])
+    assert rc == 3
+    assert "PARTIAL" in buf.getvalue()
+
+
+def test_summarize_by_height_groups_across_nodes(tmp_path, capsys):
+    events = []
+    for h in (1, 2):
+        for node_dur in (1_000_000, 3_000_000):
+            events.append(
+                {"name": "consensus.quorum.prevote", "ph": "X",
+                 "ts_ns": 0, "dur_ns": node_dur, "tid": "c",
+                 "args": {"height": h, "step": "prevote"}}
+            )
+    # height-less spans stay out of the by-height grouping
+    events.append(
+        {"name": "wal.fsync", "ph": "X", "ts_ns": 0,
+         "dur_ns": 9_000_000, "tid": "w", "args": {}}
+    )
+    bh = summarize_by_height({"n0": events[:2] + events[-1:],
+                              "n1": events[2:4]})
+    assert sorted(bh) == [1, 2]
+    assert bh[1]["consensus.quorum.prevote"]["count"] == 2
+    assert bh[1]["consensus.quorum.prevote"]["max_ms"] == 3.0
+    assert "wal.fsync" not in bh[1]
+
+    # CLI: --by-height lands in both the table and the JSON doc
+    p = write_jsonl(
+        str(tmp_path / "n0.trace.jsonl"), "n0", events
+    )
+    assert trace_cli(["summarize", p, "--by-height"]) == 0
+    text = capsys.readouterr().out
+    assert "== height 1 ==" in text and "== height 2 ==" in text
+    assert trace_cli(["summarize", p, "--by-height", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert "summary" in doc and "by_height" in doc
+    assert doc["by_height"]["1"]["consensus.quorum.prevote"]["count"] == 2
+
+
+# --- 5b. ISSUE 7 overhead guards (stamp-encode / correlate) --------------
+
+
+def _per_call(fn, n=20_000, repeats=7):
+    best = None
+    for _ in range(repeats):
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            fn()
+        dt = (time.perf_counter_ns() - t0) / n
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def test_stamp_and_correlate_overhead_bounds():
+    """ISSUE 7 overhead guards: stamping a send and correlating a
+    receive are per-MESSAGE costs on the p2p hot path, so they are
+    bounded like the PR 4/6 guards — scaled against a no-op-call
+    baseline measured under the same conditions, with an absolute
+    backstop for this throttled box."""
+    import gc
+
+    from cometbft_tpu.p2p import tracewire
+
+    payload = b"\x05" + b"v" * 120  # a realistic vote-sized message
+    enabled = Tracer("on", size=4096)
+    st = tracewire.TraceStamper(enabled, origin="n0")
+    wire = st.wrap(payload, "vote", height=3, round_=0)
+    ctx, _ = tracewire.unstamp(wire)
+    disabled = Tracer("off", size=4, enabled=False)
+    st_off = tracewire.TraceStamper(disabled, origin="n0")
+
+    def noop():
+        pass
+
+    gc.disable()
+    try:
+        baseline = _per_call(noop)
+        stamp_cost = _per_call(
+            lambda: st.wrap(payload, "vote", height=3, round_=0)
+        )
+        unstamp_cost = _per_call(lambda: tracewire.unstamp(wire))
+        correlate_cost = _per_call(lambda: st.on_receive(ctx, "peerid"))
+        # tracer-disabled paths: recv correlation short-circuits on
+        # enabled; the raw non-magic receive check is one startswith
+        recv_off = _per_call(lambda: st_off.on_receive(ctx, "peerid"))
+        plain_check = _per_call(
+            lambda: payload[:2] == tracewire.MAGIC
+        )
+    finally:
+        gc.enable()
+
+    # enabled paths: real work (varint encode + ring append) but
+    # strictly micro — a few dozen call-costs, never ms
+    assert stamp_cost < max(25_000, 150 * baseline), (
+        f"stamp-encode {stamp_cost:.0f}ns/call "
+        f"(baseline {baseline:.0f}ns)"
+    )
+    assert unstamp_cost < max(15_000, 100 * baseline), (
+        f"unstamp {unstamp_cost:.0f}ns/call"
+    )
+    assert correlate_cost < max(25_000, 150 * baseline), (
+        f"correlate-on-receive {correlate_cost:.0f}ns/call"
+    )
+    # disabled paths: attribute checks only
+    assert recv_off < max(2_000, 15 * baseline), (
+        f"disabled on_receive {recv_off:.0f}ns/call"
+    )
+    assert plain_check < max(2_000, 15 * baseline), (
+        f"magic check {plain_check:.0f}ns/call"
+    )
+    # and the disabled receive path recorded nothing
+    assert disabled.snapshot() == []
+
+
+def test_stamp_msg_disabled_switch_path_is_attribute_check():
+    """Switch.stamp_msg with no stamping plane must stay a near-free
+    None check (every per-peer gossip send pays it)."""
+    import gc
+
+    from cometbft_tpu.p2p import MemoryTransport, NodeInfo, NodeKey
+    from cometbft_tpu.p2p.switch import Switch
+
+    nk = NodeKey.generate()
+    info = NodeInfo(node_id=nk.node_id, network="ovh")
+    sw = Switch(MemoryTransport(nk, info), info)
+    assert sw.stamper is None
+    msg = b"m" * 64
+
+    def noop():
+        pass
+
+    gc.disable()
+    try:
+        baseline = _per_call(noop)
+        cost = _per_call(
+            lambda: sw.stamp_msg(0x21, msg, "vote", height=1)
+        )
+    finally:
+        gc.enable()
+    assert cost < max(3_000, 25 * baseline), (
+        f"disabled stamp_msg {cost:.0f}ns/call "
+        f"(baseline {baseline:.0f}ns)"
+    )

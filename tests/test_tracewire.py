@@ -1,0 +1,345 @@
+"""Cross-node trace-context stamping (p2p/tracewire.py) tier-1 suite.
+
+Layers:
+  1. wire codec contracts: stamp/unstamp round-trip, the zero-header
+     escape, lossless fallback on anything unparseable (the
+     backward-compat framing satellite of ISSUE 7);
+  2. TraceStamper semantics: send/recv instants, channel-cap skip,
+     clock-domain gating of live propagation spans;
+  3. switch-level interop over a real MemoryTransport net: stamping
+     node <-> non-stamping node, payloads delivered byte-identical
+     both directions while the stamping side records correlations.
+"""
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.p2p import (
+    ChannelDescriptor,
+    MemoryTransport,
+    NodeInfo,
+    NodeKey,
+    Reactor,
+    Switch,
+)
+from cometbft_tpu.p2p import tracewire
+from cometbft_tpu.trace import Tracer
+
+
+def run(coro, timeout=30):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+# --- 1. wire codec -------------------------------------------------------
+
+
+def test_stamp_unstamp_roundtrip_all_kinds():
+    payload = b"\x01proposal-bytes" * 3
+    for kind in tracewire.KINDS:
+        wire = tracewire.stamp(
+            payload, kind, seq=7, origin="n0", height=12, round_=2,
+            send_ns=123456789,
+        )
+        assert wire.startswith(tracewire.MAGIC)
+        ctx, out = tracewire.unstamp(wire)
+        assert out == payload
+        assert ctx is not None
+        assert ctx.kind == kind and ctx.seq == 7
+        assert ctx.height == 12 and ctx.round == 2
+        assert ctx.origin == "n0" and ctx.send_ns == 123456789
+        assert ctx.clock == tracewire.CLOCK_DOMAIN
+
+
+def test_stamp_roundtrip_edge_values():
+    # no-round messages (blocksync) encode round -1 losslessly; empty
+    # payloads and long origins survive (origin truncated to the cap)
+    ctx, out = tracewire.unstamp(
+        tracewire.stamp(b"", "bs.status", seq=0, origin="x" * 64)
+    )
+    assert out == b"" and ctx.round == -1 and ctx.height == 0
+    assert ctx.origin == "x" * tracewire._MAX_ORIGIN_LEN
+
+
+def test_unstamped_passthrough_and_escape():
+    # plain bytes pass through untouched...
+    raw = b"ordinary reactor message"
+    assert tracewire.unstamp(raw) == (None, raw)
+    assert tracewire.encode_plain(raw) == raw
+    # ...and a payload that happens to BEGIN with the magic is
+    # escaped by a stamping-disabled sender so the receiver cannot
+    # misparse it: unstamp(escape(m)) == m, ctx None
+    tricky = tracewire.MAGIC + b"not actually a stamp"
+    wire = tracewire.encode_plain(tricky)
+    assert wire != tricky
+    ctx, out = tracewire.unstamp(wire)
+    assert ctx is None and out == tricky
+
+
+def test_unparseable_after_magic_falls_back_to_raw():
+    # an OLD peer relaying raw bytes that start with our magic but do
+    # not parse must come back unchanged (lossless both directions)
+    for tail in (
+        b"",  # bare magic
+        b"\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff",  # overlong varint
+        b"\x7f",  # header length way past the buffer
+        b"\x03\x63\x00\x00",  # unknown kind id (99)
+    ):
+        msg = tracewire.MAGIC + tail
+        ctx, out = tracewire.unstamp(msg)
+        assert ctx is None and out == msg
+
+    # truncated header: cut a valid stamp mid-header
+    wire = tracewire.stamp(b"payload", "vote", 1, "n1", height=3)
+    cut = wire[: len(tracewire.MAGIC) + 3]
+    assert tracewire.unstamp(cut) == (None, cut)
+
+    # origin length overrunning the declared header is rejected
+    hdr = bytearray()
+    tracewire._put_uvarint(hdr, 0)  # kind
+    tracewire._put_uvarint(hdr, 0)  # seq
+    tracewire._put_uvarint(hdr, 0)  # send_ns
+    tracewire._put_uvarint(hdr, 1)  # clock
+    tracewire._put_uvarint(hdr, 0)  # height
+    tracewire._put_uvarint(hdr, 0)  # round+1
+    tracewire._put_uvarint(hdr, 40)  # origin len LIE (past header end)
+    bad = bytearray(tracewire.MAGIC)
+    tracewire._put_uvarint(bad, len(hdr))
+    bad += hdr
+    bad = bytes(bad)
+    assert tracewire.unstamp(bad) == (None, bad)
+
+
+# --- 2. TraceStamper -----------------------------------------------------
+
+
+def test_stamper_records_correlated_send_recv_and_propagation():
+    t_send = Tracer("sender", size=64)
+    t_recv = Tracer("receiver", size=64)
+    sender = tracewire.TraceStamper(t_send, origin="n0")
+    receiver = tracewire.TraceStamper(t_recv, origin="n1")
+
+    wire = sender.wrap(b"vote-bytes", "vote", height=5, round_=1,
+                       peer="abcdef", npeers=3)
+    ctx, payload = tracewire.unstamp(wire)
+    assert payload == b"vote-bytes"
+    receiver.on_receive(ctx, "sender-peer-id")
+
+    send_ev = [e for e in t_send.snapshot() if e["name"] == "p2p.msg.send"]
+    assert len(send_ev) == 1
+    assert send_ev[0]["args"]["kind"] == "vote"
+    assert send_ev[0]["args"]["h"] == 5 and send_ev[0]["args"]["seq"] == 0
+    # the ring instant carries the EXACT instant baked into the stamp
+    assert send_ev[0]["ts_ns"] == ctx.send_ns
+
+    recv = {e["name"]: e for e in t_recv.snapshot()}
+    assert recv["p2p.msg.recv"]["args"]["origin"] == "n0"
+    assert recv["p2p.msg.recv"]["args"]["seq"] == 0
+    # same process => same clock domain => live propagation span
+    prop = recv["p2p.msg.propagation"]
+    assert prop["ts_ns"] == ctx.send_ns and prop["dur_ns"] >= 0
+
+    # a foreign clock domain must NOT produce a propagation span
+    # (monotonic clocks don't compare across processes)
+    t_recv.clear()
+    foreign = tracewire.TraceCtx(
+        "vote", 1, ctx.send_ns, ctx.clock ^ 0xFFFF, 5, 1, "other"
+    )
+    receiver.on_receive(foreign, "p")
+    names = [e["name"] for e in t_recv.snapshot()]
+    assert "p2p.msg.recv" in names
+    assert "p2p.msg.propagation" not in names
+
+
+def test_stamper_skips_payloads_near_channel_cap():
+    t = Tracer("s", size=16)
+    st = tracewire.TraceStamper(t, origin="n0")
+    big = b"x" * 1000
+    wire = st.wrap(big, "txs", cap=1000 + tracewire.STAMP_MAX_OVERHEAD - 1)
+    assert wire == big  # unstamped: would cross the cap
+    assert t.snapshot() == []  # and no phantom send instant
+    # with headroom it stamps
+    wire = st.wrap(big, "txs", cap=1000 + tracewire.STAMP_MAX_OVERHEAD)
+    assert wire.startswith(tracewire.MAGIC)
+    # magic-prefixed payload near the cap is escaped IF it fits,
+    # raw otherwise (never oversized, never misparsed)
+    tricky = tracewire.MAGIC + b"y" * 998
+    wire = st.wrap(tricky, "txs", cap=1001)
+    assert tracewire.unstamp(wire) == (None, tricky)
+
+
+# --- 3. switch-level interop over MemoryTransport ------------------------
+
+
+class SinkReactor(Reactor):
+    name = "sink"
+    CHAN = 0x55
+
+    def __init__(self):
+        super().__init__()
+        self.got = []
+
+    def get_channels(self):
+        return [ChannelDescriptor(self.CHAN, priority=3)]
+
+    def add_peer(self, peer):
+        pass
+
+    def remove_peer(self, peer, reason):
+        pass
+
+    def receive(self, chan_id, peer, msg):
+        self.got.append(bytes(msg))
+
+
+def _switch(chain_id="tracewire-net"):
+    nk = NodeKey.generate()
+    info = NodeInfo(node_id=nk.node_id, network=chain_id)
+    sw = Switch(MemoryTransport(nk, info), info)
+    rx = sw.add_reactor("sink", SinkReactor())
+    return sw, rx
+
+
+def test_switch_interop_stamping_vs_plain_peer():
+    """New (stamping) node <-> old (non-stamping) node: payloads are
+    byte-identical in both directions, including a payload that
+    starts with the magic bytes; the stamping side records correlated
+    send/recv instants, the plain side records nothing."""
+
+    async def main():
+        sw_new, rx_new = _switch()
+        sw_old, rx_old = _switch()
+        tr = Tracer("new", size=256)
+        sw_new.enable_stamping(tr, "new-node")
+        for sw in (sw_new, sw_old):
+            await sw.transport.listen()
+            await sw.start()
+        await sw_new.dial_peer(sw_old.transport.listen_addr)
+        for _ in range(100):
+            if sw_new.num_peers() and sw_old.num_peers():
+                break
+            await asyncio.sleep(0.02)
+
+        tricky = tracewire.MAGIC + b"looks-like-a-stamp"
+        # new -> old: stamped broadcast decodes to the original
+        # payload on a switch with NO stamping plane at all
+        sw_new.broadcast(SinkReactor.CHAN, b"stamped-hello",
+                         tkind="vote", height=9)
+        # new -> old: kind-less broadcast goes out raw
+        sw_new.broadcast(SinkReactor.CHAN, b"plain-hello")
+        # old -> new: raw sends, one of them magic-prefixed
+        sw_old.broadcast(SinkReactor.CHAN, b"old-hello")
+        sw_old.broadcast(SinkReactor.CHAN, tricky)
+        for _ in range(100):
+            if len(rx_old.got) >= 2 and len(rx_new.got) >= 2:
+                break
+            await asyncio.sleep(0.02)
+
+        assert rx_old.got == [b"stamped-hello", b"plain-hello"]
+        # the magic-prefixed raw payload survives IF it did not parse
+        # as a stamp (tracewire guarantees unparseable => unchanged)
+        assert rx_new.got == [b"old-hello", tricky]
+
+        ev = tr.snapshot()
+        sends = [e for e in ev if e["name"] == "p2p.msg.send"]
+        assert len(sends) == 1 and sends[0]["args"]["kind"] == "vote"
+        assert sends[0]["args"]["h"] == 9
+        await sw_new.stop()
+        await sw_old.stop()
+
+    run(main())
+
+
+def test_switch_interop_both_stamping_records_recv():
+    async def main():
+        sw_a, rx_a = _switch()
+        sw_b, rx_b = _switch()
+        tr_a, tr_b = Tracer("a", size=256), Tracer("b", size=256)
+        sw_a.enable_stamping(tr_a, "node-a")
+        sw_b.enable_stamping(tr_b, "node-b")
+        for sw in (sw_a, sw_b):
+            await sw.transport.listen()
+            await sw.start()
+        await sw_a.dial_peer(sw_b.transport.listen_addr)
+        for _ in range(100):
+            if sw_a.num_peers() and sw_b.num_peers():
+                break
+            await asyncio.sleep(0.02)
+        sw_a.broadcast(SinkReactor.CHAN, b"payload", tkind="proposal",
+                       height=4, round_=0)
+        for _ in range(100):
+            if rx_b.got:
+                break
+            await asyncio.sleep(0.02)
+        assert rx_b.got == [b"payload"]
+        recvs = [
+            e for e in tr_b.snapshot() if e["name"] == "p2p.msg.recv"
+        ]
+        assert len(recvs) == 1
+        a = recvs[0]["args"]
+        assert a["origin"] == "node-a" and a["kind"] == "proposal"
+        assert a["h"] == 4
+        # same process: the live propagation span fired too
+        props = [
+            e for e in tr_b.snapshot()
+            if e["name"] == "p2p.msg.propagation"
+        ]
+        assert props and props[0]["args"]["origin"] == "node-a"
+        await sw_a.stop()
+        await sw_b.stop()
+
+    run(main())
+
+
+def test_switch_receive_only_records_arrivals_without_stamping():
+    """trace_msg_stamp=False gates only the OUTBOUND stamp
+    (config.py): the node's own sends go out unstamped, but arrivals
+    from stamping peers are still recorded in its ring."""
+
+    async def main():
+        sw_rx, rx_rx = _switch()
+        sw_tx, rx_tx = _switch()
+        tr_rx, tr_tx = Tracer("rx", size=256), Tracer("tx", size=256)
+        sw_rx.enable_stamping(tr_rx, "rx-node", outbound=False)
+        sw_tx.enable_stamping(tr_tx, "tx-node")
+        for sw in (sw_rx, sw_tx):
+            await sw.transport.listen()
+            await sw.start()
+        await sw_rx.dial_peer(sw_tx.transport.listen_addr)
+        for _ in range(100):
+            if sw_rx.num_peers() and sw_tx.num_peers():
+                break
+            await asyncio.sleep(0.02)
+        sw_tx.broadcast(SinkReactor.CHAN, b"stamped", tkind="vote",
+                        height=2)
+        sw_rx.broadcast(SinkReactor.CHAN, b"from-rx", tkind="vote",
+                        height=2)
+        for _ in range(100):
+            if rx_rx.got and rx_tx.got:
+                break
+            await asyncio.sleep(0.02)
+        assert rx_rx.got == [b"stamped"] and rx_tx.got == [b"from-rx"]
+        # receive side still correlates...
+        recvs = [
+            e for e in tr_rx.snapshot() if e["name"] == "p2p.msg.recv"
+        ]
+        assert len(recvs) == 1 and recvs[0]["args"]["origin"] == "tx-node"
+        # ...but its own sends were unstamped: no send instant here,
+        # no recv instant on the stamping peer
+        assert not [
+            e for e in tr_rx.snapshot() if e["name"] == "p2p.msg.send"
+        ]
+        assert not [
+            e for e in tr_tx.snapshot() if e["name"] == "p2p.msg.recv"
+        ]
+        await sw_rx.stop()
+        await sw_tx.stop()
+
+    run(main())
+
+
+def test_stamp_msg_disabled_is_identity():
+    sw, _ = _switch()
+    msg = b"anything"
+    assert sw.stamper is None
+    assert sw.stamp_msg(0x55, msg, "vote", height=1) is msg

@@ -29,6 +29,13 @@ echo "== chaos smoke: per-node span summary + budget table (docs/TRACE.md) =="
 # a trailing path)
 python -m cometbft_tpu.trace summarize "$TRACE_DIR" --budget
 
+echo "== chaos smoke: per-height commit-latency attribution (docs/TRACE.md) =="
+# cross-node causal timeline over the invariant run's rings: every
+# committed height must carry a complete attribution chain (proposal
+# send on the proposer correlated to arrivals on all committing
+# peers, both quorum legs measured) — --strict exits 3 on a gap
+python -m cometbft_tpu.trace timeline "$TRACE_DIR" --strict
+
 echo "== chaos smoke: forced loop stall must be flight-recorded =="
 # one seeded stall scenario: the nemesis blocks the loop for 1.2s at
 # height 2; the obs watchdog's monitor thread must snapshot the
