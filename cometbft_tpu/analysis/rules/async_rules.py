@@ -476,6 +476,90 @@ def unbounded_queue_in_hot_plane(ctx: FileContext) -> List[Finding]:
     return out
 
 
+# shutdown-path function names covered by ASY110: these are the
+# teardown entry points whose hang IS the wedge class (a stop chain
+# awaiting a sub-plane that never returns — see obs/shutdown.py)
+_STOP_NAMES = {
+    "stop", "_stop", "close", "_close", "aclose", "shutdown",
+    "_shutdown", "_halt", "kill", "crash",
+}
+
+# awaited spellings that are bounded by construction
+_BOUNDED_AWAITS = {"asyncio.wait_for", "asyncio.sleep"}
+
+
+def _stop_await_allowed(node: ast.Await) -> bool:
+    """True when the awaited expression is bounded: asyncio.wait_for /
+    sleep, asyncio.wait WITH a timeout, a ShutdownGuard ``.stage``
+    hop, or delegation to another covered shutdown method on self/cls
+    (which this rule lints on its own)."""
+    value = node.value
+    if not isinstance(value, ast.Call):
+        return False  # bare `await task` / `await fut`: unbounded
+    name = dotted(value.func)
+    if name is None:
+        return False
+    if name in _BOUNDED_AWAITS:
+        return True
+    if name == "asyncio.wait":
+        return any(kw.arg == "timeout" for kw in value.keywords)
+    if name.endswith(".stage"):
+        return True  # obs/shutdown.ShutdownGuard budgeted stage
+    parts = name.split(".")
+    if (
+        len(parts) == 2
+        and parts[0] in ("self", "cls")
+        and parts[1] in _STOP_NAMES
+    ):
+        return True  # stop() -> self._halt(): the inner one is linted
+    return False
+
+
+@rule(
+    "ASY110",
+    "unbounded-await-in-stop",
+    "an unbounded await inside a stop()/_shutdown()/close() path of a "
+    "hot-plane module can wedge the whole teardown when the awaited "
+    "plane hangs; bound it (asyncio.wait_for / ShutdownGuard.stage) "
+    "or document the suppression",
+)
+def unbounded_await_in_stop(ctx: FileContext) -> List[Finding]:
+    path = ctx.path.replace("\\", "/")
+    prefixes = _HOT_PLANE_PREFIXES + (
+        "cometbft_tpu/node/",
+        "cometbft_tpu/chaos/",
+    )
+    if not any(p in path for p in prefixes):
+        return []
+    out: List[Finding] = []
+    for fn in _async_defs(ctx.tree):
+        if fn.name not in _STOP_NAMES:
+            continue
+        for node in walk_in_function(fn):
+            if not isinstance(node, ast.Await):
+                continue
+            if _stop_await_allowed(node):
+                continue
+            what = (
+                dotted(node.value.func)
+                if isinstance(node.value, ast.Call)
+                else None
+            )
+            out.append(
+                Finding(
+                    ctx.path, node.lineno, node.col_offset,
+                    "ASY110", "unbounded-await-in-stop",
+                    f"unbounded `await {what or '<expr>'}` in shutdown "
+                    f"path `async def {fn.name}`: if the awaited plane "
+                    "hangs, teardown wedges with the loop alive and "
+                    "store fds open — wrap in asyncio.wait_for (or a "
+                    "ShutdownGuard.stage with a budget), or suppress "
+                    "with a comment documenting why it cannot hang",
+                )
+            )
+    return out
+
+
 @rule(
     "ASY106",
     "nested-event-loop",

@@ -130,7 +130,12 @@ class BlockSyncNetReactor(Reactor):
         if self._status_task:
             self._status_task.cancel()
         if self._started_pool:
-            await self.inner.stop()
+            # bounded (ASY110): the pool routine can be parked in an
+            # executor verify wait — don't let it wedge teardown
+            try:
+                await asyncio.wait_for(self.inner.stop(), 10.0)
+            except asyncio.TimeoutError:
+                pass
 
     async def _status_routine(self) -> None:
         try:

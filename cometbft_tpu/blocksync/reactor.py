@@ -135,7 +135,11 @@ class BlockSyncReactor:
         if self._task:
             self._task.cancel()
             try:
-                await self._task
+                # bounded (ASY110): the pool routine may be awaiting
+                # an executor-parked verify — abandon it past budget
+                await asyncio.wait_for(self._task, 10.0)
+            except asyncio.TimeoutError:
+                pass
             except asyncio.CancelledError:
                 if not self._task.cancelled():
                     raise  # outer cancel of stop() itself: propagate

@@ -8,18 +8,28 @@ Quick start (see docs/CHAOS.md for the full story)::
                                 base_dir=tmpdir)
     assert report.ok, report.format()
 
-CLI: ``python -m cometbft_tpu.chaos --seed 1337`` (tools/chaos_smoke.sh).
+CLI: ``python -m cometbft_tpu.chaos --seed 1337`` (tools/chaos_smoke.sh);
+scenario factory: ``python -m cometbft_tpu.chaos matrix --seed 1337
+--count 5`` (docs/CHAOS.md "Scenario factory").
 """
 
+from .generator import (
+    LIFECYCLES,
+    ScenarioSpec,
+    generate_matrix,
+    generate_scenario,
+)
 from .invariants import (
     AgreementChecker,
     InvariantViolation,
     WALReplayChecker,
 )
 from .links import ChaosConnection, LinkState, LinkTable
+from .matrix import MatrixReport, run_matrix, run_scenario
 from .nemesis import Nemesis
 from .net import ChaosNet, ChaosReport, run_schedule
 from .schedule import FaultEvent, FaultSchedule, default_schedule
+from .workload import WorkloadDriver, WorkloadSpec
 
 __all__ = [
     "AgreementChecker",
@@ -29,10 +39,19 @@ __all__ = [
     "FaultEvent",
     "FaultSchedule",
     "InvariantViolation",
+    "LIFECYCLES",
     "LinkState",
     "LinkTable",
+    "MatrixReport",
     "Nemesis",
+    "ScenarioSpec",
     "WALReplayChecker",
+    "WorkloadDriver",
+    "WorkloadSpec",
     "default_schedule",
+    "generate_matrix",
+    "generate_scenario",
+    "run_matrix",
+    "run_scenario",
     "run_schedule",
 ]

@@ -1,12 +1,16 @@
-"""CLI: one seeded chaos run.
+"""CLI: one seeded chaos run, or the scenario-factory matrix.
 
     python -m cometbft_tpu.chaos --seed 1337 [--nodes 4]
         [--schedule sched.json] [--byzantine N] [--json out.json]
+    python -m cometbft_tpu.chaos matrix --seed 1337 --count 5
 
 Exit code 0 when every invariant holds, 1 on any violation (the
-report — seed, fault trace, per-link decisions — prints either way).
-With --byzantine the run is EXPECTED to be flagged: exit codes invert
-so CI can assert the checker actually fires.
+report — seed, fault trace, per-link decisions — prints either way),
+2 on a span-budget breach only. With --byzantine the run is EXPECTED
+to be flagged: exit codes invert so CI can assert the checker
+actually fires. The ``matrix`` subcommand generates + runs seeded
+workload x network x lifecycle scenarios (chaos/generator.py,
+docs/CHAOS.md "Scenario factory").
 """
 
 from __future__ import annotations
@@ -22,6 +26,11 @@ from .schedule import FaultSchedule, default_schedule
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "matrix":
+        from .matrix import matrix_main
+
+        return matrix_main(argv[1:])
     ap = argparse.ArgumentParser(prog="python -m cometbft_tpu.chaos")
     ap.add_argument("--seed", type=int, default=1337)
     ap.add_argument("--nodes", type=int, default=4)
@@ -103,6 +112,9 @@ def main(argv=None) -> int:
                     "stall_records": report.stall_records,
                     "budget_verdicts": report.budget_verdicts,
                     "profile_file": report.profile_file,
+                    "workload": report.workload,
+                    "shutdown_stalls": report.shutdown_stalls,
+                    "proposers": report.proposers,
                 },
                 f,
                 indent=2,

@@ -15,6 +15,7 @@ invariant violation.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import time
 from typing import List
 
@@ -114,6 +115,72 @@ class Nemesis:
             # shares it, so every node's watchdog sees the stall
             chaos_stall(ev.duration_s)
             return {"duration_s": ev.duration_s}
+        if ev.action == "crash_wave":
+            crashed = []
+            for n in ev.nodes:
+                await net.crash(n)
+                crashed.append(net.nodes[n].name)
+                if ev.stagger_s > 0 and n != ev.nodes[-1]:
+                    await asyncio.sleep(ev.stagger_s)
+            restarted = []
+            if ev.restart_after_s is not None:
+                await asyncio.sleep(ev.restart_after_s)
+                for n in ev.nodes:
+                    if ev.blocksync:
+                        # adaptive-sync catchup under traffic: the
+                        # rebuilt node blocksyncs the gap while its
+                        # consensus state machine already runs
+                        net.nodes[n].build_overrides.update(
+                            {
+                                "blocksync.enable": True,
+                                "blocksync.adaptive_sync": True,
+                            }
+                        )
+                    try:
+                        await net.restart(n)
+                    finally:
+                        if ev.blocksync:
+                            # scoped to THIS wave's restart: a later
+                            # plain crash/restart of the same node in
+                            # the schedule must not silently inherit
+                            # the blocksync path
+                            for k in (
+                                "blocksync.enable",
+                                "blocksync.adaptive_sync",
+                            ):
+                                net.nodes[n].build_overrides.pop(
+                                    k, None
+                                )
+                    restarted.append(net.nodes[n].name)
+                    if ev.stagger_s > 0 and n != ev.nodes[-1]:
+                        await asyncio.sleep(ev.stagger_s)
+            return {
+                "crashed": crashed,
+                "restarted": restarted,
+                "blocksync": ev.blocksync,
+            }
+        if ev.action == "statesync_join":
+            name = await net.statesync_join(via=ev.via)
+            return {"joined": name}
+        if ev.action == "valset_churn":
+            # the new power comes from the MASTER rng unless pinned:
+            # schedule execution is sequential, so the draw is
+            # deterministic per (seed, schedule)
+            power = ev.power
+            if power is None:
+                power = net.table.rng.randint(
+                    ev.power_min, ev.power_max
+                )
+            return net.valset_churn(ev.node, power)
+        if ev.action == "wal_torn_tail":
+            # torn bytes from the MASTER rng, same determinism rule
+            n = ev.garbage or 37
+            garbage = bytes(
+                net.table.rng.getrandbits(8) for _ in range(n)
+            )
+            rec = await net.wal_torn_tail(ev.node, garbage)
+            rec["garbage_sha8"] = hashlib.sha256(garbage).hexdigest()[:8]
+            return rec
         if ev.action == "byzantine":
             # tamper bytes come from the MASTER rng: schedule execution
             # is sequential, so the draw is deterministic per run

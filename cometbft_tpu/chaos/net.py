@@ -60,6 +60,12 @@ class ChaosNode:
     # likewise one loop watchdog per incarnation: its flight records
     # (loop-stall snapshots) outlive the crash for the report
     watchdogs: List[object] = field(default_factory=list)
+    # bounded-shutdown breaches (obs/shutdown.py flight records)
+    # across every incarnation's stop/kill
+    shutdown_stalls: List[dict] = field(default_factory=list)
+    # per-node Config mutations applied on the NEXT build (restart
+    # variants: adaptive-sync catchup re-enables blocksync)
+    build_overrides: Dict[str, object] = field(default_factory=dict)
 
     @property
     def node_id(self) -> str:
@@ -84,6 +90,14 @@ class ChaosReport:
     stall_records: List[dict] = field(default_factory=list)
     budget_verdicts: List[dict] = field(default_factory=list)
     profile_file: str = ""
+    # scenario-factory planes (docs/CHAOS.md "Scenario factory")
+    workload: Dict[str, object] = field(default_factory=dict)
+    shutdown_stalls: List[dict] = field(default_factory=list)
+    # structural fingerprint: proposer address (hex, short) per
+    # committed height on the most advanced node — the same-seed
+    # determinism surface (heights/proposers reproduce; wall-clock
+    # latencies do not)
+    proposers: Dict[int, str] = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -113,6 +127,18 @@ class ChaosReport:
                 lines.append(f"  {link}: {counts}")
         for v in self.violations:
             lines.append(f"VIOLATION: {v}")
+        if self.workload:
+            lines.append(f"workload: {self.workload}")
+        if self.shutdown_stalls:
+            lines.append(
+                "bounded-shutdown breaches flight-recorded: "
+                f"{len(self.shutdown_stalls)}"
+            )
+            for r in self.shutdown_stalls[:8]:
+                lines.append(
+                    f"  {r.get('node')}: stage {r.get('stage')} "
+                    f"exceeded {r.get('waited_s')}s"
+                )
         if self.stall_records:
             lines.append(
                 f"loop stalls flight-recorded: {len(self.stall_records)}"
@@ -156,6 +182,7 @@ class ChaosNet:
         base_dir: str,
         table: Optional[LinkTable] = None,
         config_hook=None,
+        enable_rpc: bool = False,
     ):
         self.seed = seed
         self.base_dir = base_dir
@@ -163,6 +190,10 @@ class ChaosNet:
         # runs can pin feature knobs (e.g. mempool.async_recheck)
         # without forking the harness
         self.config_hook = config_hook
+        # statesync_join needs real RPC listeners (the light-client
+        # state provider bootstraps over HTTP); everything else keeps
+        # them off — invariants read stores directly
+        self.enable_rpc = enable_rpc
         self.table = table or LinkTable(seed)
         self.genesis, pvs = make_genesis(
             n_nodes, chain_id=f"chaos-{seed}"
@@ -178,6 +209,7 @@ class ChaosNet:
         self.wal_checker = WALReplayChecker()
         self._snapshots: Dict[int, Dict[int, bytes]] = {}
         self._byz_tasks: List[asyncio.Future] = []
+        self.stop_guard = None
 
     # --- node lifecycle -----------------------------------------------
 
@@ -185,11 +217,15 @@ class ChaosNet:
         cfg = test_config(cn.home)
         cfg.base.moniker = cn.name
         cfg.base.db_backend = "sqlite"  # restart needs persistence
-        cfg.rpc.laddr = ""  # invariants read stores directly
+        if not self.enable_rpc:
+            cfg.rpc.laddr = ""  # invariants read stores directly
         cfg.blocksync.enable = False
         cfg.p2p.pex = False
         if self.config_hook is not None:
             self.config_hook(cfg)
+        for dotted, value in cn.build_overrides.items():
+            section, field_ = dotted.split(".", 1)
+            setattr(getattr(cfg, section), field_, value)
         info = NodeInfo(
             node_id=cn.node_id,
             network=self.genesis.chain_id,
@@ -246,7 +282,21 @@ class ChaosNet:
             return
         self._snapshots[idx] = self.wal_checker.pre_crash(cn.node)
         _log.info("chaos: crashing node", node=cn.name, height=cn.node.height)
-        await cn.node.kill()
+        try:
+            # bounded (ASY110): kill() is internally stage-budgeted
+            # (obs/shutdown.py) — this outer bound covers the case
+            # where the loop never even schedules those stages
+            await asyncio.wait_for(
+                cn.node.kill(),
+                cn.node.config.instrumentation.shutdown_stage_budget_s
+                * 9,
+            )
+        except asyncio.TimeoutError:
+            _log.error("chaos: node kill wedged, abandoning",
+                       node=cn.name)
+        inner = getattr(cn.node, "shutdown_guard", None)
+        if inner is not None:
+            cn.shutdown_stalls.extend(inner.stalls)
         cn.node = None
 
     async def restart(self, idx: int) -> None:
@@ -268,13 +318,230 @@ class ChaosNet:
             if other.idx != idx and other.running:
                 await self._dial(cn, other)
 
+    async def statesync_join(
+        self,
+        via: Optional[List[int]] = None,
+        timeout_s: float = 90.0,
+    ) -> str:
+        """A FRESH non-validator node joins the running net through
+        the full statesync path: p2p snapshot discovery, light-client
+        verified restore against two running nodes' RPC, blocksync
+        tail-follow. Requires ``enable_rpc=True`` at net build.
+
+        Blocks (bounded) until the joiner's store holds its first
+        blocksynced block — i.e. the snapshot restore + handoff
+        really landed; the tail-follow continues in the background
+        and the end-of-run liveness check holds the joiner to the
+        same bar as everyone else. Raises InvariantViolation when the
+        join fails or times out: a node that cannot join a healthy
+        net IS a robustness failure."""
+        if via:
+            sources = [
+                self.nodes[i] for i in via if self.nodes[i].running
+            ]
+        else:
+            sources = [cn for cn in self.nodes if cn.running]
+        sources = [
+            cn for cn in sources
+            if cn.node is not None and cn.node.rpc_server is not None
+        ]
+        if not sources:
+            raise InvariantViolation(
+                "statesync-join",
+                "no running RPC sources (build ChaosNet with "
+                "enable_rpc=True and keep a source alive)",
+            )
+        trust = sources[0].node.parts.block_store.load_block(1)
+        if trust is None:
+            raise InvariantViolation(
+                "statesync-join", "source has no block 1 for the "
+                "trust root"
+            )
+        idx = len(self.nodes)
+        name = f"j{idx}"
+        home = os.path.join(self.base_dir, name)
+        os.makedirs(home, exist_ok=True)
+        cn = ChaosNode(idx, name, NodeKey.generate(), None, home)
+        cn.build_overrides = {
+            "statesync.enable": True,
+            "statesync.rpc_servers": [
+                s.node.rpc_server.listen_addr for s in sources[:2]
+            ],
+            "statesync.trust_height": 1,
+            "statesync.trust_hash": bytes(trust.hash()).hex(),
+            "statesync.discovery_time_s": 15.0,
+            "blocksync.enable": True,
+        }
+        self.nodes.append(cn)
+        cn.node = self._build(cn)
+        self._track(cn)
+        await cn.node.start()
+        for other in self.nodes:
+            if other.idx != idx and other.running:
+                await self._dial(cn, other)
+        _log.info("chaos: statesync join started", node=name)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+        while True:
+            node = cn.node
+            if node is None or node.statesync_error is not None:
+                err = (
+                    repr(node.statesync_error) if node else "stopped"
+                )
+                cn.node = None  # a dead joiner must drop out of the
+                # running set or end-of-run store scans hit closed fds
+                raise InvariantViolation(
+                    "statesync-join", f"{name} failed to join: {err}"
+                )
+            if node.height >= 1:
+                # snapshot restored + first tail block stored; the
+                # follow continues in the background
+                _log.info(
+                    "chaos: statesync join bootstrapped",
+                    node=name,
+                    height=node.height,
+                    base=node.parts.block_store.base(),
+                )
+                return name
+            if loop.time() > deadline:
+                try:
+                    # bounded like crash(): a wedged joiner kill must
+                    # not hang the run that is reporting its failure
+                    await asyncio.wait_for(
+                        node.kill(),
+                        node.config.instrumentation
+                        .shutdown_stage_budget_s * 9,
+                    )
+                except asyncio.TimeoutError:
+                    _log.error(
+                        "chaos: joiner kill wedged, abandoning",
+                        node=name,
+                    )
+                cn.node = None
+                raise InvariantViolation(
+                    "statesync-join",
+                    f"{name} did not bootstrap within {timeout_s:.0f}s",
+                )
+            await asyncio.sleep(POLL_S)
+
+    async def wal_torn_tail(self, idx: int, garbage: bytes) -> dict:
+        """Power-cut the node (if running), append a torn tail — the
+        partial record a real power cut leaves — to its consensus WAL
+        head, then restart. The restart path must repair the tail
+        (consensus/wal.py truncate_corrupt_tail on open) and the
+        WAL-replay checker holds it to the no-amnesia bar; without
+        the repair, records APPENDED after the garbage would be
+        unreadable on the following restart."""
+        cn = self.nodes[idx]
+        was_running = cn.node is not None
+        if was_running:
+            await self.crash(idx)
+        wal_path = os.path.join(cn.home, "cs.wal")
+        appended = 0
+        if os.path.exists(wal_path):
+            with open(wal_path, "ab") as f:
+                f.write(garbage)
+            appended = len(garbage)
+            _log.info(
+                "chaos: tore WAL tail", node=cn.name, bytes=appended
+            )
+        await self.restart(idx)
+        return {
+            "node": cn.name,
+            "torn_bytes": appended,
+            "was_running": was_running,
+        }
+
+    def valset_churn(self, idx: int, power: int) -> dict:
+        """Submit a validator power-change tx (kvstore
+        ``val:<hex pubkey>!<power>``) for validator ``idx``'s key
+        through the first running node's mempool — live valset churn
+        without adding absent signers (the target keeps signing with
+        the same key at its new power)."""
+        target = self.nodes[idx]
+        if target.privval is None:
+            raise ValueError(f"{target.name} is not a validator")
+        pub = target.privval.pub_key()
+        tx = (
+            b"val:" + pub.key_bytes.hex().encode()
+            + b"!" + str(power).encode()
+        )
+        for cn in self.nodes:
+            if cn.running:
+                res = cn.node.parts.mempool.check_tx(tx)
+                code = getattr(res, "code", 0)
+                _log.info(
+                    "chaos: valset churn submitted",
+                    validator=target.name,
+                    power=power,
+                    via=cn.name,
+                    code=code,
+                )
+                return {
+                    "validator": target.name,
+                    "power": power,
+                    "via": cn.name,
+                    "code": code,
+                }
+        raise InvariantViolation(
+            "valset-churn", "no running node to submit through"
+        )
+
     async def stop(self) -> None:
+        """Bounded teardown (obs/shutdown.py): each node's stop runs
+        under a budget sized to its staged shutdown; a node that
+        wedges anyway is flight-recorded, cancelled, abandoned — and
+        its store handles are force-released so the loop exits and a
+        later incarnation can still reopen the home dir. This is the
+        fix for the full-suite wedge class: an un-timeouted
+        ``await net.stop()`` tail could previously hang the suite
+        with the loop alive and store fds open."""
+        from ..obs import ShutdownGuard
+
         for t in self._byz_tasks:
             t.cancel()
+        guard = ShutdownGuard(
+            tracer=global_tracer(), name="chaosnet"
+        )
+        self.stop_guard = guard
         for cn in self.nodes:
-            if cn.node is not None:
-                await cn.node.stop()
-                cn.node = None
+            node, cn.node = cn.node, None
+            if node is None:
+                continue
+            # Node._shutdown is itself staged (~7 stages); this outer
+            # budget only trips when the staged path is wedged at a
+            # level its own guard cannot see (e.g. the loop never
+            # schedules the stage task)
+            per_stage = (
+                node.config.instrumentation.shutdown_stage_budget_s
+            )
+            done = await guard.stage(
+                f"stop.{cn.name}", node.stop(),
+                budget_s=max(10.0, per_stage * 9),
+            )
+            inner = getattr(node, "shutdown_guard", None)
+            if inner is not None:
+                cn.shutdown_stalls.extend(inner.stalls)
+            if not done:
+                # abandoned: free the store fds regardless, bounded
+                await guard.stage(
+                    f"close_stores.{cn.name}",
+                    asyncio.to_thread(node.parts.close_stores),
+                    budget_s=5.0,
+                )
+        for cn in self.nodes:
+            cn.shutdown_stalls.extend(
+                r for r in guard.stalls
+                if str(r.get("stage", "")).endswith("." + cn.name)
+            )
+
+    def shutdown_stall_records(self) -> List[dict]:
+        """Every bounded-shutdown breach captured across the run
+        (per-node inner stage stalls + net-level outer stalls)."""
+        out: List[dict] = []
+        for cn in self.nodes:
+            out.extend(dict(r) for r in cn.shutdown_stalls)
+        return out
 
     # --- byzantine commit corruption ----------------------------------
 
@@ -422,6 +689,8 @@ async def run_schedule(
     config_hook=None,
     budget_file: Optional[str] = None,
     profile_hz: float = 19.0,
+    workload=None,
+    enable_rpc: Optional[bool] = None,
 ) -> ChaosReport:
     """Execute one seeded chaos run end-to-end and return its report
     (violations recorded, not raised — callers assert on report.ok).
@@ -439,11 +708,27 @@ async def run_schedule(
     at end of run; a breach dumps traces exactly like an invariant
     violation (report.budget_ok goes False, the CLI exits nonzero)."""
     table = LinkTable(seed, fuzz_config=fuzz_config)
+    if enable_rpc is None:
+        # the statesync joiner bootstraps over the sources' RPC —
+        # switch the listeners on exactly when the schedule needs them
+        enable_rpc = any(
+            e.action == "statesync_join" for e in schedule.events
+        )
     net = ChaosNet(
-        n_nodes, seed, base_dir, table=table, config_hook=config_hook
+        n_nodes,
+        seed,
+        base_dir,
+        table=table,
+        config_hook=config_hook,
+        enable_rpc=enable_rpc,
     )
     report = ChaosReport(seed=seed, schedule_json=schedule.to_json())
     nemesis = Nemesis(net, schedule)
+    driver = None
+    if workload is not None and workload.pattern != "none":
+        from .workload import WorkloadDriver
+
+        driver = WorkloadDriver(workload, seed)
     profiler = None
     if profile_hz and profile_hz > 0:
         from ..obs import SamplingProfiler
@@ -470,6 +755,8 @@ async def run_schedule(
 
     try:
         await net.start()
+        if driver is not None:
+            driver.start(net)
         poller = asyncio.create_task(agreement_poll())
         try:
             # schedule execution itself can surface violations (a
@@ -526,10 +813,41 @@ async def run_schedule(
                 report.violations.append(str(v))
     finally:
         report.final_heights = net.heights()
+        try:
+            # keys are regenerated per run, so the stable identity is
+            # the NODE NAME (n0..nN follow sorted validator order,
+            # node/inprocess.make_genesis) — that is what same-seed
+            # runs must reproduce per height
+            addr_to_name = {
+                bytes(
+                    cn.privval.pub_key().address()
+                ).hex(): cn.name
+                for cn in net.nodes
+                if cn.privval is not None
+            }
+            running = net.running_nodes()
+            if running:
+                _, top = max(running, key=lambda t: t[1].height)
+                store = top.parts.block_store
+                for h in range(max(1, store.base()), top.height + 1):
+                    meta = store.load_block_meta(h)
+                    if meta is not None:
+                        addr = bytes(
+                            meta.header.proposer_address
+                        ).hex()
+                        report.proposers[h] = addr_to_name.get(
+                            addr, addr[:12]
+                        )
+        except Exception:
+            pass  # fingerprint is best-effort diagnostics
+        if driver is not None:
+            await driver.stop()
+            report.workload = driver.stats()
         await net.stop()
         if profiler is not None:
             profiler.stop()
         report.stall_records = net.stall_records()
+        report.shutdown_stalls = net.shutdown_stall_records()
         if budget_file:
             # evaluated over the in-memory rings so a breach can force
             # the dump below even when no invariant tripped

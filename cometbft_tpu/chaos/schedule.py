@@ -40,6 +40,37 @@ deterministic and fast):
                       checker that cannot flag an injected fork proves
                       nothing (the same discipline Jepsen applies to
                       its checkers).
+``crash_wave``        ``nodes=[i,...]``: power-cut the listed nodes in
+                      order, ``stagger_s`` apart; after
+                      ``restart_after_s`` restart them in the same
+                      order (same stagger). ``blocksync=True`` rebuilds
+                      the wave's nodes with blocksync + adaptive sync
+                      enabled, so recovery exercises adaptive-sync
+                      catchup under traffic instead of consensus
+                      catch-up gossip.
+``statesync_join``    a FRESH non-validator node joins mid-run by
+                      statesync: snapshot discovery over p2p,
+                      light-verified restore against the RPC of two
+                      running nodes (``via=[i,j]``; defaults to the
+                      first two running), then blocksync follows the
+                      tail. Requires the net to run with RPC enabled
+                      (run_schedule switches it on automatically when
+                      the schedule contains this action) and a source
+                      app snapshot (kvstore snapshots every 10
+                      heights — trigger at height >= 11).
+``valset_churn``      churn the validator set under load: submit a
+                      power-change tx for validator ``node``'s key
+                      (new power drawn from the MASTER rng in
+                      [power_min, power_max], or pass ``power``
+                      explicitly). Changes the valset hash + proposer
+                      rotation live without adding absent signers.
+``wal_torn_tail``     ``node=i``: power-cut the node (if running),
+                      append seeded garbage (``garbage`` bytes, drawn
+                      from the MASTER rng) to its consensus WAL head —
+                      the torn partial record a real power cut leaves —
+                      then restart it. Recovery must repair the tail
+                      (consensus/wal.py truncate_corrupt_tail) and
+                      extend the committed prefix unchanged.
 ====================  =================================================
 
 Schedules round-trip through JSON so failing runs can be archived and
@@ -48,13 +79,15 @@ replayed byte-for-byte alongside their seed.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional
 
 ACTIONS = (
     "partition", "heal", "set_link", "crash", "restart", "byzantine",
-    "stall",
+    "stall", "crash_wave", "statesync_join", "valset_churn",
+    "wal_torn_tail",
 )
 
 
@@ -70,6 +103,15 @@ class FaultEvent:
     link: Optional[Dict[str, float]] = None  # set_link LinkState fields
     symmetric: bool = True  # set_link: apply both directions
     duration_s: Optional[float] = None  # stall: loop-block length
+    nodes: Optional[List[int]] = None  # crash_wave members, in order
+    stagger_s: float = 0.2  # crash_wave: gap between wave members
+    restart_after_s: Optional[float] = 1.0  # crash_wave: None = stay down
+    blocksync: bool = False  # crash_wave restart: adaptive-sync catchup
+    via: Optional[List[int]] = None  # statesync_join: RPC source nodes
+    power: Optional[int] = None  # valset_churn: explicit new power
+    power_min: int = 5  # valset_churn: seeded draw range
+    power_max: int = 15
+    garbage: Optional[int] = None  # wal_torn_tail: torn bytes (seeded)
 
     def __post_init__(self):
         if self.action not in ACTIONS:
@@ -80,9 +122,10 @@ class FaultEvent:
             )
         if self.action == "partition" and not self.groups:
             raise ValueError("partition: groups required")
-        if self.action in ("crash", "restart", "byzantine") and (
-            self.node is None
-        ):
+        if self.action in (
+            "crash", "restart", "byzantine", "valset_churn",
+            "wal_torn_tail",
+        ) and self.node is None:
             raise ValueError(f"{self.action}: node required")
         if self.action == "set_link" and (
             self.src is None or self.dst is None or not self.link
@@ -92,6 +135,12 @@ class FaultEvent:
             self.duration_s and self.duration_s > 0
         ):
             raise ValueError("stall: duration_s > 0 required")
+        if self.action == "crash_wave" and not self.nodes:
+            raise ValueError("crash_wave: nodes required")
+        if self.action == "valset_churn" and not (
+            0 < self.power_min <= self.power_max
+        ):
+            raise ValueError("valset_churn: 0 < power_min <= power_max")
 
 
 @dataclass
@@ -99,9 +148,23 @@ class FaultSchedule:
     events: List[FaultEvent] = field(default_factory=list)
 
     def to_json(self) -> str:
+        """Minimal lossless form: fields still at their dataclass
+        default are dropped (from_json restores the same defaults),
+        so an event's JSON carries exactly what was set — generated
+        matrices stay readable. An EXPLICIT None over a non-None
+        default (crash_wave restart_after_s=None = "stay down") is
+        kept as JSON null: dropping it would replay with the default
+        and silently change semantics."""
+        defaults = {
+            f.name: f.default for f in dataclasses.fields(FaultEvent)
+        }
         return json.dumps(
             [
-                {k: v for k, v in asdict(e).items() if v is not None}
+                {
+                    k: v
+                    for k, v in asdict(e).items()
+                    if k == "action" or v != defaults.get(k)
+                }
                 for e in self.events
             ],
             indent=2,

@@ -199,6 +199,14 @@ class InstrumentationConfig:
     loop_lag_interval_ms: float = 100.0
     # loop blocked longer than this => flight record (0 < stall)
     loop_stall_ms: float = 500.0
+    # bounded shutdown (obs/shutdown.py, docs/OBS.md): per-stage
+    # budget for Node._shutdown — a stage (reactor stops, peer
+    # drain, consensus halt, store release) that overruns is
+    # flight-recorded into the trace ring, cancelled, and if it
+    # ignores the cancel, abandoned so the remaining stages (store
+    # fd release above all) still run. Turns the stop-path wedge
+    # class into a diagnosed bounded failure.
+    shutdown_stage_budget_s: float = 5.0
 
 
 @dataclass

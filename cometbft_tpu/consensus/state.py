@@ -144,6 +144,11 @@ class ConsensusState:
         self.queue = InstrumentedQueue(10000, name="consensus.inbox")
         self.event_bus.set_loop(asyncio.get_running_loop())
         if self._wal_path:
+            # a power cut may have left a torn partial record at the
+            # head's end; repair BEFORE reopening for append, or every
+            # record written this incarnation lands after the garbage
+            # and is lost on the next restart (wal.repair_torn_tail)
+            walmod.WAL.repair_torn_tail(self._wal_path)
             self.wal = walmod.WAL(self._wal_path, tracer=self.tracer)
             self._catchup_replay()
         self._routine_task = asyncio.create_task(self._receive_routine())
@@ -166,7 +171,15 @@ class ConsensusState:
         if self._routine_task:
             self._routine_task.cancel()
             try:
-                await self._routine_task
+                # bounded (ASY110): a receive routine wedged in a
+                # swallowed cancel must not hang the halt — the WAL
+                # close below seals the durable state either way
+                await asyncio.wait_for(self._routine_task, 10.0)
+            except asyncio.TimeoutError:
+                _log.error(
+                    "receive routine ignored cancel past budget, "
+                    "abandoning", height=self.rs.height,
+                )
             except asyncio.CancelledError:
                 if not self._routine_task.cancelled():
                     raise  # outer cancel of stop()/crash(): propagate

@@ -303,6 +303,41 @@ class WAL:
                 found = True
 
     @classmethod
+    def repair_torn_tail(cls, path: str) -> int:
+        """Truncate a torn tail off the HEAD file in place; returns
+        the bytes removed (0 when the head is clean or absent).
+
+        A power cut can leave a partial record at the head's end (a
+        real torn write, or the chaos harness's ``wal_torn_tail``
+        injection). Replay tolerates it — iteration stops at the
+        first bad record — but the WAL reopens in append mode, so
+        WITHOUT this repair every record written after the garbage
+        would be unreadable on the NEXT restart: silent amnesia one
+        crash later. The valid prefix is already in place, so this
+        is one ``truncate`` + fsync, not a rewrite (rotated files
+        are sealed behind an fsync barrier and cannot tear; cross-
+        file corruption repair stays with truncate_corrupt_tail)."""
+        if not os.path.exists(path):
+            return 0
+        stats: dict = {}
+        for _ in cls._iter_file(path, stats):
+            pass
+        torn = stats.get("size", 0) - stats.get("valid_bytes", 0)
+        if torn <= 0:
+            return 0
+        with open(path, "r+b") as f:
+            f.truncate(stats["valid_bytes"])
+            f.flush()
+            os.fsync(f.fileno())
+        _log.info(
+            "repaired torn WAL tail",
+            path=path,
+            removed_bytes=torn,
+            kept_bytes=stats["valid_bytes"],
+        )
+        return torn
+
+    @classmethod
     def truncate_corrupt_tail(cls, path: str) -> int:
         """Repair: keep only the valid record prefix of the group.
 

@@ -13,6 +13,7 @@ connection, not below it.
 
 from __future__ import annotations
 
+import asyncio
 from typing import Callable, Optional
 
 
@@ -136,4 +137,8 @@ class Host:
         self.rcmgr.release_conn()
 
     async def close(self) -> None:
-        await self.transport.close()
+        # bounded (ASY110): a wedged transport must not hang host close
+        try:
+            await asyncio.wait_for(self.transport.close(), 5.0)
+        except asyncio.TimeoutError:
+            pass

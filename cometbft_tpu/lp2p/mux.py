@@ -72,7 +72,11 @@ class MuxStream:
         if not self.closed:
             self.closed = True
             try:
-                await self.mux._send_frame(self.stream_id, FIN, b"")
+                # bounded (ASY110): the FIN is a courtesy — a dead
+                # conn must not hang the stream close
+                await asyncio.wait_for(
+                    self.mux._send_frame(self.stream_id, FIN, b""), 2.0
+                )
             except Exception:
                 pass
             self.mux._drop_stream(self.stream_id)
@@ -152,8 +156,15 @@ class Muxer:
             t.cancel()
         for t in self._tasks:
             try:
-                await t
-            except (asyncio.CancelledError, Exception):
+                # bounded (ASY110): a routine swallowing its cancel
+                # must not wedge stop — the fd close below kills its
+                # I/O anyway
+                await asyncio.wait_for(t, 2.0)
+            except (
+                asyncio.TimeoutError,
+                asyncio.CancelledError,
+                Exception,
+            ):
                 pass
         self.sconn.close()
 

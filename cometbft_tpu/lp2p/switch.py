@@ -140,7 +140,28 @@ class Lp2pPeer:
             self._start_task.cancel()
         for t in self._reader_tasks:
             t.cancel()
-        await self.mux.stop()
+        try:
+            # bounded (ASY110): mux.stop is internally bounded; this
+            # keeps a hung conn from wedging the whole switch stop
+            await asyncio.wait_for(self.mux.stop(), 5.0)
+        except asyncio.TimeoutError:
+            pass
+
+    def abort(self) -> None:
+        """Synchronous last-resort close (never awaits): see
+        p2p MConnection.abort — an abandoned stop must still kill the
+        underlying fd or the remote keeps a zombie peer entry."""
+        self._stopped = True
+        if self._start_task:
+            self._start_task.cancel()
+        for t in self._reader_tasks:
+            t.cancel()
+        for t in self.mux._tasks:
+            t.cancel()
+        try:
+            self.mux.sconn.close()
+        except Exception:
+            pass
 
     def _mux_error(self, exc: Exception) -> None:
         if self._stopped:

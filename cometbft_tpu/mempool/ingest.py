@@ -72,8 +72,10 @@ class IngestQueue:
         if t is not None:
             t.cancel()
             try:
-                await t
-            except asyncio.CancelledError:
+                # bounded (ASY110): a drain batch stuck in the ABCI
+                # executor must not wedge the reactor stop
+                await asyncio.wait_for(t, 5.0)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
                 pass
         q, self._q = self._q, None
         if q is not None:

@@ -88,7 +88,12 @@ class MempoolReactor(Reactor):
         for t in self._tasks.values():
             t.cancel()
         self._tasks.clear()
-        await self.ingest.stop()
+        # bounded (ASY110): ingest.stop is internally bounded; belt
+        # over braces so a hung drain can't wedge the switch stop
+        try:
+            await asyncio.wait_for(self.ingest.stop(), 10.0)
+        except asyncio.TimeoutError:
+            pass
 
     async def _send_txs(self, peer, txs: List[bytes]) -> None:
         msg = codec.encode_txs(txs)

@@ -324,7 +324,12 @@ class LocalNet:
 
     async def stop(self):
         for n in self.nodes:
-            await n.cs.stop()
+            # bounded (ASY110): one wedged state machine must not
+            # hang the whole test net's teardown
+            try:
+                await asyncio.wait_for(n.cs.stop(), 15.0)
+            except asyncio.TimeoutError:
+                pass
 
     async def wait_for_height(self, height: int, timeout: float = 30.0):
         async def waiter():

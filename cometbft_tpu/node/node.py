@@ -191,6 +191,9 @@ class Node:
         self.metrics_server = None
         self.debug_server = None
         self.watchdog = None
+        # last _shutdown's ShutdownGuard (stalled-stage flight
+        # records for reports/tests); set when stop()/kill() runs
+        self.shutdown_guard = None
         # runtime health plane (cometbft_tpu/obs, docs/OBS.md): the
         # loop watchdog object is built here (started in start() — it
         # needs the running loop) so Environment.from_node and the
@@ -451,6 +454,20 @@ class Node:
         await self._shutdown(graceful=True)
 
     async def _shutdown(self, graceful: bool) -> None:
+        """Bounded, staged teardown (obs/shutdown.py, docs/OBS.md):
+        every await below runs under a per-stage budget with
+        stop→cancel→abandon escalation, so one wedged sub-plane can
+        never hang the whole stop path — the breach is flight-recorded
+        into the trace ring and the remaining stages (store-handle
+        release above all) still run."""
+        from ..obs import ShutdownGuard
+
+        guard = ShutdownGuard(
+            tracer=self.parts.tracer,
+            name=self.config.base.moniker or "node",
+            budget_s=self.config.instrumentation.shutdown_stage_budget_s,
+        )
+        self.shutdown_guard = guard
         if getattr(self, "watchdog", None) is not None:
             self.watchdog.stop()
         if getattr(self, "loop_watchdog", None) is not None:
@@ -461,23 +478,48 @@ class Node:
         # able to rebind, and dead stores must stop being served) —
         # the crash/graceful split is consensus' WAL handling only
         if self.metrics_server is not None:
-            await self.metrics_server.stop()
+            await guard.stage("metrics", self.metrics_server.stop())
         if self.debug_server is not None:
-            await self.debug_server.stop()
+            await guard.stage("debug", self.debug_server.stop())
         if self.grpc_server is not None:
             self.grpc_server.stop()
         if self.rpc_server is not None:
-            await self.rpc_server.stop()
+            await guard.stage("rpc", self.rpc_server.stop())
         if self._cs_started:
-            if graceful:
-                await self.parts.cs.stop()
-            else:
-                await self.parts.cs.crash()
-        await self.switch.stop()
+            await guard.stage(
+                "consensus",
+                self.parts.cs.stop() if graceful
+                else self.parts.cs.crash(),
+            )
+        # the switch stage gets 3x: it contains per-plane bounded
+        # stops of its own (reactor stops 5-10s each under the ASY110
+        # bounds) which must get a chance to run before escalation
+        ok = await guard.stage(
+            "switch", self.switch.stop(), budget_s=guard.budget_s * 3
+        )
+        if not ok and hasattr(self.switch, "abort"):
+            # escalation floor: an abandoned graceful stop must STILL
+            # kill every conn fd synchronously — a zombie conn makes
+            # remotes dup-discard this node's next incarnation's dials
+            # (it could never rejoin the net)
+            try:
+                self.switch.abort()
+            except Exception:
+                traceback.print_exc()
         # release store handles (psql sink flush+close; logdb flocks;
         # sqlite fds) — a restart in the same process must be able to
-        # reopen every database
-        await asyncio.to_thread(self.parts.close_stores)
+        # reopen every database. Last on purpose: it must run even
+        # when every stage above was abandoned.
+        await guard.stage(
+            "stores", asyncio.to_thread(self.parts.close_stores)
+        )
+        if not guard.clean:
+            _log.error(
+                "shutdown completed with stalled stages",
+                node=self.config.base.moniker,
+                stages=[r["stage"] for r in guard.stalls],
+                abandoned=guard.abandoned,
+            )
 
     # --- convenience --------------------------------------------------
 

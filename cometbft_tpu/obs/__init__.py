@@ -27,6 +27,7 @@ Four coordinated pieces:
 from .budget import evaluate_budgets, format_verdicts, load_budgets
 from .profiler import SamplingProfiler
 from .queues import InstrumentedQueue, QueueRegistry
+from .shutdown import ShutdownGuard
 from .watchdog import LoopWatchdog
 
 __all__ = [
@@ -34,6 +35,7 @@ __all__ = [
     "LoopWatchdog",
     "QueueRegistry",
     "SamplingProfiler",
+    "ShutdownGuard",
     "evaluate_budgets",
     "format_verdicts",
     "load_budgets",

@@ -136,13 +136,33 @@ class MConnection:
             t.cancel()
         for t in self._tasks:
             try:
-                await t
+                # bounded (ASY110): a routine that swallows its cancel
+                # must not wedge the teardown — the fd close below
+                # tears its I/O down regardless
+                await asyncio.wait_for(t, 2.0)
+            except asyncio.TimeoutError:
+                pass
             except asyncio.CancelledError:
                 if not t.cancelled():
                     raise  # outer cancel of stop() itself: propagate
             except Exception:
                 pass  # routine already reported via _die
         self.sconn.close()
+
+    def abort(self) -> None:
+        """Synchronous last-resort close (ShutdownGuard escalation,
+        obs/shutdown.py): cancel the routines and close the fd WITHOUT
+        awaiting anything. An abandoned graceful stop must still kill
+        the socket — a conn left open past shutdown is a zombie the
+        remote keeps treating as a live peer (it then dup-discards the
+        restarted node's fresh dials and the node can never rejoin)."""
+        self._closed = True
+        for t in self._tasks:
+            t.cancel()
+        try:
+            self.sconn.close()
+        except Exception:
+            pass
 
     def _die(self, exc: Exception) -> None:
         if self._closed:

@@ -55,7 +55,21 @@ class Peer:
         self.mconn.start()
 
     async def stop(self) -> None:
-        await self.mconn.stop()
+        # bounded (ASY110): mconn.stop is itself bounded, this is the
+        # belt over its braces — a hung peer must never hang the switch
+        try:
+            await asyncio.wait_for(self.mconn.stop(), 7.0)
+        except asyncio.TimeoutError:
+            # graceful close ran out of budget mid-drain: the fd MUST
+            # still die or the remote keeps a zombie peer entry that
+            # dup-discards this node's next incarnation (the rejoin
+            # wedge, obs/shutdown.py) — abort is sync and total
+            self.mconn.abort()
+
+    def abort(self) -> None:
+        """Synchronous last-resort close (never awaits): see
+        MConnection.abort."""
+        self.mconn.abort()
 
     # --- messaging ----------------------------------------------------
 

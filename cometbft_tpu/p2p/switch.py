@@ -104,23 +104,93 @@ class Switch:
         self._accept_task = asyncio.create_task(self._accept_routine())
 
     async def stop(self) -> None:
+        # every await is bounded (ASY110): one wedged reactor/peer/
+        # transport must not hang the node's whole stop chain — the
+        # outer Node._shutdown stage would catch it, but per-plane
+        # bounds keep the blast radius to the plane that hung
         self._stopped = True
         if self._autopool is not None:
-            await self._autopool.stop()
+            try:
+                await asyncio.wait_for(self._autopool.stop(), 5.0)
+            except asyncio.TimeoutError:
+                pass
         if self._accept_task:
             self._accept_task.cancel()
         for t in self._reconnect_tasks.values():
             t.cancel()
         for r in self.reactors.values():
             try:
-                await r.stop()
+                # 12s: strictly ABOVE the largest per-plane bound a
+                # reactor stop carries internally (mempool/blocksync
+                # budget their sub-planes at 10s) — an inner bound
+                # must stay reachable or its post-wait cleanup is
+                # silently skipped
+                await asyncio.wait_for(r.stop(), 12.0)
             except asyncio.CancelledError:
                 raise
+            except asyncio.TimeoutError:
+                _log.error(
+                    "reactor stop exceeded its budget, abandoning",
+                    reactor=type(r).__name__,
+                )
             except Exception:
                 traceback.print_exc()
         for p in list(self.peers.values()):
-            await self._remove_peer(p, None)
-        await self.transport.close()
+            try:
+                # 9s: strictly above Peer.stop's internal 7s bound
+                # (same reachability rule as the reactor bound above)
+                await asyncio.wait_for(self._remove_peer(p, None), 9.0)
+            except asyncio.TimeoutError:
+                # the fd must still die (zombie-conn rejoin wedge)
+                try:
+                    p.abort()
+                except Exception:
+                    pass
+        try:
+            await asyncio.wait_for(self.transport.close(), 5.0)
+        except asyncio.TimeoutError:
+            pass
+
+    def abort(self) -> None:
+        """Synchronous last-resort teardown (ShutdownGuard escalation):
+        when the graceful ``stop()`` stage was cancelled/abandoned past
+        its budget, every remaining connection must STILL die — a conn
+        left open past shutdown is a zombie its remote keeps treating
+        as a live peer, so it dup-discards the restarted node's fresh
+        dials and the node can never rejoin (the liveness wedge the
+        scenario matrix surfaced under full-suite contention). Never
+        awaits; reactors get their sync remove_peer so gossip tasks
+        are cancelled, not left erroring against dead fds."""
+        self._stopped = True
+        if self._accept_task:
+            self._accept_task.cancel()
+        for t in self._reconnect_tasks.values():
+            t.cancel()
+        self._reconnect_tasks.clear()
+        for p in list(self.peers.values()):
+            for r in self.reactors.values():
+                try:
+                    r.remove_peer(p, None)
+                except Exception:
+                    pass
+            try:
+                p.abort()
+            except Exception:
+                pass
+        self.peers.clear()
+        self.tracer.counter("p2p.peers", 0, tid="p2p")
+        spawn(
+            self._close_transport_best_effort(),
+            name="switch-abort-transport",
+        )
+
+    async def _close_transport_best_effort(self) -> None:
+        try:
+            await asyncio.wait_for(self.transport.close(), 5.0)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass
 
     # --- accept / dial ------------------------------------------------
 

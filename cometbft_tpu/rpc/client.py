@@ -41,7 +41,12 @@ class HTTPClient:
 
     async def close(self) -> None:
         if self._session and not self._session.closed:
-            await self._session.close()
+            # bounded (ASY110): aiohttp session close can park on
+            # connector teardown; never let it hang the caller's stop
+            try:
+                await asyncio.wait_for(self._session.close(), 5.0)
+            except asyncio.TimeoutError:
+                pass
 
     async def call(self, method: str, **params) -> Dict[str, Any]:
         sess = await self._sess()

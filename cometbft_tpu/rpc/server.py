@@ -108,7 +108,13 @@ class RPCServer:
 
     async def stop(self) -> None:
         if self._runner:
-            await self._runner.cleanup()
+            # bounded (ASY110): aiohttp cleanup waits on open
+            # websocket handlers — a stuck subscriber must not wedge
+            # node shutdown
+            try:
+                await asyncio.wait_for(self._runner.cleanup(), 5.0)
+            except asyncio.TimeoutError:
+                pass
 
     # --- dispatch -----------------------------------------------------
 

@@ -9,16 +9,26 @@ Info against the trusted app hash -> return the light-verified State
 from __future__ import annotations
 
 import asyncio
+import random
 import traceback
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..abci import types as abci
+from ..utils.backoff import Backoff
+from ..utils.log import get_logger
 from .chunks import ChunkQueue
+
+_log = get_logger("statesync")
 
 DISCOVERY_SLEEP_S = 0.3
 CHUNK_TIMEOUT_S = 10.0
 MAX_CHUNK_FETCHERS = 4
+# chunk-request retry backoff (utils/backoff.py full jitter): fast
+# first retry, capped well under the chunk timeout so a flaky peer
+# gets several tries before the whole snapshot attempt times out
+RETRY_BACKOFF_BASE_S = 0.05
+RETRY_BACKOFF_CAP_S = 2.0
 
 
 class SyncError(Exception):
@@ -74,6 +84,7 @@ class Syncer:
         request_chunk: Callable,  # async (peer_id, height, format, index) -> Optional[bytes]
         discovery_time_s: float = 5.0,
         chunk_timeout_s: float = CHUNK_TIMEOUT_S,
+        rng: Optional[random.Random] = None,
     ):
         self.proxy = proxy
         self.provider = state_provider
@@ -82,6 +93,12 @@ class Syncer:
         self.discovery_time_s = discovery_time_s
         self.chunk_timeout_s = chunk_timeout_s
         self.banned_snapshots: Set[bytes] = set()
+        # peers that served corrupt/unappliable chunks (the app said
+        # RETRY on their chunk, or named them in reject_senders):
+        # banned for the rest of THIS sync — mirrors the blocksync
+        # pool's peer bans, and like them survives reconnect churn
+        self.banned_peers: Set[str] = set()
+        self._rng = rng or random.Random()
 
     # --- entry --------------------------------------------------------
 
@@ -145,8 +162,21 @@ class Syncer:
                     index, chunk, sender
                 )
                 if r.result == abci.APPLY_CHUNK_ACCEPT:
+                    # marked BEFORE directives: a reject_senders ban
+                    # in the same response must not rewind the chunk
+                    # the app just accepted
+                    queue.mark_applied(index)
+                # app-directed punishment/refetch rides ANY verdict
+                # (reference syncer.go:363): a chunk can apply while
+                # the app still fingers earlier senders as corrupt
+                self._apply_directives(queue, r)
+                if r.result == abci.APPLY_CHUNK_ACCEPT:
                     continue
                 if r.result == abci.APPLY_CHUNK_RETRY:
+                    # the sender served a chunk the app could not
+                    # apply: ban it for this sync (all its queued
+                    # chunks are suspect too) and refetch elsewhere
+                    self._ban_sender(queue, sender, "chunk retry")
                     queue.discard(index)
                     continue
                 if r.result in (
@@ -175,19 +205,58 @@ class Syncer:
         )
         return state, commit
 
+    def _ban_sender(
+        self, queue: ChunkQueue, sender: str, why: str
+    ) -> None:
+        if not sender or sender in self.banned_peers:
+            return
+        self.banned_peers.add(sender)
+        dropped = queue.discard_sender(sender)
+        _log.info(
+            "statesync: banned peer serving corrupt chunks",
+            peer=sender[:12],
+            why=why,
+            chunks_discarded=len(dropped),
+        )
+
+    def _apply_directives(
+        self, queue: ChunkQueue, r: abci.ResponseApplySnapshotChunk
+    ) -> None:
+        """Honor the app's refetch_chunks / reject_senders fields."""
+        for sender in r.reject_senders or ():
+            self._ban_sender(queue, sender, "reject_senders")
+        for idx in r.refetch_chunks or ():
+            queue.discard(idx)
+
     async def _fetch_routine(
         self, queue: ChunkQueue, key: SnapshotKey, peers: List[str]
     ) -> None:
         i = 0
+        # full-jitter exponential backoff per fetcher: a flaky peer
+        # retries fast at first, and a thundering re-request herd
+        # after a shared failure spreads out (utils/backoff.py)
+        backoff = Backoff(
+            base_s=RETRY_BACKOFF_BASE_S,
+            cap_s=RETRY_BACKOFF_CAP_S,
+            rng=self._rng,
+        )
         try:
             while not queue.done():
+                alive = [
+                    p for p in peers if p not in self.banned_peers
+                ]
+                if not alive:
+                    # every peer of this snapshot is banned: nothing
+                    # can complete it — let the apply loop time out
+                    # and reject the snapshot
+                    return
                 wanted = sorted(queue.wanted() - set(queue.chunks))
                 if not wanted:
                     await asyncio.sleep(0.05)
                     continue
                 index = wanted[i % len(wanted)]
                 i += 1
-                peer = peers[index % len(peers)]
+                peer = alive[index % len(alive)]
                 try:
                     chunk = await asyncio.wait_for(
                         self.request_chunk(
@@ -195,10 +264,17 @@ class Syncer:
                         ),
                         self.chunk_timeout_s,
                     )
-                except (asyncio.TimeoutError, Exception):
-                    await asyncio.sleep(0.1)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    await asyncio.sleep(backoff.next_delay())
                     continue
                 if chunk is not None:
+                    backoff.reset()
                     queue.add(index, chunk, peer)
+                else:
+                    # peer answered "don't have it": back off before
+                    # asking the rotation again
+                    await asyncio.sleep(backoff.next_delay())
         except asyncio.CancelledError:
             raise

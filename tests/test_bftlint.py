@@ -36,6 +36,7 @@ def ids_of(src: str, path: str = "x.py"):
 FIXTURE_PATHS = {
     "ASY107": "cometbft_tpu/trace/x.py",
     "ASY109": "cometbft_tpu/mempool/x.py",
+    "ASY110": "cometbft_tpu/p2p/x.py",
 }
 
 
@@ -324,6 +325,33 @@ FIXTURES = [
             d = queue.Queue()      # sync stdlib queue: not this rule
             e = Queue()            # ambiguous bare spelling: not ours
             return a, b, c, d, e
+        """,
+    ),
+    (
+        "ASY110",  # unbounded-await-in-stop (FIXTURE_PATHS)
+        """
+        import asyncio
+        class Plane:
+            async def stop(self):
+                await self.inner.stop()
+            async def close(self):
+                await self.task
+        """,
+        """
+        import asyncio
+        class Plane:
+            async def stop(self):
+                await self._halt(True)          # covered delegation
+                await asyncio.sleep(0.1)
+            async def _halt(self, graceful):
+                try:
+                    await asyncio.wait_for(self.task, 5.0)
+                except asyncio.TimeoutError:
+                    pass
+                await guard.stage("x", self.inner.stop())
+                await asyncio.wait({self.task}, timeout=1.0)
+            async def run(self):
+                await self.inner.stop()         # not a stop path
         """,
     ),
     (

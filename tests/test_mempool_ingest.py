@@ -700,7 +700,15 @@ def test_chaos_run_with_async_recheck_stays_invariant_clean(tmp_path):
             )
             assert committed > 0, "no txs ever committed"
         finally:
-            await net.stop()
+            # the stop tail is bounded inside ChaosNet.stop (per-node
+            # ShutdownGuard stages, obs/shutdown.py) — this outer
+            # wait_for is the regression tripwire for the full-suite
+            # wedge this test used to hit (loop alive, store fds
+            # open) so a recurrence fails HERE instead of hanging CI
+            await asyncio.wait_for(net.stop(), 120.0)
+            assert not net.shutdown_stall_records(), (
+                net.shutdown_stall_records()
+            )
 
     asyncio.run(main())
     assert len(seen_cfgs) == 4 and all(

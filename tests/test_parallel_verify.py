@@ -202,7 +202,11 @@ def test_process_pool_tier_on_pure_python_fallback(monkeypatch):
     items = _random_items(8, n_keys=2)
     items[2] = (items[2][0], items[2][1], bytes(64))
     want = [pk.verify(m, s) for pk, m, s in items]
-    eng = ParallelVerifyEngine(min_parallel=1)
+    # workers pinned: tier SELECTION is under test, not cpu_count
+    # detection — on a 1-vCPU box auto-detected workers=1 correctly
+    # degrades to serial (covered by the test below), which would
+    # mask the thread-vs-process choice this test asserts
+    eng = ParallelVerifyEngine(min_parallel=1, workers=2)
     try:
         assert eng.tier == "process"
         got = eng.verify(items)

@@ -1,0 +1,254 @@
+"""Statesync chunk-fetch robustness: full-jitter retry backoff +
+bans for peers serving corrupt snapshot chunks (mirrors the
+blocksync pool's peer bans; reference statesync/syncer.go RETRY /
+reject_senders handling)."""
+
+import asyncio
+import random
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.statesync.chunks import ChunkQueue
+from cometbft_tpu.statesync.syncer import SnapshotKey, Syncer
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+CHUNKS = [b"chunk-%d" % i for i in range(4)]
+SNAP_HASH = b"\x11" * 32
+
+
+class _Provider:
+    def app_hash(self, height):
+        return b"\x22" * 32
+
+    def state(self, height):
+        return {"height": height}
+
+    def commit(self, height):
+        return {"commit": height}
+
+
+class _SnapshotConn:
+    """App snapshot surface: accepts the offer; flags chunks that do
+    not match the canonical payload as RETRY (corrupt), naming the
+    sender — exactly what a checksumming app does."""
+
+    def __init__(self):
+        self.retries = []
+        self.applied = []
+
+    def offer_snapshot(self, snap, app_hash):
+        return abci.ResponseOfferSnapshot(
+            result=abci.OFFER_SNAPSHOT_ACCEPT
+        )
+
+    def apply_snapshot_chunk(self, index, chunk, sender):
+        if chunk != CHUNKS[index]:
+            self.retries.append((index, sender))
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_RETRY
+            )
+        self.applied.append(index)
+        return abci.ResponseApplySnapshotChunk(
+            result=abci.APPLY_CHUNK_ACCEPT
+        )
+
+
+class _QueryConn:
+    def info(self, req):
+        return abci.ResponseInfo(
+            last_block_height=10, last_block_app_hash=b"\x22" * 32
+        )
+
+
+class _Proxy:
+    def __init__(self):
+        self.snapshot = _SnapshotConn()
+        self.query = _QueryConn()
+
+
+def _mk_syncer(request_chunk, chunk_timeout_s=5.0):
+    return Syncer(
+        _Proxy(),
+        _Provider(),
+        request_chunk=request_chunk,
+        chunk_timeout_s=chunk_timeout_s,
+        rng=random.Random(7),
+    )
+
+
+def _key():
+    return SnapshotKey(
+        height=10, format=1, chunks=len(CHUNKS), hash=SNAP_HASH
+    )
+
+
+def test_corrupt_chunk_sender_is_banned_and_sync_completes():
+    """One peer serves garbage for every chunk: the app's RETRY on
+    its first chunk bans it, its queued chunks are discarded, and the
+    good peer completes the restore."""
+    calls = []
+
+    async def request_chunk(peer, height, fmt, index):
+        calls.append((peer, index))
+        if peer == "evil":
+            return b"garbage"
+        return CHUNKS[index]
+
+    async def main():
+        syncer = _mk_syncer(request_chunk)
+        state, commit = await syncer._sync_one(
+            _key(), {"evil", "good"}
+        )
+        assert state == {"height": 10}
+        assert "evil" in syncer.banned_peers
+        assert sorted(syncer.proxy.snapshot.applied) == [0, 1, 2, 3]
+        # after the ban the rotation stopped asking the evil peer
+        last_evil = max(
+            i for i, c in enumerate(calls) if c[0] == "evil"
+        )
+        assert any(
+            c[0] == "good" and i > last_evil
+            for i, c in enumerate(calls)
+        )
+
+    run(main())
+
+
+def test_reject_senders_directive_bans_and_discards():
+    """The app can name corrupt senders on ANY verdict
+    (reject_senders); their queued chunks are discarded and they are
+    banned from further fetches."""
+    q = ChunkQueue(3)
+    q.add(0, b"a", "good")
+    q.add(1, b"b", "shady")
+    q.add(2, b"c", "shady")
+
+    syncer = _mk_syncer(lambda *a: None)
+    syncer._apply_directives(
+        q,
+        abci.ResponseApplySnapshotChunk(
+            result=abci.APPLY_CHUNK_ACCEPT,
+            reject_senders=["shady"],
+            refetch_chunks=[0],
+        ),
+    )
+    assert "shady" in syncer.banned_peers
+    # shady's chunks dropped + the app-directed refetch honored
+    assert q.wanted() == {0, 1, 2}
+
+
+def test_all_peers_banned_rejects_snapshot_not_hangs():
+    """Every peer of the snapshot serves corrupt data: the fetchers
+    stop, the apply loop times out, and the snapshot attempt fails
+    bounded (the caller's sync_any then tries the next snapshot)."""
+
+    async def request_chunk(peer, height, fmt, index):
+        return b"garbage"
+
+    async def main():
+        syncer = _mk_syncer(request_chunk, chunk_timeout_s=0.5)
+        with pytest.raises(asyncio.TimeoutError):
+            await syncer._sync_one(_key(), {"evil1", "evil2"})
+        assert syncer.banned_peers == {"evil1", "evil2"}
+
+    run(main())
+
+
+def test_fetch_failures_back_off_with_jitter():
+    """Request failures sleep through the shared full-jitter Backoff
+    (utils/backoff.py) instead of a flat retry hammer: the fetch
+    succeeds after transient failures, and the failure sleeps grow
+    from the seeded backoff stream."""
+    fails = {"count": 0}
+    sleeps = []
+
+    async def request_chunk(peer, height, fmt, index):
+        if fails["count"] < 3:
+            fails["count"] += 1
+            raise ConnectionError("transient")
+        return CHUNKS[index]
+
+    async def main():
+        syncer = _mk_syncer(request_chunk)
+
+        real_sleep = asyncio.sleep
+
+        async def spy_sleep(d):
+            sleeps.append(d)
+            await real_sleep(0)  # keep the test fast
+
+        orig = asyncio.sleep
+        asyncio.sleep = spy_sleep
+        try:
+            state, _ = await syncer._sync_one(_key(), {"flaky"})
+        finally:
+            asyncio.sleep = orig
+        assert state == {"height": 10}
+        # three failure sleeps drawn from the jittered stream: all
+        # bounded by the growing ceiling, not a constant
+        fail_sleeps = [s for s in sleeps if s != 0.05]
+        assert len(fail_sleeps) >= 3
+        assert all(0.0 <= s <= 2.0 for s in fail_sleeps)
+
+    run(main())
+
+
+def test_chunk_queue_discard_sender():
+    q = ChunkQueue(4)
+    q.add(0, b"a", "p1")
+    q.add(1, b"b", "p2")
+    q.add(2, b"c", "p1")
+    dropped = q.discard_sender("p1")
+    assert sorted(dropped) == [0, 2]
+    assert q.wanted() == {0, 2, 3}
+    assert q.discard_sender("p1") == []
+
+
+def test_sender_ban_never_rewinds_applied_chunks():
+    """Chunks the app ACCEPTED must survive a later ban of their
+    sender: re-applying them unasked corrupts append-style restores
+    (kvstore buffers every apply call). Only an EXPLICIT app-directed
+    refetch re-opens an applied chunk."""
+
+    async def main():
+        q = ChunkQueue(3)
+        q.add(0, b"a", "evil")
+        q.add(1, b"b", "evil")
+        i, _, _ = await q.next(1.0)
+        assert i == 0
+        q.mark_applied(0)
+        # ban evil AFTER chunk 0 was applied: only the unapplied
+        # chunk 1 is discarded, next_index does not rewind
+        assert q.discard_sender("evil") == [1]
+        assert q.next_index == 1 and 0 in q.chunks
+        assert q.wanted() == {1, 2}
+        # an explicit refetch directive DOES re-open an applied chunk
+        q.discard(0)
+        assert 0 not in q.applied and q.next_index == 0
+
+    run(main())
+
+
+def test_reject_senders_directive_spares_applied_chunks():
+    """An app response that bans a sender (reject_senders) while that
+    sender's earlier chunk was already ACCEPTED must not rewind the
+    accepted chunk — the ban discards only its unapplied ones."""
+    q = ChunkQueue(3)
+    q.add(0, b"a", "shady")
+    q.add(1, b"b", "shady")
+    q.mark_applied(0)
+    syncer = _mk_syncer(lambda *a: None)
+    syncer._apply_directives(
+        q,
+        abci.ResponseApplySnapshotChunk(
+            result=abci.APPLY_CHUNK_ACCEPT, reject_senders=["shady"]
+        ),
+    )
+    assert "shady" in syncer.banned_peers
+    assert 0 in q.chunks and 0 in q.applied  # accepted chunk intact
+    assert q.wanted() == {1, 2}  # only the unapplied one refetches

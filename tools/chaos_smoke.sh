@@ -56,3 +56,13 @@ echo "== chaos smoke: byzantine corruption must be DETECTED =="
 # trap-cleaned dir instead of leaking a /tmp/chaos_trace_* per run
 JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" --byzantine 2 \
     --trace-dump "$TRACE_DIR/byzantine"
+
+echo "== chaos smoke: 5-scenario factory matrix, budget-gated =="
+# seeded workload x network x lifecycle matrix (docs/CHAOS.md
+# "Scenario factory"): any 5-window covers crash_wave,
+# statesync_join, wal_torn_tail, adaptive_catchup and
+# crash_restart+valset_churn; every scenario must be invariant-clean
+# (exit 1) and budget-clean (exit 2), each replayable byte-for-byte
+# via the printed "SCENARIO ... --only I" seed line
+JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos matrix --seed "$SEED" \
+    --count 5 --budget --out "$TRACE_DIR/matrix"
