@@ -63,6 +63,7 @@ _DEFAULT_BUDGETS_S = {
     "batch64": 600.0,
     "mixed": 600.0,
     "pipeline": 900.0,
+    "live": 1500.0,
 }
 
 
@@ -885,6 +886,288 @@ def bench_ingest() -> dict:
     }
 
 
+def bench_live() -> dict:
+    """Live-consensus fast path ablation (docs/PERF.md "Live consensus
+    fast path"): the SAME 4-validator LocalNet workload producing N
+    heights through
+
+    - serial   — the reference-like path: one inline fsync per WAL
+      sync barrier, inline per-vote signature verification, blocking
+      finalize;
+    - fastpath — WAL group commit (calibrated seam) + pipelined
+      finalize (persist/fsync off-loop, single in-flight height).
+
+    Two disk models: the REAL disk (cached NVMe, ~0.1 ms fsync — the
+    calibrated router keeps the strict inline barrier, so fastpath
+    must hold parity) and a 2 ms synthetic barrier (consensus/wal.py
+    set_fsync_model) standing in for sync-through production media,
+    where the group seam engages and the ablation measures its win.
+    Runs are pass-interleaved (serial/fast/serial/fast...) with
+    medians, the same defense bench_ingest uses against this box's
+    throttling spikes. Per mode: agreement asserted (every node,
+    every height, identical block hashes). A separate leg exercises
+    the in-round vote micro-batch (vote_batch_window_ms) and asserts
+    its verdicts are serial-equivalent."""
+    import asyncio
+    import shutil
+    import statistics
+    import tempfile
+
+    from cometbft_tpu.config.config import test_config
+    from cometbft_tpu.consensus import wal as walmod
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.node.inprocess import (
+        LocalNet,
+        build_node,
+        make_genesis,
+    )
+
+    n_nodes = int(os.environ.get("BENCH_LIVE_NODES", "4"))
+    heights = int(os.environ.get("BENCH_LIVE_HEIGHTS", "20"))
+    txs_per_height = int(os.environ.get("BENCH_LIVE_TXS", "20"))
+    repeats = int(os.environ.get("BENCH_LIVE_REPEATS", "3"))
+    slow_fsync_ms = float(os.environ.get("BENCH_LIVE_SLOW_FSYNC_MS", "2"))
+
+    def run_once(fast: bool, vote_ms: float = 0.0, nodes_n=None) -> dict:
+        base = tempfile.mkdtemp(prefix="bench_live_")
+        old_backend = crypto_batch._default_backend
+        crypto_batch.set_default_backend("cpu")
+        try:
+            nn = nodes_n or n_nodes
+            gen, pvs = make_genesis(nn, chain_id="bench-live")
+            nodes = []
+            for i, pv in enumerate(pvs):
+                home = os.path.join(base, f"n{i}")
+                os.makedirs(home, exist_ok=True)
+                cfg = test_config(home)
+                cfg.base.moniker = f"n{i}"
+                cfg.base.db_backend = "sqlite"  # real persist leg
+                cfg.consensus.skip_timeout_commit = True
+                cfg.consensus.timeout_commit_s = 0.0
+                cfg.tx_index.indexer = "null"
+                cfg.consensus.vote_batch_window_ms = vote_ms
+                if fast:
+                    cfg.consensus.wal_group_commit_ms = 2.0
+                    cfg.consensus.finalize_pipeline = True
+                else:
+                    cfg.consensus.wal_group_commit_ms = 0.0
+                    cfg.consensus.finalize_pipeline = False
+                nodes.append(
+                    build_node(gen, pv, config=cfg, home=home, wal=True)
+                )
+            net = LocalNet(nodes)
+
+            async def main():
+                await net.start()
+
+                async def feed():
+                    i = 0
+                    while True:
+                        for _ in range(txs_per_height):
+                            try:
+                                nodes[i % nn].mempool.check_tx(
+                                    b"live-%08d=%04d" % (i, i % 7919)
+                                )
+                            except Exception:
+                                pass
+                            i += 1
+                        await asyncio.sleep(0.05)
+
+                feeder = asyncio.ensure_future(feed())
+                t0 = time.perf_counter()
+                await net.wait_for_height(heights, timeout=600)
+                wall = time.perf_counter() - t0
+                feeder.cancel()
+                await net.stop()
+                return wall
+
+            wall = asyncio.run(main())
+            # agreement = the live path's verdict-parity gate: every
+            # node must hold identical block hashes at every height
+            # (header app_hash pins app agreement one height back; a
+            # raw app.app_hash comparison would race nodes sitting
+            # one height apart at stop)
+            for h in range(1, heights + 1):
+                hs = {
+                    n.block_store.load_block_meta(h).block_id.hash
+                    for n in nodes
+                }
+                assert len(hs) == 1, f"disagreement at height {h}"
+            quorum_ns = []
+            for n in nodes:
+                quorum_ns.extend(
+                    e["dur_ns"]
+                    for e in n.tracer.snapshot()
+                    if e["name"].startswith("consensus.quorum.")
+                )
+            quorum_ns.sort()
+            out = {
+                "wall_s": wall,
+                "blocks_per_s": heights / wall,
+                "p95_quorum_ms": (
+                    quorum_ns[int(0.95 * (len(quorum_ns) - 1))] / 1e6
+                    if quorum_ns
+                    else None
+                ),
+                "group_fsyncs": sum(
+                    n.cs.wal.group_fsyncs for n in nodes if n.cs.wal
+                ),
+                "group_barriers": sum(
+                    n.cs.wal.group_coalesced for n in nodes if n.cs.wal
+                ),
+                "vote_batches": sum(
+                    n.cs._vote_coalescer.dispatches
+                    for n in nodes
+                    if n.cs._vote_coalescer is not None
+                ),
+                "votes_batched": sum(
+                    n.cs._vote_coalescer.submitted
+                    for n in nodes
+                    if n.cs._vote_coalescer is not None
+                ),
+            }
+            for n in nodes:
+                n.close_stores()
+            return out
+        finally:
+            crypto_batch.set_default_backend(old_backend)
+            shutil.rmtree(base, ignore_errors=True)
+
+    def ablate(disk: str) -> dict:
+        """Interleaved serial/fast repeats under one disk model;
+        medians + speedups."""
+        runs = {"serial": [], "fastpath": []}
+        if disk == "slow":
+            walmod.set_fsync_model(slow_fsync_ms / 1e3)
+        try:
+            for _ in range(repeats):
+                runs["serial"].append(run_once(fast=False))
+                runs["fastpath"].append(run_once(fast=True))
+        finally:
+            walmod.set_fsync_model(0.0)
+        med = {
+            mode: {
+                "blocks_per_s": round(
+                    statistics.median(
+                        r["blocks_per_s"] for r in rs
+                    ),
+                    2,
+                ),
+                "p95_quorum_ms": round(
+                    statistics.median(
+                        r["p95_quorum_ms"] or 0 for r in rs
+                    ),
+                    1,
+                ),
+                "group_fsyncs": rs[-1]["group_fsyncs"],
+                "group_barriers": rs[-1]["group_barriers"],
+            }
+            for mode, rs in runs.items()
+        }
+        out = {
+            "disk": (
+                "real (cached NVMe, ~0.1ms fsync)"
+                if disk == "real"
+                else f"{slow_fsync_ms}ms synthetic barrier "
+                "(sync-through disk model)"
+            ),
+            **med,
+            "blocks_per_s_speedup": _ratio(
+                med["fastpath"]["blocks_per_s"],
+                med["serial"]["blocks_per_s"],
+            ),
+        }
+        s_q = med["serial"]["p95_quorum_ms"]
+        f_q = med["fastpath"]["p95_quorum_ms"]
+        if s_q and f_q:
+            out["p95_quorum_reduction"] = round(1.0 - f_q / s_q, 3)
+        return out
+
+    def vote_batch_leg() -> dict:
+        """In-round vote micro-batching: serial-equivalent verdicts
+        asserted two ways — a direct CoalescingVerifier-vs-serial
+        verdict comparison over valid + forged votes, and a live net
+        run with the window on (agreement per height + the coalescer
+        provably engaged)."""
+        from cometbft_tpu.crypto.coalesce import CoalescingVerifier
+        from cometbft_tpu.crypto.keys import Ed25519PrivKey
+
+        rng = np.random.default_rng(31)
+        privs = [
+            Ed25519PrivKey.from_seed(rng.bytes(32)) for _ in range(8)
+        ]
+        items = []
+        for i in range(200):
+            p = privs[i % len(privs)]
+            m = bytes(rng.bytes(96))
+            sig = p.sign(m)
+            if i % 17 == 0:
+                sig = bytes(64)  # forged lane
+            items.append((p.pub_key(), m, sig))
+        serial_verdicts = [
+            pk.verify(m, sig) for pk, m, sig in items
+        ]
+
+        async def coalesced():
+            vc = CoalescingVerifier(window_s=0.001)
+            futs = [vc.submit(pk, m, sig) for pk, m, sig in items]
+            await vc.drain()
+            return [bool(f.result()) for f in futs]
+
+        batched_verdicts = asyncio.run(coalesced())
+        assert batched_verdicts == serial_verdicts, (
+            "coalesced vote verdicts diverged from serial"
+        )
+        live = run_once(fast=False, vote_ms=2.0, nodes_n=n_nodes)
+        assert live["votes_batched"] > 0 and live["vote_batches"] > 0, (
+            "live run never exercised the vote coalescer"
+        )
+        return {
+            "verdicts_identical": True,
+            "lanes": len(items),
+            "forged_lanes": sum(1 for v in serial_verdicts if not v),
+            "live_blocks_per_s": round(live["blocks_per_s"], 2),
+            "live_votes_batched": live["votes_batched"],
+            "live_vote_batches": live["vote_batches"],
+            "note": (
+                "window=2ms on the state-machine prestage; on this "
+                "in-process 2-vCPU harness the handoff costs more "
+                "than the ~80us/sig it batches (committee waves of "
+                "3), so the knob defaults off — the reactor's "
+                "always-on coalescing serves networked nodes"
+            ),
+        }
+
+    run_once(fast=False)  # warm pass (sqlite, allocator, pools)
+    real = ablate("real")
+    slow = ablate("slow")
+    votes = vote_batch_leg()
+    if slow["fastpath"]["group_barriers"] == 0:
+        raise AssertionError(
+            "slow-disk model never engaged the WAL group seam"
+        )
+    return {
+        "rate": slow["fastpath"]["blocks_per_s"],
+        "nodes": n_nodes,
+        "heights": heights,
+        "txs_per_height": txs_per_height,
+        "repeats_per_mode": repeats,
+        "real_disk": real,
+        "slow_disk": slow,
+        "vote_batch": votes,
+        "verdict_parity": _verdict_parity(),
+        "note": (
+            "serial = inline fsync per barrier + blocking finalize; "
+            "fastpath = calibrated WAL group commit + pipelined "
+            "finalize (persist/fsync off-loop). Headline = slow-disk "
+            "ablation (the seam's target media); real-disk leg "
+            "proves the calibrated router holds parity where fsync "
+            "is ~free. Pass-interleaved medians; agreement asserted "
+            "per mode per height."
+        ),
+    }
+
+
 def bench_commit150(gen, parts) -> dict:
     import cometbft_tpu.types as T
 
@@ -1365,6 +1648,7 @@ def main() -> None:
             "mixed",
             "pipeline",
             "ingest",
+            "live",
         }
         if which == "all"
         else set(which.split(","))
@@ -1488,6 +1772,12 @@ def main() -> None:
         # host-only mempool ingest ablation: cheap enough to always
         # run (no corpus, no device, ~a minute on this box)
         run_config("ingest", bench_ingest)
+    if "live" in todo:
+        # host-only live-consensus fast-path ablation (ISSUE 11):
+        # 4-node LocalNet blocks/s + p95 quorum latency, serial vs
+        # batched — the first optimization leg behind the PR 7 quorum
+        # waterfall
+        run_config("live", bench_live)
     budget_skip = {
         "skipped": f"host budget ({host_budget_s:.0f}s) "
         "exhausted before this config"

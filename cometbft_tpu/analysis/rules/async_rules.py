@@ -560,6 +560,50 @@ def unbounded_await_in_stop(ctx: FileContext) -> List[Finding]:
     return out
 
 
+# The ONLY sanctioned direct-fsync site in the hot planes: the WAL's
+# group-commit seam (consensus/wal.py flush_sync + repair paths),
+# where barriers coalesce and the disk stall runs off-loop. A direct
+# os.fsync anywhere else in a hot plane is a serial disk stall the
+# seam exists to absorb — and on the consensus loop it parks every
+# peer at once.
+_FSYNC_SEAM_FILES = ("cometbft_tpu/consensus/wal.py",)
+
+
+@rule(
+    "ASY111",
+    "direct-fsync-in-hot-plane",
+    "a direct os.fsync in a hot-plane module outside the WAL "
+    "group-commit seam is a serial disk stall on a latency-critical "
+    "path; route the barrier through consensus/wal.py (write_sync / "
+    "write_group) or move it off-plane",
+)
+def direct_fsync_in_hot_plane(ctx: FileContext) -> List[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if not any(p in path for p in _HOT_PLANE_PREFIXES):
+        return []
+    if any(seam in path for seam in _FSYNC_SEAM_FILES):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if dotted(node.func) != "os.fsync":
+            continue
+        out.append(
+            Finding(
+                ctx.path, node.lineno, node.col_offset,
+                "ASY111", "direct-fsync-in-hot-plane",
+                "`os.fsync` in a hot-plane module outside the WAL "
+                "group-commit seam: each call is a serial disk "
+                "barrier on a latency-critical path (and a loop "
+                "stall when called from the consensus/p2p loop) — "
+                "write through consensus/wal.py's write_sync/"
+                "write_group seam, or move the fsync off-plane",
+            )
+        )
+    return out
+
+
 @rule(
     "ASY106",
     "nested-event-loop",

@@ -69,6 +69,13 @@ def main(argv=None) -> int:
         "Perfetto trace.json); without it violated runs still dump "
         "to a fresh temp directory",
     )
+    ap.add_argument(
+        "--fastpath",
+        action="store_true",
+        help="run every node with the live-consensus fast path "
+        "(WAL group commit + vote micro-batching + pipelined "
+        "finalize, docs/PERF.md) under a 2ms slow-disk fsync model",
+    )
     args = ap.parse_args(argv)
 
     if args.schedule:
@@ -83,18 +90,32 @@ def main(argv=None) -> int:
 
         budget_file = args.budget or default_budget_file()
 
-    with tempfile.TemporaryDirectory(prefix="chaos_") as tmp:
-        report = asyncio.run(
-            run_schedule(
-                schedule,
-                seed=args.seed,
-                base_dir=tmp,
-                n_nodes=args.nodes,
-                liveness_bound_s=args.liveness_bound,
-                trace_dir=args.trace_dump,
-                budget_file=budget_file,
+    config_hook = None
+    if args.fastpath:
+        from ..consensus import wal as walmod
+        from .matrix import fastpath_config_hook
+
+        config_hook = fastpath_config_hook
+        walmod.set_fsync_model(0.002)
+    try:
+        with tempfile.TemporaryDirectory(prefix="chaos_") as tmp:
+            report = asyncio.run(
+                run_schedule(
+                    schedule,
+                    seed=args.seed,
+                    base_dir=tmp,
+                    n_nodes=args.nodes,
+                    liveness_bound_s=args.liveness_bound,
+                    trace_dir=args.trace_dump,
+                    budget_file=budget_file,
+                    config_hook=config_hook,
+                )
             )
-        )
+    finally:
+        if args.fastpath:
+            from ..consensus import wal as walmod
+
+            walmod.set_fsync_model(0.0)
     print(report.format())
     if args.json:
         with open(args.json, "w") as f:

@@ -94,11 +94,23 @@ class MatrixReport:
         return "\n".join(lines)
 
 
+def fastpath_config_hook(cfg) -> None:
+    """Enable the live-consensus fast path (docs/PERF.md) on every
+    node of a chaos run: WAL group commit + in-round vote
+    micro-batching + pipelined finalize. Used by ``matrix
+    --fastpath`` so the fault matrix proves the fast path clean, not
+    just fast."""
+    cfg.consensus.wal_group_commit_ms = 2.0
+    cfg.consensus.vote_batch_window_ms = 2.0
+    cfg.consensus.finalize_pipeline = True
+
+
 async def run_scenario(
     spec: ScenarioSpec,
     base_dir: str,
     budget_file: Optional[str] = None,
     trace_dir: Optional[str] = None,
+    config_hook=None,
 ) -> ChaosReport:
     """One generated scenario through the standard chaos entrypoint
     (the same path hand-written schedules use — generated scenarios
@@ -113,6 +125,7 @@ async def run_scenario(
         trace_dir=trace_dir,
         budget_file=budget_file,
         workload=spec.workload,
+        config_hook=config_hook,
     )
 
 
@@ -121,6 +134,7 @@ async def run_matrix(
     budget_file: Optional[str] = None,
     trace_dir: Optional[str] = None,
     out_dir: Optional[str] = None,
+    config_hook=None,
 ) -> MatrixReport:
     master = specs[0].master_seed if specs else 0
     matrix = MatrixReport(master_seed=master)
@@ -151,6 +165,7 @@ async def run_matrix(
                     base_dir=tmp,
                     budget_file=budget_file,
                     trace_dir=sub_trace,
+                    config_hook=config_hook,
                 )
             except asyncio.CancelledError:
                 raise
@@ -231,6 +246,14 @@ def matrix_main(argv=None) -> int:
                     "DIR/<scenario_id>/")
     ap.add_argument("--json", help="write the matrix report here")
     ap.add_argument(
+        "--fastpath", action="store_true",
+        help="run every node with the live-consensus fast path on "
+        "(WAL group commit + vote micro-batching + pipelined "
+        "finalize, docs/PERF.md) under a 2ms slow-disk fsync model "
+        "so the group seam genuinely engages — proves the fast path "
+        "fault-clean, not just fast",
+    )
+    ap.add_argument(
         "--list", action="store_true",
         help="print the generated scenarios (seed lines + schedule "
         "JSON) without running them",
@@ -256,14 +279,29 @@ def matrix_main(argv=None) -> int:
 
         budget_file = args.budget or default_budget_file()
 
-    matrix = asyncio.run(
-        run_matrix(
-            specs,
-            budget_file=budget_file,
-            trace_dir=args.trace_dump,
-            out_dir=args.out,
+    config_hook = None
+    if args.fastpath:
+        from ..consensus import wal as walmod
+
+        config_hook = fastpath_config_hook
+        # the calibrated WAL router keeps the strict path on this
+        # box's ~0.1ms fsyncs; the model makes barriers sync-through-
+        # disk-expensive so crashes/torn tails land INSIDE group
+        # windows (restored below)
+        walmod.set_fsync_model(0.002)
+    try:
+        matrix = asyncio.run(
+            run_matrix(
+                specs,
+                budget_file=budget_file,
+                trace_dir=args.trace_dump,
+                out_dir=args.out,
+                config_hook=config_hook,
+            )
         )
-    )
+    finally:
+        if args.fastpath:
+            walmod.set_fsync_model(0.0)
     print()
     print(matrix.format_table())
     for r in matrix.results:

@@ -221,6 +221,12 @@ class ChaosNet:
             cfg.rpc.laddr = ""  # invariants read stores directly
         cfg.blocksync.enable = False
         cfg.p2p.pex = False
+        # determinism pin: the WAL group-commit router keys on
+        # MEASURED fsync walls (load-dependent), but a chaos run's
+        # structure must be a pure function of its seed — the seam
+        # stays off here unless the run opts in (matrix --fastpath's
+        # config_hook re-enables it, under the fixed fsync model)
+        cfg.consensus.wal_group_commit_ms = 0.0
         if self.config_hook is not None:
             self.config_hook(cfg)
         for dotted, value in cn.build_overrides.items():

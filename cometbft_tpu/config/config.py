@@ -134,6 +134,33 @@ class ConsensusConfig:
     # max allowed difference between proposed block time and wall clock
     # (reference config/config.go:1265-1286, default 60s; 0 disables)
     block_time_tolerance_ns: int = 60_000_000_000
+    # --- live-consensus fast path (docs/PERF.md) ---------------------
+    # WAL group commit: sync-barrier records written within this
+    # window coalesce into ONE fsync (consensus/wal.py write_group);
+    # externalization (own vote/proposal broadcast) is deferred until
+    # the covering fsync lands, so the WAL-before-act contract holds
+    # with a bounded (~window) barrier. Routing is calibrated: the
+    # seam only engages when the measured fsync cost exceeds the
+    # ticket-handoff cost (slow sync-through disks), so a cached-NVMe
+    # box keeps the strict inline barrier automatically. 0 disables
+    # the seam entirely (the reference's one-fsync-per-barrier path).
+    wal_group_commit_ms: float = 2.0
+    # in-round vote-verify micro-batching: peer votes for the current
+    # height arriving within this window are signature-verified as one
+    # batch through the crypto coalesce/parallel engine and resolve as
+    # cache hits in add_vote (the blocksync pre-verify pattern applied
+    # to live rounds). 0 (default) = serial inline verification — the
+    # batch only wins once committee vote waves are large enough to
+    # out-earn the dispatch handoff (docs/PERF.md); the p2p reactor's
+    # always-on coalescing continues to serve networked nodes either
+    # way.
+    vote_batch_window_ms: float = 0.0
+    # pipelined finalize: block persist + WAL end-height + ABCI apply
+    # run off-loop (one in-flight height, barrier before the next
+    # commit) while the loop keeps relaying gossip; next-height
+    # messages park and replay at height entry. Off = the reference's
+    # blocking finalize.
+    finalize_pipeline: bool = False
 
     def propose_timeout(self, round_: int) -> float:
         return self.timeout_propose_s + self.timeout_propose_delta_s * round_

@@ -119,6 +119,20 @@ class ConsensusState:
         # this node committed — served by RPC health so a degraded
         # verdict can cite the dominant phase
         self.last_commit_breakdown: Optional[Dict] = None
+        # --- live-consensus fast path (docs/PERF.md) -----------------
+        # in-round vote micro-batcher (built in start(): needs a loop);
+        # peer votes pre-verify in coalesced batches and resolve as
+        # sig_cache hits in add_vote
+        self._vote_coalescer = None
+        # pipelined finalize: height currently persisting/applying
+        # off-loop (None = none; at most ONE in flight by design) and
+        # the next-height messages parked until that height opens
+        self._finalize_inflight: Optional[int] = None
+        self._finalize_task: Optional[asyncio.Task] = None
+        self._parked: List[Tuple] = []
+        # deferred externalizations awaiting their WAL barrier, in
+        # submission order (see _after_durable)
+        self._durable_fifo: List[Tuple] = []
 
         self.update_to_state(state)
 
@@ -149,8 +163,23 @@ class ConsensusState:
             # record written this incarnation lands after the garbage
             # and is lost on the next restart (wal.repair_torn_tail)
             walmod.WAL.repair_torn_tail(self._wal_path)
-            self.wal = walmod.WAL(self._wal_path, tracer=self.tracer)
+            self.wal = walmod.WAL(
+                self._wal_path,
+                tracer=self.tracer,
+                group_commit_ms=self.config.wal_group_commit_ms,
+            )
             self._catchup_replay()
+            self._reconcile_privval_state()
+        if self.config.vote_batch_window_ms > 0:
+            # in-round vote-verify micro-batching (the blocksync
+            # pre-verify pattern applied to live rounds): one batch
+            # dispatch per arrival window, results land in sig_cache
+            from ..crypto.coalesce import CoalescingVerifier
+
+            self._vote_coalescer = CoalescingVerifier(
+                cache=self.sig_cache,
+                window_s=self.config.vote_batch_window_ms / 1000.0,
+            )
         self._routine_task = asyncio.create_task(self._receive_routine())
         # kick off the first height
         self._schedule_timeout(
@@ -187,6 +216,33 @@ class ConsensusState:
                 traceback.print_exc()
         if self._timeout_task:
             self._timeout_task.cancel()
+        if self._finalize_task and not self._finalize_task.done():
+            if graceful:
+                try:
+                    # bounded (ASY110): let an in-flight finalize land
+                    # before sealing the WAL; a wedged apply is
+                    # abandoned (recovery replays from the stores)
+                    await asyncio.wait_for(self._finalize_task, 10.0)
+                except asyncio.TimeoutError:
+                    pass
+                except asyncio.CancelledError:
+                    if not self._finalize_task.cancelled():
+                        raise  # outer cancel of stop(): propagate
+                except Exception:
+                    traceback.print_exc()
+            else:
+                self._finalize_task.cancel()
+        if self._vote_coalescer is not None and graceful:
+            try:
+                # flush the last vote window so no future leaks into a
+                # dead loop (drops are fine — the machine is stopping)
+                await asyncio.wait_for(self._vote_coalescer.drain(), 5.0)
+            except asyncio.TimeoutError:
+                pass
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                traceback.print_exc()
         if self.wal:
             if graceful:
                 self.wal.close()
@@ -256,6 +312,14 @@ class ConsensusState:
                     )
                     self._handle_timeout(payload)
                 else:
+                    if self._park_next_height(kind, payload, peer_id):
+                        continue
+                    if (
+                        kind == "vote"
+                        and peer_id != ""
+                        and self._maybe_prestage_vote(payload, peer_id)
+                    ):
+                        continue  # re-enqueues once batch-verified
                     self._wal_write_msg(kind, payload, peer_id)
                     self._handle_msg(kind, payload, peer_id)
             except asyncio.CancelledError:
@@ -268,6 +332,14 @@ class ConsensusState:
                     err=repr(e),
                 )
                 traceback.print_exc()
+            if (
+                self._vote_coalescer is not None
+                and self.queue.qsize() == 0
+            ):
+                # inbox drained = the natural micro-batch boundary:
+                # dispatch the staged vote wave NOW instead of letting
+                # the window timer starve behind a busy loop
+                self._vote_coalescer.flush()
 
     def _handle_msg(self, kind: str, payload, peer_id: str) -> None:
         if self.tracer.enabled:
@@ -422,6 +494,29 @@ class ConsensusState:
     ):
         """Shared tail of _finalize_commit and ingest_verified_block:
         persist, WAL-barrier, apply, advance to the next height."""
+        timings = self._finalize_tail(block, parts, commit, bid)
+        return self._complete_finalize(
+            block, bid, timings, immediate=immediate
+        )
+
+    def _finalize_tail(self, block, parts, commit, bid) -> Tuple:
+        """The blocking legs: persist -> WAL end-height barrier ->
+        ABCI apply (strictly this order; reference state.go:1769-1837)."""
+        t_fin, t_persist, t_wal = self._finalize_persist(
+            block, parts, commit
+        )
+        return self._finalize_apply(
+            block, bid, t_fin, t_persist, t_wal
+        )
+
+    def _finalize_persist(self, block, parts, commit) -> Tuple:
+        """Persist + WAL end-height barrier — the GIL-releasing disk
+        legs (sqlite writes, fsync). Thread-safe against the receive
+        loop (stores and the WAL take their own locks), so the
+        pipelined path overlaps them with live gossip relay via
+        asyncio.to_thread. The pure-Python ABCI apply deliberately
+        does NOT ride along: offloading it to a thread just fights
+        the loop for the GIL and loses outright on a 2-vCPU host."""
         height = block.height
         t_fin = time.monotonic_ns()
         fail_point("cs-before-save-block")  # reference state.go:1769
@@ -435,11 +530,26 @@ class ConsensusState:
             self.wal.write_end_height(height)
         t_wal = time.monotonic_ns()
         fail_point("cs-after-wal-end-height")  # :1809
+        return t_fin, t_persist, t_wal
+
+    def _finalize_apply(
+        self, block, bid, t_fin, t_persist, t_wal
+    ) -> Tuple:
         new_state = self.block_exec.apply_verified_block(
             self.state, bid, block
         )
         t_apply = time.monotonic_ns()
         fail_point("cs-after-apply")  # :1837
+        return new_state, t_fin, t_persist, t_wal, t_apply
+
+    def _complete_finalize(
+        self, block, bid, timings, immediate: bool,
+        pipelined: bool = False,
+    ):
+        """Loop-side completion: record the waterfall, advance to the
+        next height, release parked next-height messages."""
+        new_state, t_fin, t_persist, t_wal, t_apply = timings
+        height = block.height
         _log.info(
             "finalized block",
             height=height,
@@ -456,7 +566,16 @@ class ConsensusState:
             persist_ms=round((t_persist - t_fin) / 1e6, 3),
             wal_ms=round((t_wal - t_persist) / 1e6, 3),
             apply_ms=round((t_apply - t_wal) / 1e6, 3),
+            pipelined=pipelined,
         )
+        if pipelined:
+            # end-to-end pipelined finalize including the loop handoff
+            # (its own budget entry; the loop itself never stalled)
+            self.tracer.complete(
+                "consensus.finalize.pipelined", t_fin,
+                time.monotonic_ns() - t_fin,
+                tid="consensus", height=height,
+            )
         self._note_commit_breakdown(height, t_fin, t_persist, t_wal, t_apply)
         # close the height's span stack and stamp the commit;
         # ingest-path commits may have no open round/step spans
@@ -471,6 +590,22 @@ class ConsensusState:
             except Exception:
                 traceback.print_exc()
         self.update_to_state(new_state)
+        if pipelined:
+            self._finalize_inflight = None
+            self._finalize_task = None
+        if self._parked and self.queue is not None:
+            # the new height just opened: replay everything that
+            # arrived for it early, ahead of whatever else is queued
+            parked, self._parked = self._parked, []
+            for item in parked:
+                try:
+                    self.queue.put_nowait(item)
+                except asyncio.QueueFull:
+                    # inbox drowning (10k deep): shed THIS item and
+                    # keep trying the rest — the standard overload
+                    # policy; dropping the whole tail would lose a
+                    # proposal the flood never resends
+                    self.queue.count_drop()
         if self.queue is not None:  # only once started
             self._schedule_timeout(
                 0.0
@@ -506,9 +641,11 @@ class ConsensusState:
 
     # --- WAL ----------------------------------------------------------
 
-    def _wal_write_msg(self, kind: str, payload, peer_id: str) -> None:
+    def _wal_write_msg(
+        self, kind: str, payload, peer_id: str
+    ) -> Optional[walmod.SyncTicket]:
         if self.wal is None:
-            return
+            return None
         if kind == "proposal":
             m = walmod.WALMessage(
                 kind=walmod.MSG_PROPOSAL,
@@ -536,17 +673,157 @@ class ConsensusState:
                 peer_id=peer_id,
             )
         else:
-            return
+            return None
         # own messages (peer_id == "") are fsync barriers (state.go:881)
-        self._wal_write(m, sync=(peer_id == ""))
+        return self._wal_write(m, sync=(peer_id == ""))
 
-    def _wal_write(self, m: walmod.WALMessage, sync: bool) -> None:
+    def _wal_write(
+        self, m: walmod.WALMessage, sync: bool
+    ) -> Optional[walmod.SyncTicket]:
+        """Returns the barrier's SyncTicket for sync writes (done
+        immediately on the strict path, after the covering group
+        fsync otherwise); None for async writes / no WAL."""
         if self.wal is None:
-            return
+            return None
         if sync:
-            self.wal.write_sync(m)
-        else:
-            self.wal.write(m)
+            # group seam: with wal_group_commit_ms == 0 this IS the
+            # strict write_sync and the ticket comes back done
+            return self.wal.write_group(m)
+        self.wal.write(m)
+        return None
+
+    def _after_durable(self, ticket, fn: Callable) -> None:
+        """WAL-before-act: run ``fn`` (an externalization — broadcast
+        of our own vote/proposal) only once its barrier record is
+        durable. Strict-path / absent tickets run inline.
+
+        Deferred actions drain through a FIFO, NOT straight off each
+        ticket: a later barrier whose ticket happens to be done at
+        registration time (its group fsync landed while the current
+        handler was still running) must not jump ahead of an earlier
+        barrier whose callback is still queued on the loop — peers
+        receiving a proposer's first block part before its proposal
+        drop the part on the floor, and flood delivery never resends
+        (observed as systematic round-0 failure)."""
+        if ticket is None or (ticket.done() and not self._durable_fifo):
+            fn()
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            # no loop (sync test harness): the barrier cannot be
+            # awaited — block for it, bounded, then act
+            ticket.wait(1.0)
+            fn()
+            return
+        self._durable_fifo.append((ticket, fn))
+        ticket.add_done_callback(
+            lambda: loop.call_soon_threadsafe(self._drain_durable)
+        )
+
+    def _drain_durable(self) -> None:
+        """Run every queued externalization whose barrier has landed,
+        strictly in submission order (head-of-line blocks the rest)."""
+        fifo = self._durable_fifo
+        while fifo and fifo[0][0].done():
+            _, fn = fifo.pop(0)
+            try:
+                fn()
+            except Exception:
+                traceback.print_exc()
+
+    # --- in-round vote pre-verification (fast path) -------------------
+
+    def _maybe_prestage_vote(self, payload, peer_id: str) -> bool:
+        """Route a current-height peer vote through the coalescing
+        batch verifier; returns True when staged (the vote re-enqueues
+        once its batch resolves, then lands in add_vote as a
+        sig_cache hit). Anything the batcher cannot judge — other
+        heights, unknown indexes, already-cached signatures — handles
+        inline, where add_vote produces the canonical verdicts."""
+        vc = self._vote_coalescer
+        if vc is None:
+            return False
+        vote = payload.vote
+        rs = self.rs
+        if (
+            vote.height != rs.height
+            or rs.validators is None
+            or not vote.signature
+            or not 0 <= vote.validator_index < rs.validators.size()
+        ):
+            return False
+        val = rs.validators.get_by_index(vote.validator_index)
+        if val is None or val.address != vote.validator_address:
+            return False
+        sb = vote.sign_bytes(self.state.chain_id)
+        if self.sig_cache.contains(
+            sb, vote.signature, val.pub_key.key_bytes
+        ):
+            # pre-verified (reactor batch, a re-delivery, or our own
+            # earlier window): inline handling cache-hits — also the
+            # cycle-breaker for the re-enqueued staged vote itself
+            return False
+        fut = vc.submit(val.pub_key, sb, vote.signature)
+
+        def _done(f: "asyncio.Future") -> None:
+            ok = False
+            try:
+                ok = bool(f.result())
+            except Exception:
+                pass
+            if not ok:
+                _log.error(
+                    "dropping vote with invalid signature",
+                    height=vote.height,
+                    round=vote.round,
+                    peer=peer_id[:12],
+                )
+                return
+            # same-loop continuation: the callback runs on the event
+            # loop, i.e. inside the single-writer context — handle
+            # directly instead of paying another queue round trip and
+            # a second prestage/cache pass. The height may have moved
+            # while the batch was in flight: the normal park/height
+            # guards apply.
+            try:
+                if self._park_next_height("vote", payload, peer_id):
+                    return
+                self._wal_write_msg("vote", payload, peer_id)
+                self._handle_msg("vote", payload, peer_id)
+            except Exception:
+                traceback.print_exc()
+
+        fut.add_done_callback(_done)
+        return True
+
+    # --- pipelined-finalize parking -----------------------------------
+
+    _PARK_LIMIT = 2048
+
+    def _park_next_height(self, kind: str, payload, peer_id: str) -> bool:
+        """Messages for the NEXT height would be dropped by the height
+        guards and cost a gossip-retransmit round trip (or, on a
+        flood-only harness, the whole round). They arrive whenever
+        delivery is not globally ordered — a peer that committed
+        first proposes h+1 while our own commit of h is a few ms from
+        landing (batched vote windows, group-commit broadcast
+        deferral, pipelined finalize). Park them (bounded) and replay
+        at height entry."""
+        h = None
+        if kind == "proposal":
+            h = payload.proposal.height
+        elif kind == "block_part":
+            h = payload.height
+        elif kind in ("vote", "signed_vote"):
+            h = payload.vote.height
+        elif kind == "commit_block":
+            h = payload.block.height
+        if h is None or h != self.rs.height + 1:
+            return False
+        if len(self._parked) < self._PARK_LIMIT:
+            self._parked.append((kind, payload, peer_id))
+        return True
 
     def _catchup_replay(self) -> None:
         """Replay WAL messages for the current height after a crash
@@ -588,6 +865,202 @@ class ConsensusState:
             )
         elif m.kind == walmod.MSG_VOTE:
             self._try_add_vote(codec.decode_vote(m.data), m.peer_id)
+
+    def _reconcile_privval_state(self) -> None:
+        """Group-commit recovery: a crash between an own-vote append
+        and its group fsync loses the WAL record, but the privval
+        state file — fsync-persisted BEFORE the signature is ever
+        released (privval/file_pv.py) — still holds the signed vote.
+        Rebuild it from that authoritative record and feed it back
+        through the normal own-vote path; without this, replay asks
+        the signer for an already-passed step and every retry dies on
+        DoubleSignError while the height wedges. No-op whenever the
+        WAL already carried the vote (the strict serial path)."""
+        pv = self.privval
+        last = getattr(pv, "last", None)  # remote signers: no state
+        if (
+            last is None
+            or not last.sign_bytes
+            or not last.signature
+            or last.height != self.rs.height
+        ):
+            return
+        try:
+            vote = self._vote_from_privval_state(last)
+        except Exception:
+            traceback.print_exc()
+            return
+        if vote is None:
+            return
+        vs = (
+            self.rs.votes.prevotes(vote.round)
+            if vote.type_ == T.PREVOTE
+            else self.rs.votes.precommits(vote.round)
+        )
+        if (
+            vs is None
+            or not 0 <= vote.validator_index < len(vs.votes)
+            or vs.votes[vote.validator_index] is not None
+        ):
+            return  # replayed from the WAL — nothing was lost
+        if vote.type_ == T.PRECOMMIT and not vote.block_id.is_nil():
+            rs = self.rs
+            have_block = (
+                rs.proposal_block is not None
+                and rs.proposal_block.hash() == vote.block_id.hash
+            ) or (
+                rs.proposal_block_parts is not None
+                and rs.proposal_block_parts.header.hash
+                == vote.block_id.part_set_header.hash
+            )
+            val = rs.validators.get_by_index(vote.validator_index)
+            alone_quorum = (
+                val is not None
+                and val.voting_power * 3
+                > rs.validators.total_voting_power() * 2
+            )
+            if not have_block and alone_quorum:
+                # the WAL lost the block this precommit binds to
+                # (crash inside the same group window) and our own
+                # power forms a quorum: injecting the vote would
+                # drive _enter_commit into waiting forever for parts
+                # that exist nowhere. Roll the signer back instead —
+                # see _rollback_privval_to_wal for why that is safe.
+                self._rollback_privval_to_wal(vote)
+                return
+        _log.info(
+            "reconciling own vote lost from WAL tail (privval state "
+            "is authoritative)",
+            height=vote.height,
+            round=vote.round,
+            type=vote.type_,
+        )
+        self._commit_own_vote(vote)
+
+    def _rollback_privval_to_wal(self, vote: T.Vote) -> None:
+        """Reset the signer's last-sign state to the newest own record
+        the fsync'd WAL holds.
+
+        Safe because externalization is gated on durability: a
+        broadcast fires only after its record's covering fsync
+        (_after_durable), on the strict path and the group path
+        alike — so a vote present in the privval state but ABSENT
+        from the WAL was provably never sent to anyone, and
+        re-signing at that HRS cannot put conflicting signatures on
+        the wire. (Prefix-ordered durability extends the proof
+        backward: if this precommit never fsync'd, neither did
+        anything we wrote after the last WAL-backed record.) The one
+        unprovable case — an operator deleting the WAL while keeping
+        the privval state — is exactly the setup the reference's
+        double-sign protection cannot distinguish either."""
+        from ..privval.file_pv import (
+            _LastSign,
+            STEP_PRECOMMIT,
+            STEP_PREVOTE,
+        )
+
+        rs = self.rs
+        idx = vote.validator_index
+        newest = None  # (vote, privval step) from the replayed WAL
+        for r in range(vote.round, -1, -1):
+            for vset, step in (
+                (rs.votes.precommits(r), STEP_PRECOMMIT),
+                (rs.votes.prevotes(r), STEP_PREVOTE),
+            ):
+                v = (
+                    vset.votes[idx]
+                    if vset is not None and idx < len(vset.votes)
+                    else None
+                )
+                if v is not None and v.signature:
+                    newest = (v, step)
+                    break
+            if newest is not None:
+                break
+        if newest is None:
+            new_last = _LastSign(height=vote.height, round=0, step=0)
+        else:
+            v, step = newest
+            new_last = _LastSign(
+                height=v.height,
+                round=v.round,
+                step=step,
+                signature=v.signature.hex(),
+                sign_bytes=v.sign_bytes(self.state.chain_id).hex(),
+            )
+        _log.info(
+            "rolling back privval state to the newest WAL-proven "
+            "record (lost vote was never externalized)",
+            height=vote.height,
+            round=vote.round,
+            lost_type=vote.type_,
+            restored_step=new_last.step,
+        )
+        self.privval.last = new_last
+        try:
+            self.privval.save_state()
+        except Exception:
+            traceback.print_exc()
+
+    def _vote_from_privval_state(self, last) -> Optional[T.Vote]:
+        """Decode FilePV's canonical sign bytes back into our Vote;
+        None when it isn't a vote of ours for this height (proposals,
+        other chains, valsets we left)."""
+        from ..utils import proto
+
+        sb = bytes.fromhex(last.sign_bytes)
+        payload, _ = proto.read_delimited(sb)
+        m = proto.parse(payload)
+        type_c = proto.get1(m, 1, 0)
+        if type_c not in (T.PREVOTE, T.PRECOMMIT):
+            return None
+        chain = proto.get1(m, 6, b"").decode()
+        if chain != self.state.chain_id:
+            return None
+        bid_raw = proto.get1(m, 4, None)
+        if bid_raw is None:
+            bid = T.NIL_BLOCK_ID
+        else:
+            bm = proto.parse(bid_raw)
+            pm = proto.parse(proto.get1(bm, 2, b""))
+            bid = T.BlockID(
+                proto.get1(bm, 1, b""),
+                T.PartSetHeader(
+                    proto.get1(pm, 1, 0), proto.get1(pm, 2, b"")
+                ),
+            )
+        if (
+            self.state.consensus_params.vote_extensions_enabled(
+                last.height
+            )
+            and type_c == T.PRECOMMIT
+            and not bid.is_nil()
+        ):
+            # the extension payload/signature are not in the privval
+            # state; a rebuilt extensionless precommit would be
+            # rejected by every peer's VerifyVoteExtension gate
+            return None
+        addr = self.privval.pub_key().address()
+        idx, val = self.rs.validators.get_by_address(addr)
+        if idx < 0 or val is None:
+            return None
+        vote = T.Vote(
+            type_=type_c,
+            height=last.height,
+            round=last.round,
+            block_id=bid,
+            timestamp_ns=proto.parse_timestamp(
+                proto.get1(m, 5, b"")
+            ),
+            validator_address=addr,
+            validator_index=idx,
+            signature=bytes.fromhex(last.signature),
+        )
+        # the rebuilt encoding must reproduce the signed bytes exactly
+        # or the signature is for something else — refuse to inject
+        if vote.sign_bytes(chain) != sb:
+            return None
+        return vote
 
     # --- timeout scheduling -------------------------------------------
 
@@ -770,15 +1243,23 @@ class ConsensusState:
         rs = self.rs
         if prop.height != rs.height or prop.round != rs.round:
             return  # round moved on while signing remotely
-        self._wal_write_msg("proposal", ProposalMessage(prop), "")
+        tprop = self._wal_write_msg("proposal", ProposalMessage(prop), "")
         self._set_proposal(prop)
-        self._broadcast("proposal", ProposalMessage(prop))
+        self._after_durable(
+            tprop,
+            lambda: self._broadcast("proposal", ProposalMessage(prop)),
+        )
         for i in range(parts.header.total):
             part = parts.get_part(i)
             msg = BlockPartMessage(prop.height, prop.round, part)
-            self._wal_write_msg("block_part", msg, "")
+            # one fsync typically covers the proposal + every part
+            # (the group window): the proposer's worst per-height
+            # fsync storm collapses to one barrier
+            tpart = self._wal_write_msg("block_part", msg, "")
             self._add_proposal_block_part(prop.height, prop.round, part)
-            self._broadcast("block_part", msg)
+            self._after_durable(
+                tpart, lambda m=msg: self._broadcast("block_part", m)
+            )
 
     def _set_proposal(self, proposal: T.Proposal) -> bool:
         rs = self.rs
@@ -1005,6 +1486,8 @@ class ConsensusState:
         rs = self.rs
         if rs.height != height or rs.step != Step.COMMIT:
             return
+        if self._finalize_inflight is not None:
+            return  # single in-flight height: the pipeline's barrier
         bid = rs.votes.precommits(rs.commit_round).two_thirds_majority()
         if bid is None or bid.is_nil():
             return
@@ -1032,9 +1515,58 @@ class ConsensusState:
                 traceback.print_exc()
         # persist + WAL end-height barrier (reference :1775-1801) +
         # apply + advance (commit already verified by consensus itself)
+        if self.config.finalize_pipeline and self.queue is not None:
+            self._start_pipelined_finalize(block, parts, seen_commit, bid)
+            return
         self._apply_committed_block(
             block, parts, seen_commit, bid, immediate=False
         )
+
+    def _start_pipelined_finalize(self, block, parts, commit, bid) -> None:
+        """Run the finalize tail off-loop so the receive routine keeps
+        relaying gossip (votes/parts/catch-up) during persist + fsync +
+        apply. Bounded to one in-flight height: _try_finalize_commit
+        refuses to start another until _complete_finalize lands, and
+        the next height only opens there — the barrier before the next
+        commit is structural."""
+        height = block.height
+        self._finalize_inflight = height
+        # NOTE: _parked is NOT cleared — messages for height+1 parked
+        # before the commit quorum landed are exactly what the replay
+        # at _complete_finalize exists to deliver
+
+        async def run():
+            try:
+                # only the disk legs go off-loop: the loop keeps
+                # relaying votes/parts while sqlite + the end-height
+                # fsync grind, then the (pure-Python, GIL-bound) ABCI
+                # apply runs back on the loop exactly like the serial
+                # path — same order, same fail points
+                t_fin, t_persist, t_wal = await asyncio.to_thread(
+                    self._finalize_persist, block, parts, commit
+                )
+                timings = self._finalize_apply(
+                    block, bid, t_fin, t_persist, t_wal
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                _log.error(
+                    "pipelined finalize failed", height=height
+                )
+                traceback.print_exc()
+                # release the barrier: a later precommit/part retries
+                # through _try_finalize_commit (the tail is idempotent
+                # — save_block is height-guarded, end-height re-marks;
+                # parked next-height messages stay parked for the
+                # retry's completion)
+                self._finalize_inflight = None
+                return
+            self._complete_finalize(
+                block, bid, timings, immediate=False, pipelined=True
+            )
+
+        self._finalize_task = spawn(run(), name="finalize-pipeline")
 
     # --- votes --------------------------------------------------------
 
@@ -1113,11 +1645,14 @@ class ConsensusState:
             async def sign_off_loop():
                 try:
                     await asyncio.to_thread(do_sign)
-                except Exception:
+                except Exception as e:
+                    from ..privval import DoubleSignError
+
                     traceback.print_exc()
-                    self._schedule_sign_retry(
-                        type_, block_hash, psh, vote.height, vote.round
-                    )
+                    if not isinstance(e, DoubleSignError):
+                        self._schedule_sign_retry(
+                            type_, block_hash, psh, vote.height, vote.round
+                        )
                     return
                 self.enqueue_nowait("signed_vote", VoteMessage(vote), "")
 
@@ -1127,8 +1662,17 @@ class ConsensusState:
             self.privval.sign_vote(self.state.chain_id, vote)
             if want_ext:
                 self.privval.sign_vote_extension(self.state.chain_id, vote)
-        except Exception:
+        except Exception as e:
+            from ..privval import DoubleSignError
+
             traceback.print_exc()
+            if isinstance(e, DoubleSignError):
+                # permanent: the signer's state is AHEAD of this ask
+                # (e.g. group-commit recovery rebuilt an earlier step)
+                # — retrying the same HRS can never succeed, and the
+                # privval-state reconciliation / round progression is
+                # what recovers liveness
+                return
             # signing can fail transiently (remote signer down):
             # retry while the round is still current, else a lone or
             # pivotal validator stalls forever even after the signer
@@ -1191,9 +1735,15 @@ class ConsensusState:
             raise ValueError("app rejected vote extension")
 
     def _commit_own_vote(self, vote: T.Vote) -> None:
-        self._wal_write_msg("vote", VoteMessage(vote), "")
+        ticket = self._wal_write_msg("vote", VoteMessage(vote), "")
         self._try_add_vote(vote, "")
-        self._broadcast("vote", VoteMessage(vote))
+        # WAL-before-act: the group-commit seam defers the BROADCAST
+        # (the externalization that must never precede durability)
+        # until the vote's barrier fsync lands; adding to our own
+        # vote set above is in-memory only and crash-consistent
+        self._after_durable(
+            ticket, lambda: self._broadcast("vote", VoteMessage(vote))
+        )
 
     def _schedule_sign_retry(
         self, type_, block_hash, psh, height: int, round_: int

@@ -92,6 +92,19 @@ class CoalescingVerifier:
         self._inflight.add(t)
         t.add_done_callback(self._inflight.discard)
 
+    def flush(self) -> None:
+        """Dispatch whatever is pending right now (no-op when empty).
+
+        Callers that know the natural batch boundary — the consensus
+        receive loop draining its inbox, a reactor finishing a read
+        burst — flush explicitly instead of waiting out the window
+        timer: on a busy loop the timer callback can starve for tens
+        of milliseconds behind queued work, turning the micro-batch
+        window into real quorum latency. The timer stays as the
+        backstop for callers without such a boundary."""
+        if self._pending:
+            self._flush_now()
+
     async def _window(self) -> None:
         try:
             await asyncio.sleep(self.window_s)

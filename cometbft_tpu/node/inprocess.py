@@ -296,11 +296,28 @@ def make_genesis(
 
 
 class LocalNet:
-    """Fully-connected in-memory delivery between consensus states."""
+    """Fully-connected in-memory delivery between consensus states.
 
-    def __init__(self, nodes: List[NodeParts], drop: Optional[Callable] = None):
+    Delivery is flood-with-dedup plus a CATCH-UP healer (the reactor's
+    gossipDataForCatchup analog): a node whose round state trails a
+    peer's committed height is periodically re-fed that block + commit
+    through the normal commit_block path. The flood alone has no
+    retransmission, so any delivery skew (batched vote windows, WAL
+    group-commit broadcast deferral, loop contention) could strand a
+    node in COMMIT waiting for parts nobody will ever resend — the
+    real p2p reactor heals this with per-peer gossip routines, and the
+    harness must match that delivery contract."""
+
+    def __init__(
+        self,
+        nodes: List[NodeParts],
+        drop: Optional[Callable] = None,
+        heal_interval_s: float = 0.05,
+    ):
         self.nodes = nodes
         self.drop = drop  # (src_idx, dst_idx, kind, payload) -> bool
+        self.heal_interval_s = heal_interval_s
+        self._healer: Optional[asyncio.Task] = None
         for i, n in enumerate(nodes):
             n.cs.add_broadcast_hook(self._make_hook(i))
 
@@ -321,8 +338,57 @@ class LocalNet:
     async def start(self):
         for n in self.nodes:
             await n.cs.start()
+        if self.heal_interval_s > 0 and len(self.nodes) > 1:
+            self._healer = asyncio.create_task(self._heal_loop())
+
+    async def _heal_loop(self):
+        """Re-feed committed blocks to lagging nodes (reference
+        consensus/reactor.go gossipDataForCatchup, harness-sized)."""
+        import traceback
+
+        from ..consensus.reactor import CommitBlockMessage
+
+        while True:
+            await asyncio.sleep(self.heal_interval_s)
+            try:
+                stores = [n.block_store.height() for n in self.nodes]
+                for j, n in enumerate(self.nodes):
+                    h = n.cs.rs.height
+                    for i, m in enumerate(self.nodes):
+                        if i == j or stores[i] < h:
+                            continue
+                        if self.drop and self.drop(
+                            i, j, "commit_block", None
+                        ):
+                            continue
+                        block = m.block_store.load_block(h)
+                        commit = m.block_store.load_seen_commit(
+                            h
+                        ) or m.block_store.load_block_commit(h)
+                        if block is None or commit is None:
+                            continue
+                        try:
+                            n.cs.enqueue_nowait(
+                                "commit_block",
+                                CommitBlockMessage(
+                                    block,
+                                    commit,
+                                    m.block_store.load_extended_commit(h),
+                                ),
+                                f"node{i}",
+                            )
+                        except asyncio.QueueFull:
+                            pass
+                        break
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                traceback.print_exc()
 
     async def stop(self):
+        if self._healer is not None:
+            self._healer.cancel()
+            self._healer = None
         for n in self.nodes:
             # bounded (ASY110): one wedged state machine must not
             # hang the whole test net's teardown

@@ -37,6 +37,7 @@ FIXTURE_PATHS = {
     "ASY107": "cometbft_tpu/trace/x.py",
     "ASY109": "cometbft_tpu/mempool/x.py",
     "ASY110": "cometbft_tpu/p2p/x.py",
+    "ASY111": "cometbft_tpu/consensus/x.py",
 }
 
 
@@ -352,6 +353,21 @@ FIXTURES = [
                 await asyncio.wait({self.task}, timeout=1.0)
             async def run(self):
                 await self.inner.stop()         # not a stop path
+        """,
+    ),
+    (
+        "ASY111",  # direct-fsync-in-hot-plane (FIXTURE_PATHS)
+        """
+        import os
+        def persist(f):
+            f.flush()
+            os.fsync(f.fileno())
+        """,
+        """
+        def persist(self, msg):
+            # barriers route through the WAL group-commit seam
+            self.wal.write_sync(msg)
+            return self.wal.write_group(msg)
         """,
     ),
     (

@@ -267,6 +267,40 @@ def test_workload_tx_stream_is_deterministic():
     assert [d3._next_tx() for _ in range(50)] != s1
 
 
+# --- fast-path slice (ISSUE 11) -----------------------------------------
+
+
+def test_fastpath_matrix_slice_invariant_and_budget_clean(
+    tmp_path, capsys
+):
+    """``chaos matrix --fastpath``: the live-consensus fast path (WAL
+    group commit + in-round vote micro-batching + pipelined finalize,
+    docs/PERF.md) under the seeded fault matrix, beneath the 2ms
+    slow-disk fsync model so the calibrated group seam genuinely
+    engages — gated on the SAME invariants and span budgets as the
+    plain smoke. Proves the fast path fault-clean, not just fast."""
+    from cometbft_tpu.consensus import wal as walmod
+
+    out_json = tmp_path / "fastpath.json"
+    rc = matrix_main(
+        [
+            "--seed", str(SEED), "--count", "2", "--fastpath",
+            "--budget", "--json", str(out_json),
+        ]
+    )
+    printed = capsys.readouterr().out
+    assert rc == 0, printed
+    # the model must be restored no matter what the run did
+    assert walmod._FSYNC_MODEL_S == 0.0
+    with open(out_json) as f:
+        matrix = json.load(f)
+    assert matrix["ok"] and matrix["budget_ok"]
+    assert len(matrix["scenarios"]) == 2
+    for s in matrix["scenarios"]:
+        assert s["ok"] and not s["violations"], s
+        assert s["final_heights"]
+
+
 # --- 5. nightly-sized soak (slow marker) --------------------------------
 
 

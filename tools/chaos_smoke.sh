@@ -57,6 +57,26 @@ echo "== chaos smoke: byzantine corruption must be DETECTED =="
 JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" --byzantine 2 \
     --trace-dump "$TRACE_DIR/byzantine"
 
+echo "== chaos smoke: fast-path slice (group commit + vote batch + pipelined finalize), budget-gated =="
+# the live-consensus fast path (docs/PERF.md) under faults: every
+# node runs WAL group commit + in-round vote micro-batching +
+# pipelined finalize beneath a 2ms slow-disk fsync model (so crashes
+# and torn tails land inside group windows), gated on the SAME
+# invariants + span budgets as the plain matrix — fault-clean, not
+# just fast
+JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos matrix --seed "$SEED" \
+    --count 3 --fastpath --budget --out "$TRACE_DIR/fastpath"
+
+echo "== chaos smoke: fast-path waterfalls must stay complete + budget-clean =="
+# the partition/heal + crash/restart schedule again WITH the fast
+# path on: the changed finalize span shape (docs/TRACE.md) must not
+# break per-height attribution — every committed height still needs
+# a complete proposal->parts->quorum->finalize chain (--strict exits
+# 3 on a gap) and the span budgets still hold (exit 2 on breach)
+JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" --fastpath \
+    --trace-dump "$TRACE_DIR/fastpath_run" --budget
+python -m cometbft_tpu.trace timeline "$TRACE_DIR/fastpath_run" --strict
+
 echo "== chaos smoke: 5-scenario factory matrix, budget-gated =="
 # seeded workload x network x lifecycle matrix (docs/CHAOS.md
 # "Scenario factory"): any 5-window covers crash_wave,
