@@ -604,6 +604,82 @@ def direct_fsync_in_hot_plane(ctx: FileContext) -> List[Finding]:
     return out
 
 
+# Reconnect paths live in the p2p planes (both switch flavors).
+_RECONNECT_PREFIXES = ("cometbft_tpu/p2p/", "cometbft_tpu/lp2p/")
+
+
+def _awaits_dial(loop_node: ast.AST) -> bool:
+    """True when the loop body awaits a dial-ish call (last dotted
+    segment contains "dial": dial, dial_peer, _try_dial, redial)."""
+    for n in walk_in_function(loop_node):
+        if not (
+            isinstance(n, ast.Await) and isinstance(n.value, ast.Call)
+        ):
+            continue
+        name = dotted(n.value.func)
+        if name is not None and "dial" in name.rsplit(".", 1)[-1]:
+            return True
+    return False
+
+
+def _finite_loop(node: ast.AST) -> str | None:
+    """The offending spelling if this loop runs a FINITE attempt
+    schedule: ``for ... in range(...)`` or ``while <counter compare>``
+    (``while True`` is unbounded and fine)."""
+    if isinstance(node, (ast.For, ast.AsyncFor)):
+        it = node.iter
+        if isinstance(it, ast.Call) and dotted(it.func) == "range":
+            return "for ... in range(...)"
+        return None
+    if isinstance(node, ast.While) and isinstance(node.test, ast.Compare):
+        return "while <attempt bound>"
+    return None
+
+
+@rule(
+    "ASY112",
+    "finite-reconnect-give-up",
+    "a bounded attempt loop around a p2p dial that abandons a "
+    "persistent peer when the budget runs out: a healed partition can "
+    "then never re-converge — hand the peer to the reconnect plane's "
+    "slow lane instead (p2p/reconnect.py)",
+)
+def finite_reconnect_give_up(ctx: FileContext) -> List[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if not any(p in path for p in _RECONNECT_PREFIXES):
+        return []
+    out: List[Finding] = []
+    for fn in _async_defs(ctx.tree):
+        # a slow-lane handoff anywhere in the function means the
+        # budget is a LANE TRANSITION, not a give-up — the exact
+        # pattern the reconnect plane's fast lane uses
+        hands_off = any(
+            isinstance(n, ast.Call)
+            and "slow_lane" in (dotted(n.func) or "")
+            for n in walk_in_function(fn)
+        )
+        if hands_off:
+            continue
+        for node in walk_in_function(fn):
+            spelling = _finite_loop(node)
+            if spelling is None or not _awaits_dial(node):
+                continue
+            out.append(
+                Finding(
+                    ctx.path, node.lineno, node.col_offset,
+                    "ASY112", "finite-reconnect-give-up",
+                    f"`{spelling}` dial loop in `async def {fn.name}` "
+                    "gives up on the peer when the budget runs out — "
+                    "a healed partition minority then stays isolated "
+                    "FOREVER (the liveness hole the chaos matrix "
+                    "found); park the peer in the reconnect plane's "
+                    "slow lane (never-give-up sweep) when the fast "
+                    "budget is spent",
+                )
+            )
+    return out
+
+
 @rule(
     "ASY106",
     "nested-event-loop",

@@ -52,7 +52,10 @@ LIFECYCLES = (
     "crash_restart",
 )
 WORKLOADS = ("sustained", "sustained_heavy", "bursty", "large_tx")
-NETWORKS = ("clean", "partition", "asym_loss", "jitter_storm")
+NETWORKS = (
+    "clean", "partition", "asym_loss", "jitter_storm",
+    "reconnect_storm",
+)
 
 
 @dataclass
@@ -188,6 +191,20 @@ def _network_events(
                 link={"loss": 0.0}, symmetric=False,
             ),
         ]
+    if kind == "reconnect_storm":
+        # repeated partition/heal cycles + pong-timeout conn kills on
+        # one victim: the exact compound that used to exhaust the
+        # finite reconnect budget and permanently isolate a healed
+        # minority. The self-healing plane (p2p/reconnect.py) must
+        # re-converge after every heal, inside the p2p.reconnect span
+        # budget.
+        victim = rng.randrange(n_nodes)
+        return [
+            FaultEvent(
+                "reconnect_storm", at_height=2, node=victim,
+                cycles=2, hold_s=1.2, gap_s=0.8,
+            )
+        ]
     if kind == "jitter_storm":
         # latency+jitter on two symmetric links, calmed later; stays
         # well under the propose timeout so rounds keep closing
@@ -232,9 +249,14 @@ def _lifecycle_events(
         ]
     if kind == "statesync_join":
         # join needs a source snapshot (kvstore snapshots every 10
-        # heights) and a healthy net: trigger past height 11
+        # heights): trigger past height 11. A valset-churn leg rides
+        # ahead of the join so the un-pinned compound
+        # (partition x statesync_join x churn) exercises joining into
+        # a net whose validator set changed mid-run.
+        churn_target = rng.randrange(n_nodes)
         return [
-            FaultEvent("statesync_join", at_height=max(h, 11))
+            FaultEvent("valset_churn", at_height=h, node=churn_target),
+            FaultEvent("statesync_join", at_height=max(h + 1, 11)),
         ]
     if kind == "wal_torn_tail":
         victim = rng.randrange(n_nodes)
@@ -292,15 +314,13 @@ def generate_scenario(
             n_nodes = rng.choice([4, 4, 5, 7])
         else:
             n_nodes = 4
-    if lifecycle == "statesync_join":
-        # the joiner bootstraps over p2p + RPC and waits for a
-        # height-11 snapshot, so the run's horizon is long: a
-        # partition minority would have to catch up 10+ heights
-        # against live traffic before the liveness bound — a
-        # compound that starves on a contended 2-vCPU box. The join
-        # axis tests JOINING under load; partitions keep their
-        # coverage on the short-horizon lifecycles.
-        network_kind = "clean"
+    # NOTE: statesync_join used to PIN the network axis to "clean" —
+    # the finite-attempts reconnect gave a partitioned/conn-killed
+    # minority no reliable way back, so join-under-faults starved.
+    # The self-healing plane (p2p/reconnect.py: never-give-up budgeted
+    # redial + incarnation-safe dialing) removed the hole, so
+    # partition x statesync_join x churn now runs un-pinned; the
+    # longer horizon is absorbed by the liveness bound below.
 
     events = _network_events(network_kind, rng, n_nodes)
     last_net_h = max(
@@ -315,6 +335,11 @@ def generate_scenario(
     liveness = 90.0
     if lifecycle == "statesync_join":
         liveness = 120.0  # the join itself waits through discovery
+        if network_kind != "clean":
+            # un-pinned compound (join under network faults): the
+            # faulted horizon is longer — heal-then-catch-up rides on
+            # top of snapshot discovery
+            liveness = 150.0
     return ScenarioSpec(
         master_seed=master_seed,
         index=index,

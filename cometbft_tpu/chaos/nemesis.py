@@ -159,6 +159,38 @@ class Nemesis:
                 "restarted": restarted,
                 "blocksync": ev.blocksync,
             }
+        if ev.action == "conn_kill":
+            net.kill_conns(ev.node, count=ev.count)
+            # trace determinism: record the victim + HOW MANY we asked
+            # for, not the momentary peer set (wall-clock-dependent)
+            return {
+                "node": net.nodes[ev.node].name,
+                "count": ev.count,
+            }
+        if ev.action == "reconnect_storm":
+            # repeated partition/heal cycles + targeted pong-timeout
+            # conn kills: the compound that used to exhaust the finite
+            # reconnect budget and permanently isolate the victim —
+            # the self-healing plane must re-converge after EVERY heal
+            victim = ev.node
+            others = [
+                i for i in range(len(net.nodes)) if i != victim
+            ]
+            for cycle in range(ev.cycles):
+                net.table.partition([
+                    [net.nodes[i].node_id for i in others],
+                    [net.nodes[victim].node_id],
+                ])
+                net.kill_conns(victim)
+                await asyncio.sleep(ev.hold_s)
+                net.table.heal()
+                await asyncio.sleep(ev.gap_s)
+            return {
+                "node": net.nodes[victim].name,
+                "cycles": ev.cycles,
+                "hold_s": ev.hold_s,
+                "gap_s": ev.gap_s,
+            }
         if ev.action == "statesync_join":
             name = await net.statesync_join(via=ev.via)
             return {"joined": name}

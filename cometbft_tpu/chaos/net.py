@@ -96,8 +96,18 @@ class ChaosReport:
     # structural fingerprint: proposer address (hex, short) per
     # committed height on the most advanced node — the same-seed
     # determinism surface (heights/proposers reproduce; wall-clock
-    # latencies do not)
+    # latencies do not). ``rounds`` records each height's commit
+    # round: proposer rotation is a pure function of (valset, height,
+    # round HISTORY), and round counts are the one wall-clock-coupled
+    # input (a round times out when its proposer is mid-crash/restart
+    # on a contended box) — so same-seed comparisons assert proposers
+    # over the prefix where the round histories still agree.
     proposers: Dict[int, str] = field(default_factory=dict)
+    rounds: Dict[int, int] = field(default_factory=dict)
+    # self-healing connectivity plane (docs/CHAOS.md): dials that
+    # failed into the reconnect plane + injected conn kills
+    dial_failures: int = 0
+    conns_killed: int = 0
 
     @property
     def ok(self) -> bool:
@@ -129,6 +139,13 @@ class ChaosReport:
             lines.append(f"VIOLATION: {v}")
         if self.workload:
             lines.append(f"workload: {self.workload}")
+        if self.dial_failures or self.conns_killed:
+            lines.append(
+                "connectivity plane: "
+                f"{self.dial_failures} failed dials handed to "
+                f"reconnect, {self.conns_killed} conns killed by "
+                "injection"
+            )
         if self.shutdown_stalls:
             lines.append(
                 "bounded-shutdown breaches flight-recorded: "
@@ -210,6 +227,10 @@ class ChaosNet:
         self._snapshots: Dict[int, Dict[int, bytes]] = {}
         self._byz_tasks: List[asyncio.Future] = []
         self.stop_guard = None
+        # self-healing plane telemetry: failed dials routed to the
+        # reconnect plane + conns killed by pong-timeout injection
+        self.dial_failures = 0
+        self.conns_killed = 0
 
     # --- node lifecycle -----------------------------------------------
 
@@ -271,16 +292,37 @@ class ChaosNet:
                     break
                 await asyncio.sleep(POLL_S)
 
-    @staticmethod
-    async def _dial(a: ChaosNode, b: ChaosNode) -> None:
+    async def _dial(self, a: ChaosNode, b: ChaosNode) -> None:
         try:
             await a.node.dial(
                 f"{b.node_id}@mem://{b.node_id}", persistent=True
             )
         except asyncio.CancelledError:
             raise
-        except Exception:
-            pass  # partitioned/crashed target: persistent reconnect retries
+        except Exception as e:
+            # partitioned/crashed target: the failed PERSISTENT dial
+            # was handed to the self-healing reconnect plane inside
+            # dial_peer (p2p/reconnect.py note_dial_failure) — verify
+            # that handoff instead of trusting a comment, and count
+            # the failure for the report. schedule() legitimately
+            # no-ops when the peer is ALREADY connected (an inbound
+            # conn raced this failing dial) or banned — only the
+            # none-of-the-above case is a dropped retry.
+            self.dial_failures += 1
+            sw = a.node.switch
+            if not (
+                sw.reconnect.is_scheduled(b.node_id)
+                or b.node_id in sw.peers
+                or b.node_id in sw.banned
+            ):
+                raise AssertionError(
+                    f"failed persistent dial {a.name}->{b.name} was "
+                    "NOT scheduled on the reconnect plane"
+                ) from e
+            _log.debug(
+                "chaos: dial failed, reconnect plane owns the retry",
+                src=a.name, dst=b.name, err=repr(e),
+            )
 
     async def crash(self, idx: int) -> None:
         cn = self.nodes[idx]
@@ -375,7 +417,12 @@ class ChaosNet:
             ],
             "statesync.trust_height": 1,
             "statesync.trust_hash": bytes(trust.hash()).hex(),
-            "statesync.discovery_time_s": 15.0,
+            # discovery exits as soon as ONE snapshot lands, so this
+            # only bounds the FAILURE case — and on a contended box
+            # the joiner's 4 secret-connection handshakes alone can
+            # eat >10s before any peer can even answer, so a short
+            # window misreads load as "no viable snapshots"
+            "statesync.discovery_time_s": 45.0,
             "blocksync.enable": True,
         }
         self.nodes.append(cn)
@@ -457,6 +504,38 @@ class ChaosNet:
             "torn_bytes": appended,
             "was_running": was_running,
         }
+
+    def kill_conns(
+        self,
+        idx: int,
+        count: Optional[int] = None,
+        reason: str = "pong timeout (injected)",
+    ) -> List[str]:
+        """Kill up to ``count`` (None = all) of node ``idx``'s live
+        connections via pong-timeout injection — the conn death a
+        partition's silent blackhole eventually produces, without
+        waiting out ping_interval + pong_timeout. Both ends observe
+        the death (the remote reads a closed conn), so both ends'
+        reconnect planes engage. Deterministic kill order (sorted
+        peer id)."""
+        cn = self.nodes[idx]
+        if cn.node is None:
+            return []
+        killed: List[str] = []
+        for pid in sorted(cn.node.switch.peers):
+            if count is not None and len(killed) >= count:
+                break
+            peer = cn.node.switch.peers.get(pid)
+            if peer is None:
+                continue
+            peer.inject_error(ConnectionError(reason))
+            killed.append(pid)
+        self.conns_killed += len(killed)
+        _log.info(
+            "chaos: injected conn kills",
+            node=cn.name, killed=len(killed), reason=reason,
+        )
+        return killed
 
     def valset_churn(self, idx: int, power: int) -> dict:
         """Submit a validator power-change tx (kvstore
@@ -844,6 +923,9 @@ async def run_schedule(
                         report.proposers[h] = addr_to_name.get(
                             addr, addr[:12]
                         )
+                    commit = store.load_block_commit(h)
+                    if commit is not None:
+                        report.rounds[h] = commit.round
         except Exception:
             pass  # fingerprint is best-effort diagnostics
         if driver is not None:
@@ -854,6 +936,8 @@ async def run_schedule(
             profiler.stop()
         report.stall_records = net.stall_records()
         report.shutdown_stalls = net.shutdown_stall_records()
+        report.dial_failures = net.dial_failures
+        report.conns_killed = net.conns_killed
         if budget_file:
             # evaluated over the in-memory rings so a breach can force
             # the dump below even when no invariant tripped

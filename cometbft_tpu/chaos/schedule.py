@@ -71,6 +71,21 @@ deterministic and fast):
                       then restart it. Recovery must repair the tail
                       (consensus/wal.py truncate_corrupt_tail) and
                       extend the committed prefix unchanged.
+``conn_kill``         ``node=i``: kill up to ``count`` (default: all)
+                      of the node's live connections via pong-timeout
+                      injection (MConnection.inject_error) — the conn
+                      death a silent blackhole eventually produces,
+                      without waiting out ping_interval+pong_timeout.
+                      Persistent-peer reconnect (p2p/reconnect.py)
+                      must heal every kill.
+``reconnect_storm``   ``node=i``: ``cycles`` repetitions of
+                      {partition the victim off, pong-timeout-kill its
+                      conns, hold ``hold_s``, heal, wait ``gap_s``} —
+                      the compound that used to exhaust the finite
+                      reconnect budget and permanently isolate a
+                      healed minority. The self-healing plane must
+                      re-converge after every heal (gated by the
+                      ``p2p.reconnect`` span budget).
 ====================  =================================================
 
 Schedules round-trip through JSON so failing runs can be archived and
@@ -87,7 +102,7 @@ from typing import Dict, List, Optional
 ACTIONS = (
     "partition", "heal", "set_link", "crash", "restart", "byzantine",
     "stall", "crash_wave", "statesync_join", "valset_churn",
-    "wal_torn_tail",
+    "wal_torn_tail", "conn_kill", "reconnect_storm",
 )
 
 
@@ -112,6 +127,10 @@ class FaultEvent:
     power_min: int = 5  # valset_churn: seeded draw range
     power_max: int = 15
     garbage: Optional[int] = None  # wal_torn_tail: torn bytes (seeded)
+    count: Optional[int] = None  # conn_kill: conns to kill (None=all)
+    cycles: int = 2  # reconnect_storm: partition/heal repetitions
+    hold_s: float = 1.2  # reconnect_storm: partition hold per cycle
+    gap_s: float = 0.8  # reconnect_storm: healed gap between cycles
 
     def __post_init__(self):
         if self.action not in ACTIONS:
@@ -124,9 +143,11 @@ class FaultEvent:
             raise ValueError("partition: groups required")
         if self.action in (
             "crash", "restart", "byzantine", "valset_churn",
-            "wal_torn_tail",
+            "wal_torn_tail", "conn_kill", "reconnect_storm",
         ) and self.node is None:
             raise ValueError(f"{self.action}: node required")
+        if self.action == "reconnect_storm" and self.cycles < 1:
+            raise ValueError("reconnect_storm: cycles >= 1 required")
         if self.action == "set_link" and (
             self.src is None or self.dst is None or not self.link
         ):

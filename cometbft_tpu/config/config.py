@@ -75,6 +75,22 @@ class P2PConfig:
     dial_timeout_s: float = 3.0
     use_libp2p_equivalent: bool = False  # fork: lp2p transport selection
     use_autopool: bool = False  # fork: autopool reactor msg draining
+    # --- self-healing connectivity plane (p2p/reconnect.py) -----------
+    # full-jitter backoff for the per-peer fast reconnect lane
+    reconnect_base_s: float = 1.0
+    reconnect_cap_s: float = 30.0
+    # fast-lane dial BUDGET per outage (not a give-up bound: spending
+    # it parks the peer in the never-give-up slow lane)
+    reconnect_fast_attempts: int = 12
+    # slow-lane sweep period: steady-state redial load for peers whose
+    # fast budget is spent
+    reconnect_slow_interval_s: float = 30.0
+    # zero peers for this long = starving (PEX re-learn storm on every
+    # dial success; cometbft_p2p_starvation_seconds accumulates)
+    starvation_s: float = 10.0
+    # RPC health `connectivity` verdict: degraded below this many
+    # peers (once the node has evidence it is meant to be connected)
+    min_peers: int = 1
 
 
 @dataclass

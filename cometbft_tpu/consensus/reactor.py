@@ -54,6 +54,15 @@ MSG_HAS_PART = 0x07
 
 RETRANSMIT_AFTER_S = 0.25
 CATCHUP_RETRANSMIT_S = 1.0
+# periodic NewRoundStep re-announce per peer: step announcements are
+# otherwise only broadcast ON step transitions, so a node whose
+# announcement was lost (partition blackhole, conn churn) leaves every
+# peer's PeerRoundState stale FOREVER if it then wedges in one step —
+# peers keep aiming catch-up at the wrong height and the node can
+# never advance (the healed-minority consensus wedge the chaos
+# compound partition x statesync_join surfaced: peers retransmitted
+# height-2 commits at a node parked in height 3 for 150s+)
+STEP_REANNOUNCE_S = 1.0
 MAX_GOSSIP_VOTES_PER_TICK = 16
 MAX_GOSSIP_PARTS_PER_TICK = 8
 
@@ -305,13 +314,27 @@ class ConsensusReactor(Reactor):
                 if self.wait_sync:
                     continue
                 prs: PeerRoundState = peer.get("prs")
-                if prs is None or prs.height == 0:
-                    continue
                 rs = self.cs.rs
                 now = time.monotonic()
 
                 def due(key, after=RETRANSMIT_AFTER_S) -> bool:
                     return now - sent_at.get(key, 0.0) > after
+
+                # keep the PEER's view of US fresh (STEP_REANNOUNCE_S
+                # above): runs even while we are behind or the peer
+                # never announced — a behind node correcting its
+                # peers' stale view is exactly what re-aims their
+                # catch-up at the right height
+                if due(("nrs",), STEP_REANNOUNCE_S):
+                    sent_at[("nrs",)] = now
+                    peer.try_send(
+                        STATE_CHANNEL,
+                        encode_new_round_step(
+                            rs.height, rs.round, int(rs.step)
+                        ),
+                    )
+                if prs is None or prs.height == 0:
+                    continue
 
                 if prs.height < rs.height:
                     # catch-up: ship whole committed blocks, repeating

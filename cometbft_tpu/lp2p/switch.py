@@ -170,6 +170,12 @@ class Lp2pPeer:
         if self._on_error:
             self._on_error(self, exc)
 
+    def inject_error(self, exc: Exception) -> None:
+        """Chaos hook (parity with p2p.Peer.inject_error): die as if
+        ``exc`` came from a mux routine, driving the switch's
+        on_error -> reconnect path."""
+        self._mux_error(exc)
+
     # --- inbound ------------------------------------------------------
 
     def _on_stream(self, st: MuxStream) -> None:
@@ -249,11 +255,13 @@ class Lp2pSwitch(Switch):
         use_autopool: bool = True,
         send_rate: int = 0,
         recv_rate: int = 0,
+        reconnect_config: Optional[dict] = None,
     ):
         host = Host(transport, rcmgr=rcmgr, gater=gater)
         super().__init__(
             host, node_info, max_peers=max_peers,
             use_autopool=use_autopool,
+            reconnect_config=reconnect_config,
         )
         self.host = host
         self.send_rate = send_rate
@@ -294,6 +302,14 @@ class Lp2pSwitch(Switch):
     async def _remove_peer(self, peer, exc, reconnect=False) -> None:
         present = self.peers.get(peer.peer_id) is peer
         await super()._remove_peer(peer, exc, reconnect)
+        if present:
+            self.host.conn_closed()
+
+    def _evict_peer_sync(self, peer, reason) -> None:
+        # duplicate-resolution loser: release its admission slot like
+        # _remove_peer does, or incarnation churn leaks rcmgr capacity
+        present = self.peers.get(peer.peer_id) is peer
+        super()._evict_peer_sync(peer, reason)
         if present:
             self.host.conn_closed()
 

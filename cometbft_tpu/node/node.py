@@ -77,6 +77,14 @@ class Node:
                 fuzz_config=getattr(config, "fuzz", None),
             )
         self.transport = transport
+        # self-healing connectivity plane knobs ([p2p], README table)
+        reconnect_config = {
+            "base_s": config.p2p.reconnect_base_s,
+            "cap_s": config.p2p.reconnect_cap_s,
+            "fast_attempts": config.p2p.reconnect_fast_attempts,
+            "slow_interval_s": config.p2p.reconnect_slow_interval_s,
+            "starvation_s": config.p2p.starvation_s,
+        }
         if config.p2p.use_libp2p_equivalent:
             # fork feature: alternative stream-multiplexed switcher
             # (reference lp2p selection at node/node.go:476-575)
@@ -88,6 +96,7 @@ class Node:
                 send_rate=config.p2p.send_rate,
                 recv_rate=config.p2p.recv_rate,
                 use_autopool=config.p2p.use_autopool,
+                reconnect_config=reconnect_config,
             )
         else:
             self.switch = Switch(
@@ -99,7 +108,9 @@ class Node:
                     "flush_throttle_s": config.p2p.flush_throttle_ms / 1000.0,
                 },
                 use_autopool=config.p2p.use_autopool,
+                reconnect_config=reconnect_config,
             )
+        self.switch.min_peers = config.p2p.min_peers
 
         blocksync_active = config.blocksync.enable and not config.statesync.enable
         adaptive = config.blocksync.adaptive_sync
@@ -149,6 +160,9 @@ class Node:
         for seed in (config.p2p.seeds or "").split(","):
             if seed.strip():
                 self.addr_book.add_address(seed.strip())
+        # the reconnect plane consults the book for re-learned
+        # addresses and records dial/conn failures into it
+        self.switch.addr_book = self.addr_book
         self.pex_reactor = (
             PexReactor(
                 self.addr_book,

@@ -7,7 +7,8 @@ Parity map (reference -> here):
 - p2p/conn/connection.go  -> conn/connection.py (MConnection)
 - p2p/transport.go        -> transport.py (TCP + in-memory)
 - p2p/peer.go             -> peer.py
-- p2p/switch.go           -> switch.py
+- p2p/switch.go           -> switch.py (+ reconnect.py: the
+  self-healing never-give-up redial plane, fork addition)
 - p2p/base_reactor.go     -> reactor.py
 - p2p/pex/                -> pex.py (addrbook + PEX reactor)
 """
@@ -16,6 +17,7 @@ from .key import NodeKey, node_id_from_pubkey
 from .node_info import ChannelDescriptor, NodeInfo
 from .peer import Peer
 from .reactor import Reactor
+from .reconnect import ReconnectPlane
 from .switch import Switch
 from .transport import MemoryTransport, TCPTransport
 
@@ -26,6 +28,7 @@ __all__ = [
     "ChannelDescriptor",
     "Peer",
     "Reactor",
+    "ReconnectPlane",
     "Switch",
     "TCPTransport",
     "MemoryTransport",

@@ -164,6 +164,16 @@ class MConnection:
         except Exception:
             pass
 
+    def inject_error(self, exc: Exception) -> None:
+        """Fault-injection hook (chaos ``reconnect_storm`` /
+        ``conn_kill``): kill the connection exactly the way an
+        internal routine failure does — e.g. a pong timeout
+        (``_ping_routine``) — driving the owner's on_error path and,
+        for persistent peers, the self-healing reconnect plane. The
+        remote side observes the close as a read error, so BOTH ends
+        exercise their conn-death handling."""
+        self._die(exc)
+
     def _die(self, exc: Exception) -> None:
         if self._closed:
             return

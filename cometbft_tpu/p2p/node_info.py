@@ -9,6 +9,7 @@ identity (pubkey from the secret connection) must match the dialed ID.
 from __future__ import annotations
 
 import json
+import secrets
 from dataclasses import dataclass, field
 from typing import List
 
@@ -20,6 +21,16 @@ class ChannelDescriptor:
     max_msg_size: int = 10 * 1024 * 1024
 
 
+def _new_incarnation() -> str:
+    """Per-process handshake nonce: one draw per constructed NodeInfo,
+    so every incarnation of a node (each restart builds a fresh
+    NodeInfo) advertises a distinct value. The switch's duplicate-conn
+    resolution keys on (node id, incarnation) — a restarted remote's
+    fresh dial must never be dup-discarded against its previous life's
+    zombie entry."""
+    return secrets.token_hex(8)
+
+
 @dataclass
 class NodeInfo:
     node_id: str
@@ -29,6 +40,9 @@ class NodeInfo:
     channels: List[int] = field(default_factory=list)
     moniker: str = ""
     rpc_address: str = ""
+    # incarnation-safe dialing (p2p/switch.py _new_conn_wins); ""
+    # on DECODED info from a peer that predates the field
+    incarnation: str = field(default_factory=_new_incarnation)
 
     def encode(self) -> bytes:
         return json.dumps(
@@ -40,6 +54,7 @@ class NodeInfo:
                 "channels": self.channels,
                 "moniker": self.moniker,
                 "rpc_address": self.rpc_address,
+                "incarnation": self.incarnation,
             }
         ).encode()
 
@@ -54,6 +69,7 @@ class NodeInfo:
             channels=list(d.get("channels", [])),
             moniker=d.get("moniker", ""),
             rpc_address=d.get("rpc_address", ""),
+            incarnation=d.get("incarnation", ""),
         )
 
     def compatible_with(self, other: "NodeInfo") -> None:

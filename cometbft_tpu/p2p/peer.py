@@ -71,6 +71,11 @@ class Peer:
         MConnection.abort."""
         self.mconn.abort()
 
+    def inject_error(self, exc: Exception) -> None:
+        """Chaos hook: die as if ``exc`` came from a conn routine
+        (e.g. an injected pong timeout) — see MConnection.inject_error."""
+        self.mconn.inject_error(exc)
+
     # --- messaging ----------------------------------------------------
 
     async def send(self, chan_id: int, msg: bytes) -> bool:

@@ -35,6 +35,13 @@ CRAWL_INTERVAL_S = 5.0
 REQUEST_INTERVAL_S = 30.0
 MAX_ATTEMPTS = 10
 MAX_BOOK_SIZE = 5000  # reference addrbook bucket caps analog
+# persisted attempt counters age out: an address whose LAST attempt
+# is older than this reloads with a clean counter — without
+# forgiveness, a never-connected entry that crossed MAX_ATTEMPTS
+# would be is_bad FOREVER across restarts (excluded from crawl and
+# selection, re-learnable only by an inbound conn). The pre-persist
+# behavior got this for free by losing the counters entirely.
+FORGIVE_AFTER_S = 3600.0
 
 
 @dataclass
@@ -45,6 +52,10 @@ class KnownAddress:
     last_attempt: float = 0.0
     last_success: float = 0.0
     is_old: bool = False  # promoted after a successful connection
+    # conn-death bookkeeping (the self-healing plane records a
+    # failure on every dial failure AND every conn death)
+    failures: int = 0
+    last_failure: float = 0.0
 
     @property
     def peer_id(self) -> str:
@@ -81,6 +92,18 @@ class AddrBook:
             return True
         if not ka.is_old and addr != ka.addr:
             ka.addr = addr  # newer routing info for a NEW address
+            ka.attempts = 0  # a fresh address deserves fresh dials
+        elif (
+            ka.is_old
+            and addr != ka.addr
+            and ka.last_failure > ka.last_success
+        ):
+            # the PROVEN address is now failing (conn died / dials
+            # miss): re-learned routing info wins, or a moved peer's
+            # stale entry would shadow its new address forever and
+            # the reconnect plane would redial the dead one
+            ka.addr = addr
+            ka.attempts = 0
         return False
 
     def _evict_one(self) -> None:
@@ -109,9 +132,25 @@ class AddrBook:
         if ka is None and addr:
             ka = self.addrs[peer_id] = KnownAddress(addr=addr)
         if ka:
+            if addr and ka.addr != addr:
+                # a LIVE connection at this address is the strongest
+                # routing evidence there is — it beats any older entry
+                ka.addr = addr
             ka.attempts = 0
             ka.last_success = time.time()
             ka.is_old = True
+
+    def mark_failed(self, peer_id: str, addr: str = "") -> None:
+        """A dial failed or a live conn died (the reconnect plane's
+        conn-death hook). Creates the entry when ``addr`` is given so
+        a persistent peer that was never PEX-learned still accumulates
+        health history."""
+        ka = self.addrs.get(peer_id)
+        if ka is None and addr:
+            ka = self.addrs[peer_id] = KnownAddress(addr=addr)
+        if ka:
+            ka.failures += 1
+            ka.last_failure = time.time()
 
     def remove(self, peer_id: str) -> None:
         self.addrs.pop(peer_id, None)
@@ -148,13 +187,20 @@ class AddrBook:
     def save(self) -> None:
         if not self.path:
             return
+        # the FULL bookkeeping persists: a restarted node's reconnect
+        # plane and crawl biasing resume from real dial history, not a
+        # wiped slate (attempts/last_attempt previously evaporated
+        # across restarts, resetting pick_to_dial's backoff gating)
         data = [
             {
                 "addr": a.addr,
                 "src": a.src,
                 "attempts": a.attempts,
+                "last_attempt": a.last_attempt,
                 "last_success": a.last_success,
                 "is_old": a.is_old,
+                "failures": a.failures,
+                "last_failure": a.last_failure,
             }
             for a in self.addrs.values()
         ]
@@ -168,14 +214,23 @@ class AddrBook:
         try:
             with open(self.path) as f:
                 data = json.load(f)
+            now = time.time()
             for d in data.get("addrs", []):
                 ka = KnownAddress(
                     addr=d["addr"],
                     src=d.get("src", ""),
                     attempts=d.get("attempts", 0),
+                    last_attempt=d.get("last_attempt", 0.0),
                     last_success=d.get("last_success", 0.0),
                     is_old=d.get("is_old", False),
+                    failures=d.get("failures", 0),
+                    last_failure=d.get("last_failure", 0.0),
                 )
+                if now - ka.last_attempt > FORGIVE_AFTER_S:
+                    # aged-out failure history (FORGIVE_AFTER_S):
+                    # the entry gets a fresh chance; failures/
+                    # last_failure stay for diagnostics
+                    ka.attempts = 0
                 self.addrs[ka.peer_id] = ka
         except Exception:
             traceback.print_exc()
@@ -231,7 +286,15 @@ class PexReactor(Reactor):
         now = time.monotonic()
         if now - self._last_request.get(peer.peer_id, 0) < REQUEST_INTERVAL_S:
             return
-        self._last_request[peer.peer_id] = now
+        self.request_now(peer)
+
+    def request_now(self, peer) -> None:
+        """Rate-limit-bypassing address request: the switch calls this
+        on every dial success while the node is STARVING (zero peers
+        past the starvation threshold) so a rejoining minority
+        re-learns moved/healed addresses immediately instead of
+        waiting out REQUEST_INTERVAL_S."""
+        self._last_request[peer.peer_id] = time.monotonic()
         self._requested.add(peer.peer_id)
         peer.try_send(PEX_CHANNEL, bytes([MSG_PEX_REQUEST]))
 

@@ -2,8 +2,17 @@
 
 Responsibilities (mirroring the reference):
 - accept loop: upgraded inbound conns -> add_peer
-- dial_peers_async with exponential-backoff reconnect for persistent
-  peers (reference switch.go reconnectToPeer)
+- dial_peers_async with persistent-peer redial handed to the
+  self-healing ReconnectPlane (p2p/reconnect.py): budgeted full-jitter
+  fast lane + never-give-up slow-lane sweep (the reference's
+  reconnectToPeer gave up after a finite budget; ours cannot — a
+  healed partition must always converge)
+- incarnation-safe dial dedup: duplicate conns are resolved on
+  (node id, incarnation) — a restarted remote's fresh dial EVICTS the
+  zombie entry (sync abort, the PR 10 floor) instead of being
+  dup-discarded against it, and simultaneous cross-dials resolve
+  deterministically (the conn whose dialer has the lower node id wins
+  on both ends; the loser's conn is closed synchronously)
 - channel routing: every complete MConnection message is dispatched to
   the reactor that registered its channel
 - stop_peer_for_error: the single choke point reactors use to drop a
@@ -14,24 +23,29 @@ Responsibilities (mirroring the reference):
 from __future__ import annotations
 
 import asyncio
+import time
 import traceback
 from typing import Dict, List, Optional
 
 from ..trace import NOOP as TRACE_NOOP
-from ..utils.backoff import Backoff
 from ..utils.log import get_logger
 from ..utils.tasks import spawn
 from . import tracewire
 from .node_info import ChannelDescriptor, NodeInfo
 from .peer import Peer
 from .reactor import Reactor
+from .reconnect import ReconnectPlane
 
 _log = get_logger("p2p")
 
-RECONNECT_BASE_S = 1.0
-RECONNECT_MAX_S = 30.0
-MAX_RECONNECT_ATTEMPTS = 20
 DEFAULT_MAX_PEERS = 50
+# health connectivity verdict default: degraded below this many peers
+# (only once the node has evidence it is MEANT to be connected)
+DEFAULT_MIN_PEERS = 1
+# duplicate-conn resolution: a conn OLDER than this facing a fresh
+# opposite-dialer conn is not in a simultaneous dial race — the fresh
+# conn is a redial against our (one-sided-dead) entry and wins
+CROSS_DIAL_WINDOW_S = 5.0
 
 
 class Switch:
@@ -42,6 +56,7 @@ class Switch:
         max_peers: int = DEFAULT_MAX_PEERS,
         mconn_config: Optional[dict] = None,
         use_autopool: bool = False,
+        reconnect_config: Optional[dict] = None,
     ):
         # fork feature: reactor messages can be drained by an
         # auto-scaling worker pool (reference lp2p/reactor_set.go +
@@ -60,8 +75,16 @@ class Switch:
         self.max_peers = max_peers
         self.mconn_config = mconn_config or {}
         self._accept_task: Optional[asyncio.Task] = None
-        self._reconnect_tasks: Dict[str, asyncio.Task] = {}
         self._stopped = False
+        # self-healing connectivity plane (p2p/reconnect.py): owns all
+        # persistent-peer redial; Lp2pSwitch inherits it unchanged
+        self.reconnect = ReconnectPlane(self, **(reconnect_config or {}))
+        # PEX address book, set by node wiring when PEX is on: the
+        # reconnect plane consults it for re-learned addresses and
+        # records dial failures into it
+        self.addr_book = None
+        # health connectivity verdict floor (rpc/core.health)
+        self.min_peers = DEFAULT_MIN_PEERS
         # tracing plane (trace/): node wiring swaps in the per-node
         # tracer; peer-count changes land as counter events
         self.tracer = TRACE_NOOP
@@ -102,6 +125,7 @@ class Switch:
         for r in self.reactors.values():
             await r.start()
         self._accept_task = asyncio.create_task(self._accept_routine())
+        self.reconnect.start()
 
     async def stop(self) -> None:
         # every await is bounded (ASY110): one wedged reactor/peer/
@@ -116,8 +140,7 @@ class Switch:
                 pass
         if self._accept_task:
             self._accept_task.cancel()
-        for t in self._reconnect_tasks.values():
-            t.cancel()
+        self.reconnect.stop()
         for r in self.reactors.values():
             try:
                 # 12s: strictly ABOVE the largest per-plane bound a
@@ -164,9 +187,7 @@ class Switch:
         self._stopped = True
         if self._accept_task:
             self._accept_task.cancel()
-        for t in self._reconnect_tasks.values():
-            t.cancel()
-        self._reconnect_tasks.clear()
+        self.reconnect.stop()
         for p in list(self.peers.values()):
             for r in self.reactors.values():
                 try:
@@ -205,11 +226,24 @@ class Switch:
                 await asyncio.sleep(0.1)
                 continue
             if (
-                len(self.peers) >= self.max_peers
-                or their_info.node_id in self.peers
-                or their_info.node_id in self.banned
+                their_info.node_id in self.banned
                 or their_info.node_id == self.node_info.node_id
             ):
+                self._discard_conn(sconn)
+                continue
+            existing = self.peers.get(their_info.node_id)
+            if existing is not None:
+                # incarnation-safe dedup: the duplicate may be the
+                # LIVE conn (restarted remote, cross-dial winner)
+                if self._new_conn_wins(existing, their_info, inbound=True):
+                    self._evict_peer_sync(
+                        existing,
+                        ConnectionError("superseded by newer conn"),
+                    )
+                else:
+                    self._discard_conn(sconn)
+                    continue
+            elif len(self.peers) >= self.max_peers:
                 self._discard_conn(sconn)
                 continue
             self._make_peer(sconn, their_info, conn_str, outbound=False)
@@ -232,14 +266,23 @@ class Switch:
             )
         except Exception as e:
             if persistent and peer_id:
-                self._schedule_reconnect(peer_id)
+                # hand the retry to the self-healing plane (counted;
+                # never given up on)
+                self.reconnect.note_dial_failure(peer_id)
             raise e
         if their_info.node_id == self.node_info.node_id:
             self._discard_conn(sconn)
             raise ValueError("dialed own address (self-connection)")
-        if their_info.node_id in self.peers:
-            self._discard_conn(sconn)
-            return self.peers[their_info.node_id]
+        existing = self.peers.get(their_info.node_id)
+        if existing is not None:
+            if self._new_conn_wins(existing, their_info, inbound=False):
+                self._evict_peer_sync(
+                    existing,
+                    ConnectionError("superseded by newer conn"),
+                )
+            else:
+                self._discard_conn(sconn)
+                return existing
         return self._make_peer(
             sconn, their_info, conn_str, outbound=True, persistent=persistent
         )
@@ -256,7 +299,75 @@ class Switch:
         except asyncio.CancelledError:
             raise
         except Exception:
-            pass  # dial errors are expected; reconnect logic retries
+            pass  # dial errors are expected; the reconnect plane owns
+            # the retry (dial_peer already routed the failure there)
+
+    # --- duplicate-conn resolution ------------------------------------
+
+    def _new_conn_wins(
+        self, existing: Peer, their_info, inbound: bool
+    ) -> bool:
+        """Deterministic duplicate resolution keyed on
+        (node id, incarnation):
+
+        - different incarnation → the registered peer is a previous
+          life of the remote (its conn may be a zombie the abort floor
+          has not reaped yet): the NEW conn always wins, so a
+          restarted node's dials are never dup-discarded against a
+          stale entry;
+        - same incarnation, same dialer → a REDIAL: the origin only
+          dials again because its end of the old conn is already dead
+          (our side may not have processed the EOF yet), so the new
+          conn wins — dup-discarding it would throw away the redial
+          against a conn that is about to die anyway;
+        - same incarnation, opposite dialers, EXISTING conn long
+          established → also a redial: the remote's end of the old
+          conn died one-sided (we have not noticed yet), so its fresh
+          dial wins — the tiebreak below must not keep discarding
+          legitimate redials in favor of a zombie until the pong
+          timeout reaps it;
+        - same incarnation, opposite dialers, both young →
+          simultaneous cross-dial: the conn whose DIALER has the
+          lower node id wins, evaluated identically on both ends
+          (each end keeps the same one connection and closes the
+          other synchronously)."""
+        new_inc = getattr(their_info, "incarnation", "")
+        old_inc = getattr(existing.node_info, "incarnation", "")
+        if new_inc and old_inc and new_inc != old_inc:
+            return True
+        me = self.node_info.node_id
+        them = their_info.node_id
+        new_dialer = them if inbound else me
+        old_dialer = me if existing.outbound else them
+        if new_dialer == old_dialer:
+            return True
+        established = getattr(existing, "established_at", 0.0)
+        if time.monotonic() - established > CROSS_DIAL_WINDOW_S:
+            return True  # not a dial race: the remote REDIALED
+        return new_dialer < old_dialer
+
+    def _evict_peer_sync(self, peer: Peer, reason: Exception) -> None:
+        """Synchronous removal of a duplicate-resolution loser: the
+        conn must be DEAD before the replacement registers (never
+        awaits — same floor as abort())."""
+        if self.peers.get(peer.peer_id) is peer:
+            del self.peers[peer.peer_id]
+            self.tracer.counter("p2p.peers", len(self.peers), tid="p2p")
+        _log.info(
+            "evicted duplicate peer conn",
+            peer=peer.peer_id[:12],
+            reason=str(reason),
+            outbound=peer.outbound,
+        )
+        for r in self.reactors.values():
+            try:
+                r.remove_peer(peer, reason)
+            except Exception:
+                traceback.print_exc()
+        try:
+            peer.abort()
+        except Exception:
+            pass
 
     # --- peer management ----------------------------------------------
 
@@ -267,7 +378,8 @@ class Switch:
 
     def _register_peer(self, peer) -> None:
         """Shared tail of peer construction: register, start, announce
-        to reactors."""
+        to reactors, feed the self-healing plane."""
+        peer.established_at = time.monotonic()
         self.peers[peer.peer_id] = peer
         self.tracer.counter("p2p.peers", len(self.peers), tid="p2p")
         _log.info(
@@ -277,12 +389,25 @@ class Switch:
             outbound=peer.outbound,
             total=len(self.peers),
         )
+        was_starving = self.reconnect.on_peer_connected(peer)
+        if self.addr_book is not None and peer.node_info.listen_addr:
+            self.addr_book.mark_good(
+                peer.peer_id,
+                f"{peer.peer_id}@{peer.node_info.listen_addr}",
+            )
         peer.start()
         for r in self.reactors.values():
             try:
                 r.add_peer(peer)
             except Exception:
                 traceback.print_exc()
+        if was_starving:
+            # starvation exit: re-learn moved/healed addresses NOW —
+            # a rejoining minority must not wait out the PEX crawl
+            # interval to find where everyone went
+            pex = self.reactors.get("pex")
+            if pex is not None and hasattr(pex, "request_now"):
+                pex.request_now(peer)
 
     def _make_peer(
         self, sconn, their_info, conn_str, outbound, persistent=False
@@ -371,46 +496,16 @@ class Switch:
             except Exception:
                 traceback.print_exc()
         await peer.stop()
-        if reconnect and peer.persistent and not self._stopped:
-            self._schedule_reconnect(peer.peer_id)
+        if not self._stopped:
+            self.reconnect.on_peer_removed(peer, had_error=reconnect)
 
     def ban_peer(self, peer_id: str) -> None:
         _log.info("banned peer", peer=peer_id[:12])
         self.banned.add(peer_id)
+        self.reconnect.abandon(peer_id)  # the one sanctioned give-up
         p = self.peers.get(peer_id)
         if p:
             spawn(self._remove_peer(p, None))
-
-    def _schedule_reconnect(self, peer_id: str) -> None:
-        if peer_id in self._reconnect_tasks or self._stopped:
-            return
-        addr = self.persistent_addrs.get(peer_id)
-        if not addr:
-            return
-
-        async def routine():
-            try:
-                # shared backoff policy (utils/backoff.py): exponential
-                # with full jitter, capped — also the Lp2pSwitch
-                # reconnect path, which inherits this routine
-                backoff = Backoff(
-                    base_s=RECONNECT_BASE_S, cap_s=RECONNECT_MAX_S
-                )
-                for _ in range(MAX_RECONNECT_ATTEMPTS):
-                    await asyncio.sleep(backoff.next_delay())
-                    if self._stopped or peer_id in self.peers:
-                        return
-                    try:
-                        await self.dial_peer(addr, peer_id)
-                        return
-                    except asyncio.CancelledError:
-                        raise
-                    except Exception:
-                        pass  # dial failed; next attempt backs off further
-            finally:
-                self._reconnect_tasks.pop(peer_id, None)
-
-        self._reconnect_tasks[peer_id] = asyncio.create_task(routine())
 
     # --- broadcast / trace stamping -----------------------------------
 

@@ -138,6 +138,44 @@ def health(env) -> Dict[str, Any]:
             maxsize = int(s.get("maxsize", 0) or 0)
             if maxsize and int(s.get("depth", 0)) >= maxsize:
                 reasons.append(f"queue {name} is full ({maxsize})")
+    sw = env.switch
+    if sw is not None and hasattr(sw, "num_peers"):
+        # connectivity verdict (self-healing plane, p2p/reconnect.py):
+        # degraded below min_peers — but only once the node has
+        # evidence it is MEANT to be connected (persistent peers
+        # configured, addresses learned, or a peer ever lost); a
+        # single-node net with nothing to dial stays ok
+        n = sw.num_peers()
+        min_peers = getattr(sw, "min_peers", 1)
+        conn: Dict[str, Any] = {"n_peers": n, "min_peers": min_peers}
+        plane = getattr(sw, "reconnect", None)
+        if plane is not None:
+            st = plane.stats()
+            conn.update(st)
+            expects_peers = plane.expects_peers()
+        else:
+            expects_peers = bool(getattr(sw, "persistent_addrs", None))
+        conn_reasons: List[str] = []
+        if expects_peers and n < min_peers:
+            detail = ""
+            if plane is not None:
+                detail = (
+                    f" (reconnect: {st['fast_lane']} fast-lane, "
+                    f"{st['slow_lane']} slow-lane, "
+                    f"{st['attempts_total']} attempts)"
+                )
+            conn_reasons.append(
+                f"connectivity: {n}/{min_peers} peers connected"
+                + detail
+            )
+        if plane is not None and plane.starving():
+            conn_reasons.append(
+                "connectivity: starving — zero peers for "
+                f"{st['starving_for_s']}s"
+            )
+        conn["status"] = "degraded" if conn_reasons else "ok"
+        out["connectivity"] = conn
+        reasons.extend(conn_reasons)
     bd = getattr(env.consensus_state, "last_commit_breakdown", None)
     if bd is not None:
         # per-phase attribution of the last committed height (ISSUE 7

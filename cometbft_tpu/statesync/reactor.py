@@ -81,15 +81,32 @@ class StateSyncReactor(Reactor):
             state_provider,
             request_chunk=self._request_chunk,
             discovery_time_s=discovery_time_s,
+            # the syncer re-broadcasts while its pool is empty (a
+            # rejected/timed-out snapshot must not idle out the whole
+            # discovery window when peers hold newer snapshots)
+            request_snapshots=self._broadcast_request,
         )
         # ask everyone we know for their snapshots
-        self.switch.broadcast(
-            SNAPSHOT_CHANNEL, bytes([MSG_SNAPSHOTS_REQUEST])
-        )
-        state, commit = await self.syncer.sync_any()
+        self._broadcast_request()
+        try:
+            state, commit = await self.syncer.sync_any()
+        finally:
+            # resolve every in-flight chunk wait on the way out
+            # (success, failure or CANCELLATION): an abandoned
+            # `await fut` in _request_chunk would otherwise hold its
+            # fetcher task alive forever — the leaked-task wedge a
+            # cancelled chaos scenario exposed in asyncio.run cleanup
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_result(None)
         state_store.bootstrap(state)
         block_store.save_seen_commit(state.last_block_height, commit)
         return state
+
+    def _broadcast_request(self) -> None:
+        self.switch.broadcast(
+            SNAPSHOT_CHANNEL, bytes([MSG_SNAPSHOTS_REQUEST])
+        )
 
     async def _request_chunk(self, peer_id, height, format_, index):
         peer = self.switch.peers.get(peer_id)

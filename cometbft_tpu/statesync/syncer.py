@@ -22,6 +22,12 @@ from .chunks import ChunkQueue
 _log = get_logger("statesync")
 
 DISCOVERY_SLEEP_S = 0.3
+# while the pool is EMPTY, re-broadcast the snapshot request this
+# often: advertisements are one-shot per request, so after a rejected
+# or timed-out snapshot attempt drains the pool, a syncer that never
+# re-asks would idle out the whole discovery window even though its
+# peers hold (by now newer) snapshots
+REDISCOVERY_INTERVAL_S = 2.0
 CHUNK_TIMEOUT_S = 10.0
 MAX_CHUNK_FETCHERS = 4
 # chunk-request retry backoff (utils/backoff.py full jitter): fast
@@ -52,12 +58,16 @@ class SnapshotPool:
     """Advertised snapshots and which peers can serve them."""
 
     snapshots: Dict[SnapshotKey, Set[str]] = field(default_factory=dict)
+    # every advertisement ever received (diagnostics: distinguishes
+    # "nothing discovered" from "everything rejected")
+    discovered_total: int = 0
 
     def add(self, peer_id: str, snap: abci.Snapshot) -> None:
         key = SnapshotKey(
             snap.height, snap.format, snap.chunks, bytes(snap.hash)
         )
         self.snapshots.setdefault(key, set()).add(peer_id)
+        self.discovered_total += 1
 
     def remove_peer(self, peer_id: str) -> None:
         for peers in self.snapshots.values():
@@ -85,10 +95,12 @@ class Syncer:
         discovery_time_s: float = 5.0,
         chunk_timeout_s: float = CHUNK_TIMEOUT_S,
         rng: Optional[random.Random] = None,
+        request_snapshots: Optional[Callable] = None,  # () -> None
     ):
         self.proxy = proxy
         self.provider = state_provider
         self.request_chunk = request_chunk
+        self.request_snapshots = request_snapshots
         self.pool = SnapshotPool()
         self.discovery_time_s = discovery_time_s
         self.chunk_timeout_s = chunk_timeout_s
@@ -104,16 +116,27 @@ class Syncer:
 
     async def sync_any(self):
         """Try snapshots until one applies. Returns (state, commit)."""
-        deadline = (
-            asyncio.get_running_loop().time() + self.discovery_time_s
-        )
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.discovery_time_s
+        last_request = loop.time()  # the caller just broadcast one
         while True:
             pick = self.pool.best()
             if pick is None:
-                if asyncio.get_running_loop().time() > deadline:
+                now = loop.time()
+                if now > deadline:
                     raise SyncError(
-                        "no viable snapshots discovered in time"
+                        "no viable snapshots discovered in time "
+                        f"(advertisements={self.pool.discovered_total}"
+                        f", rejected={len(self.banned_snapshots)})"
                     )
+                if (
+                    self.request_snapshots is not None
+                    and now - last_request >= REDISCOVERY_INTERVAL_S
+                ):
+                    # re-ask: a rejected/timed-out attempt drained the
+                    # pool; peers hold (by now newer) snapshots
+                    last_request = now
+                    self.request_snapshots()
                 await asyncio.sleep(DISCOVERY_SLEEP_S)
                 continue
             key, peers = pick
@@ -122,10 +145,22 @@ class Syncer:
                 continue
             try:
                 return await self._sync_one(key, peers)
-            except SnapshotRejected:
+            except SnapshotRejected as e:
+                # logged: a run that ends in "no viable snapshots"
+                # after REJECTING offers is a different failure than
+                # one that never discovered any — the error text alone
+                # cannot tell them apart
+                _log.error(
+                    "snapshot rejected",
+                    height=key.height,
+                    err=repr(e),
+                )
                 self.banned_snapshots.add(key.hash)
                 self.pool.reject(key)
             except asyncio.TimeoutError:
+                _log.error(
+                    "snapshot attempt timed out", height=key.height
+                )
                 self.pool.reject(key)
 
     async def _sync_one(self, key: SnapshotKey, peers: Set[str]):

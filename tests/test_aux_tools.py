@@ -126,13 +126,23 @@ def test_latency_report_math():
 
 
 def test_all_stacks_and_heap():
+    import tracemalloc
+
     from cometbft_tpu.utils.debug import all_stacks, heap_stats
 
     out = all_stacks()
     assert "thread MainThread" in out
-    heap_stats()  # starts tracing
-    out = heap_stats()
-    assert "current=" in out
+    try:
+        heap_stats()  # starts tracing
+        out = heap_stats()
+        assert "current=" in out
+    finally:
+        # tracemalloc left tracing would tax EVERY allocation for the
+        # REST of the suite (this file runs third alphabetically): it
+        # measurably starved the chaos scenarios' event loops — loop
+        # lag p50 jumped ~70ms and the statesync-join compound blew
+        # its liveness budgets
+        tracemalloc.stop()
 
 
 def test_debug_server_endpoints():

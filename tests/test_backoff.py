@@ -1,6 +1,7 @@
 """utils/backoff.py: the one shared reconnect/retry backoff policy
-(exponential + full jitter + cap) used by p2p.Switch._schedule_reconnect
-and, via inheritance, the Lp2pSwitch reconnect path."""
+(exponential + full jitter + cap) used by the p2p self-healing
+reconnect plane (p2p/reconnect.py) and, via inheritance, the
+Lp2pSwitch reconnect path."""
 
 import random
 
@@ -50,14 +51,24 @@ def test_rejects_nonsense_parameters():
 
 
 def test_switch_reconnect_uses_shared_backoff():
-    """The reconnect routine must construct the shared Backoff (no
-    second hand-rolled schedule); both switch flavors share the
-    routine by inheritance."""
+    """The reconnect plane must construct the shared Backoff (no
+    second hand-rolled schedule); both switch flavors share the plane
+    by inheritance (Lp2pSwitch subclasses Switch, which owns a
+    ReconnectPlane)."""
     import inspect
 
     from cometbft_tpu.lp2p.switch import Lp2pSwitch
+    from cometbft_tpu.p2p.reconnect import ReconnectPlane
     from cometbft_tpu.p2p.switch import Switch
 
-    src = inspect.getsource(Switch._schedule_reconnect)
+    src = inspect.getsource(ReconnectPlane._backoff_for)
     assert "Backoff(" in src
-    assert Lp2pSwitch._schedule_reconnect is Switch._schedule_reconnect
+    # one plane implementation for both switch flavors
+    assert "reconnect" not in vars(Lp2pSwitch), (
+        "Lp2pSwitch must inherit the Switch reconnect plane, not "
+        "carry its own"
+    )
+    for name in ("_schedule_reconnect",):
+        assert not hasattr(Switch, name), (
+            "the old finite-attempts reconnect routine is gone"
+        )

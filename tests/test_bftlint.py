@@ -38,6 +38,7 @@ FIXTURE_PATHS = {
     "ASY109": "cometbft_tpu/mempool/x.py",
     "ASY110": "cometbft_tpu/p2p/x.py",
     "ASY111": "cometbft_tpu/consensus/x.py",
+    "ASY112": "cometbft_tpu/p2p/x.py",
 }
 
 
@@ -353,6 +354,44 @@ FIXTURES = [
                 await asyncio.wait({self.task}, timeout=1.0)
             async def run(self):
                 await self.inner.stop()         # not a stop path
+        """,
+    ),
+    (
+        "ASY112",  # finite-reconnect-give-up (FIXTURE_PATHS)
+        """
+        import asyncio
+        class Switch:
+            async def _reconnect_routine(self, peer_id, addr):
+                for _ in range(20):
+                    await asyncio.sleep(1.0)
+                    try:
+                        await self.dial_peer(addr, peer_id)
+                        return
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        continue
+        """,
+        """
+        import asyncio
+        class Plane:
+            async def _fast_routine(self, peer_id):
+                attempt = 0
+                while attempt < self.fast_attempts:
+                    await asyncio.sleep(0.1)
+                    attempt += 1
+                    if await self._try_dial(peer_id):
+                        return
+                # budget spent = LANE TRANSITION, not a give-up
+                self._park_slow_lane(peer_id)
+            async def crawl(self):
+                # iterating candidate ADDRESSES, not a retry budget
+                for addr in self.book.pick_to_dial(set(), 3):
+                    await self.dial_peer(addr)
+            async def sweep(self):
+                while True:
+                    await asyncio.sleep(30.0)
+                    await self.dial_peer("a@b:1")
         """,
     ),
     (

@@ -171,6 +171,10 @@ def test_prometheus_metrics_endpoint():
         assert "cometbft_p2p_peers" in text
         assert "cometbft_consensus_total_txs" in text
         assert "cometbft_blocksync_pipeline_reused_total" in text
+        # self-healing connectivity plane (p2p/reconnect.py)
+        assert "cometbft_p2p_reconnect_attempts_total" in text
+        assert "cometbft_p2p_peer_flaps_total" in text
+        assert "cometbft_p2p_starvation_seconds" in text
         # span→metrics bridge (trace/bridge.py): consensus step spans
         # must have landed in the step-duration histogram by height 3
         step_counts = [

@@ -1,0 +1,429 @@
+"""Self-healing connectivity plane (p2p/reconnect.py + switch dedup).
+
+1. Lane mechanics: fast-lane budget -> slow-lane park -> the sweep
+   reconnects after heal (the healed-minority starvation regression at
+   switch level — at HEAD-before semantics the finite budget abandoned
+   the peer and the minority stayed isolated forever), backoff reset
+   on success, counters + the p2p.reconnect span.
+2. Incarnation-safe dialing: a restarted remote's fresh dial evicts
+   the zombie entry instead of being dup-discarded; simultaneous
+   cross-dials resolve deterministically (lower dialer node id wins on
+   both ends, loser closed synchronously).
+3. Starvation -> PEX re-learn storm on dial success.
+4. lp2p parity: the same healed-minority scenario over Lp2pSwitch
+   (the plane is shared by inheritance).
+5. RPC health `connectivity` verdict.
+"""
+
+import asyncio
+
+from cometbft_tpu.chaos.links import LinkTable
+from cometbft_tpu.lp2p import Lp2pSwitch
+from cometbft_tpu.p2p import (
+    ChannelDescriptor,
+    MemoryTransport,
+    NodeInfo,
+    NodeKey,
+    Reactor,
+    Switch,
+)
+from cometbft_tpu.trace import Tracer
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+# small budgets so a test crosses fast lane -> slow lane in well under
+# a second instead of minutes
+FAST_RECONNECT = {
+    "base_s": 0.02,
+    "cap_s": 0.08,
+    "fast_attempts": 2,
+    "slow_interval_s": 0.15,
+    "starvation_s": 0.2,
+}
+
+
+class SinkReactor(Reactor):
+    name = "sink"
+    CHAN = 0x7A
+
+    def __init__(self):
+        super().__init__()
+        self.added = []
+        self.removed = []
+
+    def get_channels(self):
+        return [ChannelDescriptor(self.CHAN, priority=1)]
+
+    def add_peer(self, peer):
+        self.added.append(peer.peer_id)
+
+    def remove_peer(self, peer, reason):
+        self.removed.append(peer.peer_id)
+
+    def receive(self, chan_id, peer, msg):
+        pass
+
+
+def _mem_switch(table=None, cls=Switch, chain="reconnect-test", **kw):
+    nk = NodeKey.generate()
+    info = NodeInfo(node_id=nk.node_id, network=chain)
+    tr = MemoryTransport(nk, info, link_hook=table)
+    sw = cls(tr, info, reconnect_config=dict(FAST_RECONNECT), **kw)
+    sw.add_reactor("sink", SinkReactor())
+    sw.tracer = Tracer(name=nk.node_id[:8], size=2048)
+    return sw
+
+
+async def _mesh(switches):
+    """Ring-dial (i -> i+1): with 3 switches that is the full mesh,
+    and EVERY switch owns one persistent outbound dial — so each
+    side's reconnect plane has something to redial (the reference
+    semantics only redial peers *we* dialed)."""
+    for sw in switches:
+        await sw.transport.listen()
+        await sw.start()
+    n = len(switches)
+    for i, a in enumerate(switches):
+        b = switches[(i + 1) % n]
+        await a.dial_peer(
+            f"{b.node_info.node_id}@mem://{b.node_info.node_id}",
+            persistent=True,
+        )
+    for sw in switches:
+        for _ in range(200):
+            if sw.num_peers() == n - 1:
+                break
+            await asyncio.sleep(0.01)
+        assert sw.num_peers() == n - 1
+
+
+async def _wait(cond, timeout=20.0, what=""):
+    for _ in range(int(timeout / 0.02)):
+        if cond():
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _kill_all_conns(sw):
+    for peer in list(sw.peers.values()):
+        peer.inject_error(ConnectionError("pong timeout (injected)"))
+
+
+def _minority_scenario(cls):
+    """Partition the minority off, kill its conns (pong-timeout
+    style), let every fast lane exhaust into the slow lane, heal —
+    the sweep must reconverge the full mesh. The pre-plane semantics
+    (finite attempts, no slow lane) fail this: after exhaustion
+    nobody ever redials."""
+
+    async def main():
+        table = LinkTable(seed=7)
+        sws = [_mem_switch(table, cls=cls) for _ in range(3)]
+        try:
+            await _mesh(sws)
+            minority = sws[2]
+            others = [sws[0], sws[1]]
+            table.partition(
+                [
+                    [s.node_info.node_id for s in others],
+                    [minority.node_info.node_id],
+                ]
+            )
+            _kill_all_conns(minority)
+            await _wait(
+                lambda: minority.num_peers() == 0
+                and all(s.num_peers() == 1 for s in others),
+                what="conn deaths to propagate",
+            )
+            # the partition outlasts the whole fast budget: each
+            # side's fast lane must PARK its dead persistent peer
+            # (minority dialed sws[0]; sws[1] dialed the minority),
+            # not give up
+            await _wait(
+                lambda: minority.reconnect.slow_parks_total >= 1
+                and sws[1].reconnect.slow_parks_total >= 1,
+                what="fast budgets to exhaust into the slow lane",
+            )
+            assert minority.reconnect.slow_lane, "peers abandoned!"
+            assert minority.reconnect.attempts_total >= 2
+            assert minority.reconnect.flaps_total >= 2
+            table.heal()
+            await _wait(
+                lambda: all(s.num_peers() == 2 for s in sws),
+                what="slow-lane sweep to reconverge the mesh",
+            )
+            # success resets the backoff (next flap starts fast) and
+            # drains both lanes
+            plane = minority.reconnect
+            assert not plane.slow_lane and not plane._fast_tasks
+            for bo in plane._backoffs.values():
+                assert bo.attempt == 0
+            assert plane.recoveries_total >= 1
+            # convergence is a recorded span (budget-gated in chaos)
+            spans = [
+                e
+                for e in minority.tracer.snapshot()
+                if e["name"] == "p2p.reconnect"
+            ]
+            assert spans, "no p2p.reconnect span recorded"
+            assert any(
+                e["args"].get("recovered") for e in spans
+            ), spans
+        finally:
+            for sw in sws:
+                await sw.stop()
+
+    run(main())
+
+
+def test_healed_minority_reconverges_native_switch():
+    _minority_scenario(Switch)
+
+
+def test_healed_minority_reconverges_lp2p_switch():
+    # parity: Lp2pSwitch inherits the same plane (shared lifecycle)
+    _minority_scenario(Lp2pSwitch)
+
+
+def test_boot_dial_failure_routes_to_plane():
+    """A persistent dial that fails before ANY conn existed (target
+    down at boot) must land on the plane — and succeed once the
+    target appears."""
+
+    async def main():
+        table = LinkTable(seed=11)
+        a = _mem_switch(table)
+        b = _mem_switch(table)
+        await a.transport.listen()
+        await a.start()
+        # b exists as a hub target id but is partitioned off
+        await b.transport.listen()
+        table.partition(
+            [[a.node_info.node_id], [b.node_info.node_id]]
+        )
+        try:
+            await a.dial_peer(
+                f"{b.node_info.node_id}@mem://{b.node_info.node_id}",
+                persistent=True,
+            )
+        except Exception:
+            pass
+        assert a.reconnect.is_scheduled(b.node_info.node_id)
+        assert a.reconnect.dial_failures_total >= 1
+        await b.start()
+        table.heal()
+        await _wait(
+            lambda: a.num_peers() == 1 and b.num_peers() == 1,
+            what="boot-failed dial to recover via the plane",
+        )
+        await a.stop()
+        await b.stop()
+
+    run(main())
+
+
+def test_restarted_incarnation_evicts_zombie_entry():
+    """The rejoin wedge: A holds a still-open conn to B's PREVIOUS
+    life; restarted B (same node id, fresh incarnation) dials A. The
+    old semantics dup-discarded the fresh conn against the zombie
+    entry — now the zombie is evicted synchronously and the fresh
+    conn registers."""
+
+    async def main():
+        a = _mem_switch()
+        b1 = _mem_switch()
+        # pin both to the same identity: b2 is b1's next incarnation
+        key = b1.transport.node_key
+        await _mesh([a, b1])
+        bid = b1.node_info.node_id
+        old_inc = a.peers[bid].node_info.incarnation
+        assert old_inc  # incarnation rides the handshake
+
+        # "restart" b: a fresh switch with the same key; b1's conn to
+        # a is left OPEN (the zombie: a has no idea b died)
+        info2 = NodeInfo(node_id=bid, network="reconnect-test")
+        tr2 = MemoryTransport(key, info2)  # re-registers the mem hub
+        b2 = Switch(tr2, info2, reconnect_config=dict(FAST_RECONNECT))
+        b2.add_reactor("sink", SinkReactor())
+        await b2.transport.listen()
+        await b2.start()
+        peer = await b2.dial_peer(
+            f"{a.node_info.node_id}@mem://{a.node_info.node_id}",
+            persistent=True,
+        )
+        assert peer is not None and peer.peer_id == a.node_info.node_id
+        await _wait(
+            lambda: a.peers.get(bid) is not None
+            and a.peers[bid].node_info.incarnation
+            == info2.incarnation,
+            what="fresh incarnation to replace the zombie entry",
+        )
+        assert a.peers[bid].node_info.incarnation != old_inc
+        assert a.num_peers() == 1  # replaced, not duplicated
+        await a.stop()
+        await b2.stop()
+        b1.abort()
+
+    run(main())
+
+
+def test_acceptor_redial_beats_long_established_zombie():
+    """One-sided death at the original ACCEPTOR: its redial must not
+    be dup-discarded against the dialer's zombie entry (the cross-dial
+    lower-id tiebreak only applies to genuinely simultaneous dials —
+    a fresh conn against a LONG-established one is a redial and
+    wins)."""
+
+    async def main():
+        a = _mem_switch()
+        b = _mem_switch()
+        await _mesh([a, b])  # ring: a dialed b AND b dialed a... 2
+        # nodes: a->b and b->a are the same pair; keep only a's
+        # outbound view by construction below
+        aid, bid = a.node_info.node_id, b.node_info.node_id
+        old_peer = a.peers[bid]
+        # age the established conn out of the cross-dial window
+        old_peer.established_at -= 60.0
+        # one-sided death at b: b loses its ENTRY while the conn fds
+        # stay open on both ends (a's registered conn is now a zombie
+        # from b's point of view; a has noticed nothing)
+        b.peers.pop(aid)
+        await asyncio.sleep(0.05)
+        # b's plane would redial; simulate the dial directly
+        await b.dial_peer(f"{aid}@mem://{aid}", persistent=True)
+        await _wait(
+            lambda: a.peers.get(bid) is not None
+            and a.peers[bid] is not old_peer,
+            what="redial to evict the zombie entry at a",
+        )
+        assert a.num_peers() == 1 and b.num_peers() == 1
+        await a.stop()
+        await b.stop()
+
+    run(main())
+
+
+def test_simultaneous_cross_dial_resolves_deterministically():
+    """Both sides dial at once: each pair must converge to exactly ONE
+    conn, and the surviving conn is the one dialed by the LOWER node
+    id on BOTH ends (no close/redial livelock)."""
+
+    async def main():
+        a = _mem_switch()
+        b = _mem_switch()
+        for sw in (a, b):
+            await sw.transport.listen()
+            await sw.start()
+        aid, bid = a.node_info.node_id, b.node_info.node_id
+        low = min(aid, bid)
+        await asyncio.gather(
+            a.dial_peer(f"{bid}@mem://{bid}", persistent=True),
+            b.dial_peer(f"{aid}@mem://{aid}", persistent=True),
+            return_exceptions=True,
+        )
+        await _wait(
+            lambda: a.num_peers() == 1 and b.num_peers() == 1,
+            what="cross-dial to settle on one conn per side",
+        )
+        # give any in-flight duplicate resolution a beat, then check
+        # stability: still exactly one conn, consistent direction
+        await asyncio.sleep(0.3)
+        assert a.num_peers() == 1 and b.num_peers() == 1
+        winner_dialed_by_a = a.peers[bid].outbound
+        winner_dialed_by_b = b.peers[aid].outbound
+        # exactly one side's outbound conn survived, and it is the
+        # lower node id's
+        assert winner_dialed_by_a != winner_dialed_by_b
+        assert winner_dialed_by_a == (low == aid)
+        await a.stop()
+        await b.stop()
+
+    run(main())
+
+
+def test_starvation_triggers_pex_relearn():
+    """Zero peers past the starvation threshold: the next dial success
+    must fire a rate-limit-bypassing PEX request so the minority
+    re-learns moved addresses immediately."""
+
+    class PexStub(Reactor):
+        name = "pex"
+
+        def __init__(self):
+            super().__init__()
+            self.requested = []
+
+        def get_channels(self):
+            return []
+
+        def request_now(self, peer):
+            self.requested.append(peer.peer_id)
+
+        def receive(self, chan_id, peer, msg):
+            pass
+
+    async def main():
+        a = _mem_switch()
+        b = _mem_switch()
+        stub = a.add_reactor("pex", PexStub())
+        for sw in (a, b):
+            await sw.transport.listen()
+            await sw.start()
+        # a is MEANT to be connected (boot config names b) but has
+        # zero peers past the threshold: starving
+        bid = b.node_info.node_id
+        a.persistent_addrs[bid] = f"mem://{bid}"
+        await asyncio.sleep(0.3)
+        assert a.reconnect.starving()
+        # a switch with nothing to dial is NOT starving
+        assert not b.reconnect.starving()
+        await a.dial_peer(
+            f"{b.node_info.node_id}@mem://{b.node_info.node_id}",
+            persistent=True,
+        )
+        assert stub.requested == [b.node_info.node_id]
+        assert not a.reconnect.starving()
+        # starvation clock accumulated the episode
+        assert a.reconnect.starvation_seconds() >= 0.3
+        await a.stop()
+        await b.stop()
+
+    run(main())
+
+
+def test_health_connectivity_verdict():
+    """rpc health: ok for a node with nothing to dial; degraded (with
+    reconnect detail) once it expects peers it does not have."""
+    from cometbft_tpu.rpc import core
+    from cometbft_tpu.rpc.env import Environment
+
+    class StubStore:
+        def height(self):
+            return 0
+
+        def load_block_meta(self, h):
+            return None
+
+    async def main():
+        sw = _mem_switch()
+        env = Environment(block_store=StubStore(), switch=sw)
+        h = core.health(env)
+        # no persistent peers, empty book, no flaps: no expectation
+        assert h["connectivity"]["status"] == "ok"
+        assert h["status"] == "ok"
+        # now the node is MEANT to be connected and is not
+        sw.persistent_addrs["deadbeef"] = "mem://deadbeef"
+        h = core.health(env)
+        conn = h["connectivity"]
+        assert conn["status"] == "degraded"
+        assert conn["n_peers"] == 0 and conn["min_peers"] >= 1
+        assert any(
+            "connectivity" in r for r in h["reasons"]
+        ), h["reasons"]
+        assert h["status"] == "degraded"
+
+    run(main())

@@ -28,6 +28,132 @@ def test_addrbook_basics(tmp_path):
     assert book2.addrs["aa"].is_old
 
 
+def test_addrbook_bookkeeping_persists(tmp_path):
+    """Dial success/failure history must survive a restart: the
+    reconnect plane and pick_to_dial's backoff gating key on
+    attempts/last_attempt/failures, which previously evaporated
+    (save() dropped them)."""
+    path = str(tmp_path / "addrbook.json")
+    book = AddrBook(path, our_id="me")
+    book.add_address("aa@1.2.3.4:1")
+    book.mark_attempt("aa")
+    book.mark_attempt("aa")
+    book.mark_failed("aa")
+    # mark_failed with addr creates the entry (persistent peer never
+    # PEX-learned still accumulates health history)
+    book.mark_failed("cc", "cc@9.9.9.9:3")
+    book.save()
+    again = AddrBook(path, our_id="me")
+    aa = again.addrs["aa"]
+    assert aa.attempts == 2
+    assert aa.last_attempt > 0
+    assert aa.failures == 1
+    assert aa.last_failure > 0
+    assert again.addrs["cc"].failures == 1
+    # a success resets the attempt counter (the bad-address gate) but
+    # keeps the flap history
+    again.mark_good("aa", "aa@1.2.3.4:1")
+    assert again.addrs["aa"].attempts == 0
+    assert again.addrs["aa"].failures == 1
+
+
+def test_addrbook_persisted_attempts_age_out(tmp_path):
+    """Forgiveness: a never-connected address that crossed the
+    bad-address attempt cap must NOT stay is_bad forever across
+    restarts — stale attempt counters reload clean (failure history
+    stays for diagnostics), while fresh ones persist."""
+    import time as _time
+
+    from cometbft_tpu.p2p.pex import FORGIVE_AFTER_S
+
+    path = str(tmp_path / "addrbook.json")
+    book = AddrBook(path, our_id="me")
+    book.add_address("aa@h:1")
+    book.addrs["aa"].attempts = 99  # crossed MAX_ATTEMPTS, no success
+    book.addrs["aa"].failures = 99
+    book.addrs["aa"].last_attempt = (
+        _time.time() - FORGIVE_AFTER_S - 60
+    )
+    book.add_address("bb@h:2")
+    book.mark_attempt("bb")  # fresh: must survive the reload
+    book.save()
+    again = AddrBook(path, our_id="me")
+    assert again.addrs["aa"].attempts == 0  # forgiven
+    assert not again.addrs["aa"].is_bad
+    assert again.addrs["aa"].failures == 99  # history kept
+    assert again.addrs["bb"].attempts == 1  # fresh: persisted
+    # a re-learned NEW address also resets the counter live
+    book.addrs["bb"].attempts = 99
+    book.add_address("bb@moved:9")
+    assert book.addrs["bb"].attempts == 0
+    assert book.addrs["bb"].addr == "bb@moved:9"
+
+
+def test_addrbook_relearned_address_replaces_failing_old_entry():
+    """A moved peer must not be shadowed by its stale proven entry:
+    while the known address keeps failing, re-learned routing info
+    (PEX) replaces it; while it is healthy, it is sticky; and a LIVE
+    connection at a new address always wins."""
+    book = AddrBook(our_id="me")
+    book.add_address("aa@old:1")
+    book.mark_good("aa")  # proven -> is_old, addr sticky
+    book.add_address("aa@moved:2", src="pex")
+    assert book.addrs["aa"].addr == "aa@old:1"  # healthy: sticky
+    book.mark_failed("aa")  # conn died / dials failing
+    book.add_address("aa@moved:2", src="pex")
+    assert book.addrs["aa"].addr == "aa@moved:2"  # failing: re-learn
+    # a live conn at yet another address is the strongest evidence
+    book.mark_good("aa", "aa@live:3")
+    assert book.addrs["aa"].addr == "aa@live:3"
+    assert book.addrs["aa"].is_old
+
+
+def test_addrbook_selection_biases_old_then_new():
+    """selection(): OLD (proven) addresses lead, NEW fill the tail,
+    bad addresses are excluded (reference GetSelection bias)."""
+    book = AddrBook(our_id="me")
+    for i in range(6):
+        book.add_address(f"new{i}@h:{i}")
+    for i in range(3):
+        book.add_address(f"old{i}@H:{i}")
+        book.mark_good(f"old{i}")
+    bad = book.addrs["new0"]
+    bad.attempts = 100  # is_bad: many attempts, never a success
+    sel = book.selection(limit=6)
+    assert "new0@h:0" not in sel
+    head = sel[:3]
+    assert {a.partition("@")[0] for a in head} == {
+        "old0", "old1", "old2",
+    }, sel
+    assert len(sel) == 6
+    # deterministic across shuffles: old always first
+    for _ in range(10):
+        s = book.selection(limit=6)
+        assert all(a.startswith("old") for a in s[:3])
+
+
+def test_addrbook_pick_to_dial_gates_on_attempt_backoff():
+    """pick_to_dial: excludes live/banned ids, bad addresses, and
+    addresses attempted too recently (10s * (attempts+1) gate)."""
+    import time as _time
+
+    book = AddrBook(our_id="me")
+    book.add_address("aa@h:1")
+    book.add_address("bb@h:2")
+    book.add_address("cc@h:3")
+    # aa: attempted just now -> gated out
+    book.mark_attempt("aa")
+    # bb: attempted long ago -> eligible again
+    book.mark_attempt("bb")
+    book.addrs["bb"].last_attempt = _time.time() - 120.0
+    picks = book.pick_to_dial(exclude={"cc"}, n=10)
+    assert picks == ["bb@h:2"]
+    # the gate scales with attempt count: 2 attempts => 30s window
+    book.addrs["bb"].attempts = 2
+    book.addrs["bb"].last_attempt = _time.time() - 25.0
+    assert "bb@h:2" not in book.pick_to_dial(exclude=set(), n=10)
+
+
 def test_pex_discovers_indirect_peer():
     """A knows only B; B knows C. PEX must connect A to C."""
     gen, pvs = make_genesis(3, chain_id="pex-chain")

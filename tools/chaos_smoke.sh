@@ -86,3 +86,13 @@ echo "== chaos smoke: 5-scenario factory matrix, budget-gated =="
 # via the printed "SCENARIO ... --only I" seed line
 JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos matrix --seed "$SEED" \
     --count 5 --budget --out "$TRACE_DIR/matrix"
+
+echo "== chaos smoke: un-pinned partition x statesync_join x churn + reconnect span budget =="
+# the compound the matrix previously pinned out (ISSUE 12): a
+# partitioned net churns its valset, heals, and a fresh node joins by
+# statesync mid-load — gated on the invariants, the span budgets
+# (p2p.reconnect convergence included; exit 2 on breach) and, below,
+# strict per-height commit attribution over the run's rings
+JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos matrix --seed "$SEED" \
+    --only 11 --budget --trace-dump "$TRACE_DIR/join_partition"
+python -m cometbft_tpu.trace timeline "$TRACE_DIR/join_partition"/m*-11 --strict
