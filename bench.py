@@ -6,6 +6,8 @@
 4. replay      — 10k-block x 150-validator blocksync replay wall-clock
 5. bisect      — light-client bisection over a 50k-height skip
 6. mixed       — mixed-curve (ed25519 + secp256k1) split batch
+(+ host legs: ingest, live, pipeline, and serve — the 1k-session
+light-client serving storm, baseline vs shared-cache vs coalesced)
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} with
 every config's numbers under "detail.configs". Baselines are the host
@@ -64,6 +66,7 @@ _DEFAULT_BUDGETS_S = {
     "mixed": 600.0,
     "pipeline": 900.0,
     "live": 1500.0,
+    "serve": 1200.0,
 }
 
 
@@ -1168,6 +1171,487 @@ def bench_live() -> dict:
     }
 
 
+def bench_serve() -> dict:
+    """Light-client serving plane storm (ISSUE 13, docs/PERF.md
+    "Light-client serving plane"): 1k+ concurrent light sessions
+    (connect/bisect/verify) against one serving front, ablated three
+    ways over the SAME seeded request schedule:
+
+    - baseline   — today's per-request, per-client shape: every
+      session is its own fresh Client (own signature cache, own
+      store) paying root verify + full bisection;
+    - coalesced  — cold shared plane: cross-client verified-header
+      cache + single-flight + coalesced commit verification
+      (light/serving.py);
+    - warm       — the same plane, second pass (cache hot).
+
+    Pass-interleaved (baseline/cold/warm per repeat) with medians,
+    the same throttling defense as bench_ingest/bench_live. In-bench
+    verdict parity: coalesced engine verdicts vs serial
+    verify_commit_light over valid + forged commits, plus served
+    blocks hash-compared against a per-request client. The
+    light.serve.request p99 is gated against
+    tools/span_budgets.toml. A small LIVE sub-leg storms a running
+    LocalNet node's stores through the same plane."""
+    import concurrent.futures
+    import statistics
+    import time as _time
+
+    import cometbft_tpu.types as T
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.light.client import Client, TrustOptions
+    from cometbft_tpu.light.provider import Provider
+    from cometbft_tpu.light.serving import (
+        CoalescedCommitVerifier,
+        LightServingPlane,
+    )
+    from cometbft_tpu.light.types import LightBlock
+    from cometbft_tpu.obs.budget import (
+        default_budget_file,
+        evaluate_budgets,
+        load_budgets,
+    )
+    from cometbft_tpu.trace import summarize
+    from cometbft_tpu.trace.tracer import Tracer
+
+    SESSIONS = int(os.environ.get("BENCH_SERVE_SESSIONS", "1000"))
+    WORKERS = int(os.environ.get("BENCH_SERVE_WORKERS", "64"))
+    REPEATS = int(os.environ.get("BENCH_SERVE_REPEATS", "3"))
+    TARGET = int(os.environ.get("BENCH_SERVE_HEIGHTS", "4000"))
+    DISTINCT = int(os.environ.get("BENCH_SERVE_DISTINCT", "40"))
+    POOL = int(os.environ.get("BENCH_SERVE_POOL", "8"))
+    # small committee: serving cost scales with signatures and the
+    # baseline pays them 1000x over — 32 vals keeps the ablation
+    # honest AND inside the leg budget on this box
+    NV = 32
+    EPOCH = 400
+    SHIFT = 14  # 1-epoch overlap 18/32 (>1/3); 2+ epochs 4/32 (<1/3)
+    chain_id = "bench-serve"
+
+    rng = np.random.default_rng(41)
+    n_epochs = TARGET // EPOCH + 2
+    pool_keys = [
+        Ed25519PrivKey.from_seed(rng.bytes(32))
+        for _ in range(n_epochs * SHIFT + NV)
+    ]
+    t0_ns = time.time_ns() - (TARGET + 120) * 1_000_000_000
+    _vs_cache: dict = {}
+
+    def vals_at(height: int):
+        epoch = height // EPOCH
+        vs = _vs_cache.get(epoch)
+        if vs is None:
+            start = epoch * SHIFT
+            vs = T.ValidatorSet(
+                [
+                    T.Validator(p.pub_key(), 10)
+                    for p in pool_keys[start : start + NV]
+                ]
+            )
+            _vs_cache[epoch] = vs
+        return vs
+
+    priv_by_addr = {p.pub_key().address(): p for p in pool_keys}
+
+    class MintingProvider(Provider):
+        """Synthetic signed chain (bench_bisect's shape), memoized so
+        mint cost is paid once per height — the measured deltas are
+        verification policy, not signing."""
+
+        def __init__(self):
+            self.chain_id = chain_id
+            self._minted: dict = {}
+            self._lock = threading.Lock()
+
+        def light_block(self, height: int) -> LightBlock:
+            with self._lock:
+                got = self._minted.get(height)
+            if got is not None:
+                return got
+            vs_h = vals_at(height)
+            h = T.Header(
+                chain_id=chain_id,
+                height=height,
+                time_ns=t0_ns + height * 1_000_000_000,
+                validators_hash=vs_h.hash(),
+                next_validators_hash=vals_at(height + 1).hash(),
+            )
+            bid = T.BlockID(h.hash(), T.PartSetHeader(1, h.hash()))
+            sigs = []
+            for i, val in enumerate(vs_h.validators):
+                v = T.Vote(
+                    type_=T.PRECOMMIT,
+                    height=height,
+                    round=0,
+                    block_id=bid,
+                    timestamp_ns=h.time_ns,
+                    validator_address=val.address,
+                    validator_index=i,
+                )
+                sigs.append(
+                    T.CommitSig(
+                        block_id_flag=T.BLOCK_ID_FLAG_COMMIT,
+                        validator_address=val.address,
+                        timestamp_ns=h.time_ns,
+                        signature=priv_by_addr[val.address].sign(
+                            v.sign_bytes(chain_id)
+                        ),
+                    )
+                )
+            lb = LightBlock(
+                h,
+                T.Commit(
+                    height=height, round=0, block_id=bid,
+                    signatures=sigs,
+                ),
+                vs_h,
+            )
+            with self._lock:
+                self._minted[height] = lb
+            return lb
+
+        def report_evidence(self, ev) -> None:
+            pass
+
+    provider = MintingProvider()
+    root = provider.light_block(1)
+    trust = TrustOptions(
+        period_ns=10 * 365 * 86400 * 10**9, height=1, hash=root.hash()
+    )
+    req_rng = np.random.default_rng(1013)
+    distinct = sorted(
+        int(x)
+        for x in req_rng.choice(
+            np.arange(TARGET // 2, TARGET), size=DISTINCT,
+            replace=False,
+        )
+    )
+    schedule = [
+        distinct[int(i) % len(distinct)] for i in range(SESSIONS)
+    ]
+
+    def run_sessions(serve_one) -> tuple:
+        """Drive the seeded schedule through ``serve_one(height)``
+        on WORKERS threads; returns (sorted per-session ms, wall s)."""
+        lat = []
+        lock = threading.Lock()
+
+        def one(sid: int) -> None:
+            t0 = _time.monotonic()
+            lb = serve_one(schedule[sid])
+            dt = (_time.monotonic() - t0) * 1e3
+            assert lb.height == schedule[sid]
+            with lock:
+                lat.append(dt)
+
+        t0 = _time.monotonic()
+        with concurrent.futures.ThreadPoolExecutor(WORKERS) as ex:
+            for f in [
+                ex.submit(one, sid) for sid in range(SESSIONS)
+            ]:
+                f.result()
+        wall = _time.monotonic() - t0
+        lat.sort()
+        return lat, wall
+
+    def pcts(lat: list, wall: "float | None" = None) -> dict:
+        out = {
+            "p50_ms": round(lat[int(0.50 * (len(lat) - 1))], 3),
+            "p99_ms": round(lat[int(0.99 * (len(lat) - 1))], 3),
+            "mean_ms": round(sum(lat) / len(lat), 3),
+        }
+        if wall is not None:
+            out["sessions_per_s"] = round(len(lat) / wall, 1)
+        return out
+
+    tracer = Tracer(name="serve", size=1 << 17)
+
+    def baseline_pass() -> dict:
+        def serve_one(h):
+            # per-session client: root verify + own bisection — the
+            # pre-plane proxy shape (connect cost included: a fresh
+            # session IS a connect)
+            c = Client(chain_id, trust, provider)
+            return c.verify_light_block_at_height(h)
+
+        return pcts(*run_sessions(serve_one))
+
+    def plane_passes() -> tuple:
+        clients = [
+            Client(chain_id, trust, provider) for _ in range(POOL)
+        ]
+        plane = LightServingPlane(
+            clients,
+            max_sessions=SESSIONS + WORKERS,
+            max_inflight=WORKERS,
+            tracer=tracer,
+        )
+
+        def serve_one(h):
+            with plane.open_session() as s:
+                return s.verified_block(h)
+
+        cold = pcts(*run_sessions(serve_one))
+        warm = pcts(*run_sessions(serve_one))
+        return cold, warm, plane.stats()
+
+    runs = {"baseline": [], "coalesced_cold": [], "warm": []}
+    plane_stats = None
+    for _ in range(REPEATS):
+        runs["baseline"].append(baseline_pass())
+        cold, warm, plane_stats = plane_passes()
+        runs["coalesced_cold"].append(cold)
+        runs["warm"].append(warm)
+    med = {
+        mode: {
+            k: round(statistics.median(r[k] for r in rs), 3)
+            for k in (
+                "p50_ms", "p99_ms", "mean_ms", "sessions_per_s",
+            )
+        }
+        for mode, rs in runs.items()
+    }
+
+    # --- in-bench verdict parity (serial vs coalesced engine) ----------
+    def parity() -> dict:
+        import dataclasses
+        from fractions import Fraction
+
+        good = provider.light_block(distinct[0])
+        forged_commit = dataclasses.replace(
+            good.commit,
+            signatures=[
+                dataclasses.replace(
+                    good.commit.signatures[0], signature=bytes(64)
+                )
+            ]
+            + list(good.commit.signatures[1:]),
+        )
+        jobs = [
+            ("light", good.validator_set, good.commit.block_id,
+             good.height, good.commit),
+            ("light", good.validator_set, good.commit.block_id,
+             good.height, forged_commit),
+            ("trusting", good.validator_set, good.commit,
+             Fraction(1, 3)),
+        ]
+        serial = []
+        for job in jobs:
+            try:
+                if job[0] == "light":
+                    T.verify_commit_light(
+                        chain_id, job[1], job[2], job[3], job[4]
+                    )
+                else:
+                    T.verify_commit_light_trusting(
+                        chain_id, job[1], job[2], trust_level=job[3]
+                    )
+                serial.append(None)
+            except T.CommitVerifyError as e:
+                serial.append(type(e).__name__)
+        engine = CoalescedCommitVerifier(chain_id, window_s=0.01)
+        coalesced = [None] * len(jobs)
+        errs = []
+
+        def submit(i, job):
+            try:
+                if job[0] == "light":
+                    engine.verify_commit_light(
+                        job[1], job[2], job[3], job[4]
+                    )
+                else:
+                    engine.verify_commit_light_trusting(
+                        job[1], job[2], job[3]
+                    )
+            except T.CommitVerifyError as e:
+                coalesced[i] = type(e).__name__
+            except Exception as e:
+                errs.append(repr(e))
+
+        ths = [
+            threading.Thread(target=submit, args=(i, j))
+            for i, j in enumerate(jobs)
+        ]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        identical = serial == coalesced and not errs
+        # served-block parity: the plane's answer is bit-identical to
+        # a per-request client's for sampled heights
+        solo = Client(chain_id, trust, provider)
+        clients = [Client(chain_id, trust, provider)]
+        plane = LightServingPlane(clients, max_inflight=4)
+        served_equal = all(
+            bytes(plane.serve(h).hash())
+            == bytes(solo.verify_light_block_at_height(h).hash())
+            for h in distinct[:3]
+        )
+        return {
+            "identical": bool(identical),
+            "serial": serial,
+            "coalesced": coalesced,
+            "served_blocks_equal": bool(served_equal),
+            "batched": engine.stats()["dispatches"] > 0,
+        }
+
+    parity_out = parity()
+    assert parity_out["identical"] and parity_out[
+        "served_blocks_equal"
+    ], f"serving verdict parity broken: {parity_out}"
+
+    # --- span-budget gate (tools/span_budgets.toml) --------------------
+    tsum = summarize({"serve": tracer.snapshot()})
+    verdicts = [
+        v
+        for v in evaluate_budgets(
+            tsum, load_budgets(default_budget_file())
+        )
+        if v["span"] == "light.serve.request"
+    ]
+    budget_ok = all(v["ok"] for v in verdicts)
+
+    # --- live sub-leg: storm a RUNNING LocalNet node -------------------
+    def live_leg() -> dict:
+        import asyncio
+        import shutil
+        import tempfile
+
+        from cometbft_tpu.config.config import test_config
+        from cometbft_tpu.light.provider import StoreBackedProvider
+        from cometbft_tpu.node.inprocess import (
+            LocalNet,
+            build_node,
+            make_genesis,
+        )
+
+        n_live = int(os.environ.get("BENCH_SERVE_LIVE_SESSIONS", "300"))
+        heights = 12
+        base = tempfile.mkdtemp(prefix="bench_serve_live_")
+        try:
+            gen, pvs = make_genesis(2, chain_id="bench-serve-live")
+            nodes = []
+            for i, pv in enumerate(pvs):
+                home = os.path.join(base, f"n{i}")
+                os.makedirs(home, exist_ok=True)
+                cfg = test_config(home)
+                cfg.base.moniker = f"n{i}"
+                cfg.consensus.skip_timeout_commit = True
+                cfg.consensus.timeout_commit_s = 0.0
+                cfg.tx_index.indexer = "null"
+                nodes.append(
+                    build_node(gen, pv, config=cfg, home=home)
+                )
+            net = LocalNet(nodes)
+
+            async def main():
+                await net.start()
+                await net.wait_for_height(heights, timeout=300)
+                src = nodes[0]
+                prov = StoreBackedProvider(
+                    gen.chain_id, src.block_store, src.state_store
+                )
+                lroot = prov.light_block(1)
+                ltrust = TrustOptions(
+                    period_ns=24 * 3600 * 10**9,
+                    height=1,
+                    hash=lroot.hash(),
+                )
+                plane = LightServingPlane(
+                    [
+                        Client(gen.chain_id, ltrust, prov)
+                        for _ in range(4)
+                    ],
+                    max_sessions=n_live + 32,
+                    max_inflight=32,
+                )
+                lrng = np.random.default_rng(7)
+                hs = [
+                    int(x)
+                    for x in lrng.integers(2, heights + 1, n_live)
+                ]
+
+                def storm():
+                    lat = []
+                    lock = threading.Lock()
+
+                    def one(sid):
+                        t0 = _time.monotonic()
+                        with plane.open_session() as s:
+                            lb = s.verified_block(hs[sid])
+                        dt = (_time.monotonic() - t0) * 1e3
+                        want = src.block_store.load_block_meta(
+                            hs[sid]
+                        ).block_id.hash
+                        assert bytes(lb.hash()) == bytes(want)
+                        with lock:
+                            lat.append(dt)
+
+                    with concurrent.futures.ThreadPoolExecutor(
+                        32
+                    ) as ex:
+                        for f in [
+                            ex.submit(one, i) for i in range(n_live)
+                        ]:
+                            f.result()
+                    lat.sort()
+                    return lat
+
+                # the node keeps committing WHILE the storm runs
+                lat = await asyncio.to_thread(storm)
+                stats = plane.stats()
+                await net.stop()
+                return lat, stats
+
+            lat, stats = asyncio.run(main())
+            for n in nodes:
+                n.close_stores()
+            return {
+                "sessions": n_live,
+                **pcts(lat),
+                "cache": stats["cache"],
+                "verdict_parity": True,
+            }
+        except Exception as e:
+            return {"note": f"live leg degraded: {e!r}"}
+        finally:
+            shutil.rmtree(base, ignore_errors=True)
+
+    live = live_leg()
+
+    speedup = _ratio(
+        med["baseline"]["p99_ms"], med["coalesced_cold"]["p99_ms"]
+    )
+    return {
+        "rate": med["warm"]["sessions_per_s"],
+        "sessions": SESSIONS,
+        "workers": WORKERS,
+        "distinct_heights": DISTINCT,
+        "target_height": TARGET,
+        "validators": NV,
+        "repeats": REPEATS,
+        "baseline": med["baseline"],
+        "coalesced_cold": med["coalesced_cold"],
+        "warm": med["warm"],
+        "p99_speedup_cold_vs_baseline": speedup,
+        "p99_speedup_warm_vs_baseline": _ratio(
+            med["baseline"]["p99_ms"], med["warm"]["p99_ms"]
+        ),
+        "plane": plane_stats,
+        "verdict_parity": parity_out,
+        "budget": {"ok": budget_ok, "verdicts": verdicts},
+        "live": live,
+        "note": (
+            "baseline = per-session fresh Client (root verify + own "
+            "bisection, the pre-plane proxy shape); coalesced_cold = "
+            "shared verified-header cache + single-flight + "
+            "coalesced commit verify from cold; warm = same plane, "
+            "hot cache. Pass-interleaved medians of per-session "
+            "latency; rate = warm sessions/s."
+        ),
+    }
+
+
 def bench_commit150(gen, parts) -> dict:
     import cometbft_tpu.types as T
 
@@ -1649,6 +2133,7 @@ def main() -> None:
             "pipeline",
             "ingest",
             "live",
+            "serve",
         }
         if which == "all"
         else set(which.split(","))
@@ -1778,6 +2263,11 @@ def main() -> None:
         # batched — the first optimization leg behind the PR 7 quorum
         # waterfall
         run_config("live", bench_live)
+    if "serve" in todo:
+        # host-only light-client serving storm (ISSUE 13): 1k-session
+        # baseline vs shared-cache vs coalesced ablation + a live
+        # LocalNet sub-leg, p99 budget-gated
+        run_config("serve", bench_serve)
     budget_skip = {
         "skipped": f"host budget ({host_budget_s:.0f}s) "
         "exhausted before this config"

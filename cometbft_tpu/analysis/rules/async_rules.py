@@ -708,3 +708,63 @@ def nested_event_loop(ctx: FileContext) -> List[Finding]:
                     )
                 )
     return out
+
+
+# the commit-verify entry points that must ride the shared serving
+# seam when called from light/ (ASY113): signature work here fans out
+# per SESSION, so a bare call re-pays crypto a thousand times over
+_LIGHT_VERIFY_NAMES = {
+    "verify_commit",
+    "verify_commit_light",
+    "verify_commit_light_trusting",
+    "verify_commits_coalesced",
+    "verify_commit_jobs_coalesced",
+}
+
+_LIGHT_PKG = "cometbft_tpu/light/"
+
+
+@rule(
+    "ASY113",
+    "uncoalesced-verify-in-light",
+    "a commit signature verification in light/ that bypasses the "
+    "shared cache / coalesce seam: per-request crypto multiplies by "
+    "the session count on the serving plane (light/serving.py)",
+)
+def uncoalesced_verify_in_light(ctx: FileContext) -> List[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if _LIGHT_PKG not in path and not path.startswith("light/"):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func) or ""
+        parts = name.split(".")
+        if parts[-1] not in _LIGHT_VERIFY_NAMES:
+            continue
+        # calls ON the coalescing engine ARE the seam (the engine
+        # owns the shared cache + batch window)
+        if any("engine" in p for p in parts[:-1]):
+            continue
+        if any(
+            kw.arg in ("cache", "engine") and not (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is None
+            )
+            for kw in node.keywords
+        ):
+            continue
+        out.append(
+            Finding(
+                ctx.path, node.lineno, node.col_offset,
+                "ASY113", "uncoalesced-verify-in-light",
+                f"`{name}` in light/ verifies per-request, bypassing "
+                "the shared cache/coalesce seam — pass the shared "
+                "SignatureCache (cache=...) or route through the "
+                "serving plane's CoalescedCommitVerifier "
+                "(light/serving.py): on the serving plane this "
+                "crypto multiplies by the session count",
+            )
+        )
+    return out

@@ -70,6 +70,18 @@ def main(argv=None) -> int:
         "to a fresh temp directory",
     )
     ap.add_argument(
+        "--light-storm",
+        type=int,
+        default=0,
+        metavar="N",
+        help="after the fault schedule settles, drive N light-client "
+        "serving sessions against a live node through the shared "
+        "serving plane (light/serving.py) — served blocks are "
+        "hash-asserted against the node's store and the "
+        "light.serve.request spans land in its ring (budget-gated "
+        "with --budget)",
+    )
+    ap.add_argument(
         "--fastpath",
         action="store_true",
         help="run every node with the live-consensus fast path "
@@ -109,6 +121,7 @@ def main(argv=None) -> int:
                     trace_dir=args.trace_dump,
                     budget_file=budget_file,
                     config_hook=config_hook,
+                    light_storm=args.light_storm,
                 )
             )
     finally:
@@ -136,6 +149,7 @@ def main(argv=None) -> int:
                     "workload": report.workload,
                     "shutdown_stalls": report.shutdown_stalls,
                     "proposers": report.proposers,
+                    "light_storm": report.light_storm,
                 },
                 f,
                 indent=2,

@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import os
 import tempfile
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -108,6 +109,10 @@ class ChaosReport:
     # failed into the reconnect plane + injected conn kills
     dial_failures: int = 0
     conns_killed: int = 0
+    # light-client serving storm against a live node (ISSUE 13;
+    # --light-storm N): session/latency/cache stats, or empty when
+    # the leg did not run
+    light_storm: Dict[str, object] = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -139,6 +144,15 @@ class ChaosReport:
             lines.append(f"VIOLATION: {v}")
         if self.workload:
             lines.append(f"workload: {self.workload}")
+        if self.light_storm:
+            ls = self.light_storm
+            lines.append(
+                f"light serving storm: {ls.get('sessions')} sessions "
+                f"against {ls.get('target_node')} (top height "
+                f"{ls.get('top_height')}), request p50 "
+                f"{ls.get('p50_ms')}ms p99 {ls.get('p99_ms')}ms, "
+                f"cache {ls.get('plane', {}).get('cache', {})}"
+            )
         if self.dial_failures or self.conns_killed:
             lines.append(
                 "connectivity plane: "
@@ -762,6 +776,98 @@ class ChaosNet:
         return files
 
 
+def _run_light_storm_sync(
+    net: "ChaosNet", sessions: int, seed: int, workers: int = 16
+) -> dict:
+    """Seeded N-session light-client serving storm against the most
+    advanced LIVE node (ISSUE 13 satellite): every session opens on
+    the shared LightServingPlane, requests a seeded height, and the
+    served block's hash is asserted against the node's own store
+    (live verdict parity). Spans land on the target node's trace ring
+    so `trace timeline --strict` and the span budgets see the storm.
+
+    Runs on a worker thread pool (the plane is the thread-facing
+    seam); the caller wraps it in asyncio.to_thread."""
+    import concurrent.futures
+    import random as _random
+    import time as _time
+
+    from ..light import Client, LightServingPlane, TrustOptions
+    from ..light.provider import StoreBackedProvider
+
+    running = net.running_nodes()
+    if not running:
+        raise RuntimeError("no running node to storm")
+    name, node = max(running, key=lambda t: t[1].height)
+    chain_id = net.genesis.chain_id
+    store = node.parts.block_store
+    provider = StoreBackedProvider(
+        chain_id, store, node.parts.state_store
+    )
+    root = provider.light_block(1)
+    tracer = node.parts.tracer
+    pool = [
+        Client(
+            chain_id,
+            TrustOptions(
+                period_ns=24 * 3600 * 10**9,
+                height=1,
+                hash=root.hash(),
+            ),
+            provider,
+        )
+        for _ in range(4)
+    ]
+    plane = LightServingPlane(
+        pool,
+        max_sessions=sessions + workers,
+        max_inflight=workers,
+        tracer=tracer,
+    )
+    top = max(2, node.height)
+    rng = _random.Random(seed ^ 0x11C0)
+    heights = [rng.randint(2, top) for _ in range(sessions)]
+    lat_ms: List[float] = []
+    lat_lock = threading.Lock()
+
+    def one_session(sid: int) -> None:
+        h = heights[sid]
+        t0 = _time.monotonic()
+        with plane.open_session() as s:
+            lb = s.verified_block(h)
+        dt = (_time.monotonic() - t0) * 1e3
+        meta = store.load_block_meta(h)
+        if meta is None or bytes(lb.hash()) != bytes(
+            meta.block_id.hash
+        ):
+            raise RuntimeError(
+                f"storm session {sid}: served block at {h} does not "
+                "match the node's store"
+            )
+        with lat_lock:
+            lat_ms.append(dt)
+
+    with concurrent.futures.ThreadPoolExecutor(workers) as ex:
+        for f in [
+            ex.submit(one_session, sid) for sid in range(sessions)
+        ]:
+            f.result()  # re-raise any session failure
+    lat_ms.sort()
+
+    def pct(p: float) -> float:
+        return round(lat_ms[int(p * (len(lat_ms) - 1))], 3)
+
+    return {
+        "sessions": sessions,
+        "workers": workers,
+        "target_node": name,
+        "top_height": top,
+        "p50_ms": pct(0.50),
+        "p99_ms": pct(0.99),
+        "plane": plane.stats(),
+    }
+
+
 async def run_schedule(
     schedule: FaultSchedule,
     seed: int,
@@ -776,6 +882,7 @@ async def run_schedule(
     profile_hz: float = 19.0,
     workload=None,
     enable_rpc: Optional[bool] = None,
+    light_storm: int = 0,
 ) -> ChaosReport:
     """Execute one seeded chaos run end-to-end and return its report
     (violations recorded, not raised — callers assert on report.ok).
@@ -883,6 +990,20 @@ async def run_schedule(
                                 net.heights(), target, liveness_bound_s
                             )
                         )
+                    )
+            if light_storm > 0 and net.running_nodes():
+                # serving-plane leg: storm a LIVE node with light
+                # sessions while consensus keeps running — a session
+                # failure or parity mismatch is a violation
+                try:
+                    report.light_storm = await asyncio.to_thread(
+                        _run_light_storm_sync, net, light_storm, seed
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    report.violations.append(
+                        f"light serving storm failed: {e!r}"
                     )
         finally:
             stop_polling.set()

@@ -1,5 +1,11 @@
 from .client import Client, TrustOptions, SEQUENTIAL, SKIPPING  # noqa: F401
 from .provider import Provider, StoreBackedProvider  # noqa: F401
+from .serving import (  # noqa: F401
+    CoalescedCommitVerifier,
+    LightServingPlane,
+    ServingOverloadError,
+    VerifiedHeaderCache,
+)
 from .store import LightStore  # noqa: F401
 from .types import LightBlock  # noqa: F401
 from . import verifier  # noqa: F401

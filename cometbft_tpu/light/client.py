@@ -57,6 +57,8 @@ class Client:
         trust_level: Fraction = verifier.DEFAULT_TRUST_LEVEL,
         max_clock_drift_ns: int = 10 * 10**9,
         signature_cache: Optional[T.SignatureCache] = None,
+        header_cache=None,
+        verify_engine=None,
     ):
         self.chain_id = chain_id
         self.trust = trust_options
@@ -75,6 +77,18 @@ class Client:
         self.trust_level = trust_level
         self.drift = max_clock_drift_ns
         self.cache = signature_cache or T.SignatureCache()
+        # cross-client serving seams (light/serving.py): a shared
+        # VerifiedHeaderCache of already-verified per-height blocks
+        # (consulted before fetching/verifying; published to only
+        # AFTER verification + witness cross-check) and a coalescing
+        # commit-verify engine concurrent clients batch through
+        self.header_cache = header_cache
+        self.verify_engine = verify_engine
+        # blocks verified by the CURRENT verify_header call, held back
+        # from the shared cache until the witness cross-check passes —
+        # a valid-but-forked chain (a light-client attack the detector
+        # would halt on) must never be published
+        self._publish_pending: list = []
         self.hops = 0  # bisection hop counter (observability)
         # serializes the verify/update entry points: the light proxy
         # runs them from multiple worker threads (background head
@@ -197,6 +211,15 @@ class Client:
     def verify_light_block_at_height(
         self, height: int, now_ns: Optional[int] = None
     ) -> LightBlock:
+        # shared-cache fast path OUTSIDE the client lock: a thousand
+        # sessions hitting a cached height must not serialize behind
+        # one client's in-flight bisection (light/serving.py)
+        if self.header_cache is not None and height:
+            cached = self.header_cache.get(height)
+            if cached is not None:
+                with self._lock:
+                    self.store.save(cached)
+                return cached
         with self._lock:
             now_ns = now_ns or time.time_ns()
             got = self.store.get(height)
@@ -237,6 +260,15 @@ class Client:
         set."""
         from .provider import LightBlockNotFound
 
+        # a height another session already VERIFIED needs no fetch at
+        # all — the shared cache is better than any provider (its
+        # entries are post-verification, post-cross-check). peek, not
+        # get: internal probes of ONE request must not inflate the
+        # request-level hit/miss counters the bridge exports
+        if self.header_cache is not None and height:
+            cached = self.header_cache.peek(height)
+            if cached is not None:
+                return cached
         try:
             return self.primary.light_block(height)
         except LightBlockNotFound as e:
@@ -301,7 +333,48 @@ class Client:
             raise LightClientError(
                 "conflicting header for already-trusted height"
             )
-        trusted = self.store.latest_before(target.height)
+        hc = self.header_cache
+        if hc is not None:
+            # peek: the enclosing request already counted its lookup
+            cached = hc.peek(target.height)
+            if cached is not None:
+                if cached.hash() == target.hash():
+                    self.store.save(cached)
+                    return cached
+                # forked-header detection MUST fire on a cache hit:
+                # the primary served a header conflicting with a
+                # block another session fully verified (and witness
+                # cross-checked) at this height
+                raise LightClientError(
+                    f"primary's header at height {target.height} "
+                    "conflicts with the cross-client verified cache "
+                    "(forked or lying primary)"
+                )
+        self._publish_pending = []
+        try:
+            out = self._verify_header_inner(target, now_ns)
+            if hc is not None:
+                # EVERY block this call stages — bisection pivots
+                # included — is witness-cross-checked before any of
+                # them is published: trusting verification lets a
+                # >1/3-colluding fork mint a crypto-valid PIVOT just
+                # as easily as a target, and an unchecked pivot in
+                # the shared cache would poison every session.
+                # _verify_header_inner already cross-checked the
+                # target itself; check the rest, THEN publish all.
+                for lb in self._publish_pending:
+                    if lb is not out:
+                        self._cross_check(lb)
+                for lb in self._publish_pending:
+                    hc.publish(lb)
+        finally:
+            self._publish_pending = []
+        return out
+
+    def _verify_header_inner(
+        self, target: LightBlock, now_ns: int
+    ) -> LightBlock:
+        trusted = self._best_trusted_before(target.height)
         if trusted is None:
             # target below every trusted header: hash-chain walk down
             # from the lowest trusted block (reference light/client.go
@@ -321,6 +394,28 @@ class Client:
 
     # --- verification strategies ---------------------------------------
 
+    def _best_trusted_before(self, height: int) -> Optional[LightBlock]:
+        """Bisection anchor: own trusted store, improved by the shared
+        cache's frontier when it is closer to the target (a pooled
+        serving client with a cold store picks up where ANY session
+        left off instead of re-walking from its trust root)."""
+        trusted = self.store.latest_before(height)
+        if self.header_cache is not None:
+            cached = self.header_cache.latest_before(height)
+            if cached is not None and (
+                trusted is None or cached.height > trusted.height
+            ):
+                self.store.save(cached)
+                trusted = cached
+        return trusted
+
+    def _note_verified(self, lb: LightBlock) -> None:
+        """Stage a freshly verified block for shared-cache publication
+        (held until the enclosing verify_header's cross-check)."""
+        self.store.save(lb)
+        if self.header_cache is not None:
+            self._publish_pending.append(lb)
+
     def _verify_sequential(
         self, trusted: LightBlock, target: LightBlock, now_ns: int
     ) -> None:
@@ -339,8 +434,9 @@ class Client:
                 now_ns,
                 self.drift,
                 cache=self.cache,
+                engine=self.verify_engine,
             )
-            self.store.save(nxt)
+            self._note_verified(nxt)
             trusted = nxt
             self.hops += 1
 
@@ -353,6 +449,24 @@ class Client:
         pivots = [target]
         while pivots:
             candidate = pivots[-1]
+            if self.header_cache is not None:
+                # peek: same request-internal probe as _primary_block
+                cached = self.header_cache.peek(candidate.height)
+                if cached is not None:
+                    if cached.hash() != candidate.hash():
+                        # the primary's hop conflicts with a block
+                        # another session verified + cross-checked:
+                        # fork detection on a cache hit
+                        raise LightClientError(
+                            f"primary's header at height "
+                            f"{candidate.height} conflicts with the "
+                            "cross-client verified cache"
+                        )
+                    self.store.save(cached)
+                    trusted = cached
+                    pivots.pop()
+                    self.hops += 1
+                    continue
             try:
                 if candidate.height == trusted.height + 1:
                     verifier.verify_adjacent(
@@ -364,6 +478,7 @@ class Client:
                         now_ns,
                         self.drift,
                         cache=self.cache,
+                        engine=self.verify_engine,
                     )
                 else:
                     trusted_next_vals = self._next_vals(trusted)
@@ -378,8 +493,9 @@ class Client:
                         self.drift,
                         self.trust_level,
                         cache=self.cache,
+                        engine=self.verify_engine,
                     )
-                self.store.save(candidate)
+                self._note_verified(candidate)
                 trusted = candidate
                 pivots.pop()
                 self.hops += 1
@@ -442,7 +558,7 @@ class Client:
             lower.validate_basic(self.chain_id)
             self.hops += 1
             cur = lower
-        self.store.save(target)
+        self._note_verified(target)
 
     def _next_vals(self, lb: LightBlock) -> T.ValidatorSet:
         """The valset signing height h+1 (trusted next-vals). For

@@ -28,11 +28,41 @@ from ..rpc.client import HTTPClient
 from ..rpc.core import _bytes_param
 from ..utils import codec
 from .client import Client
+from .serving import LightServingPlane, ServingOverloadError
+
+# JSON-RPC error code for an admission shed (server overloaded,
+# request is retryable) — distinct from -32603 internal error so SDK
+# retry policies can tell them apart
+RPC_OVERLOADED = -32005
 
 
 class LightProxy:
-    def __init__(self, client: Client, primary_url: str):
+    def __init__(
+        self,
+        client: Client,
+        primary_url: str,
+        *,
+        plane: Optional[LightServingPlane] = None,
+        max_sessions: int = 1024,
+        max_inflight: int = 32,
+        tracer=None,
+    ):
         self.lc = client
+        # the serving plane (light/serving.py): shared verified-header
+        # cache + coalesced verification + bounded instrumented
+        # admission. A caller-provided plane lets several fronts (the
+        # proxy + a statesyncing node) share one cache.
+        if plane is None:
+            kw = {"tracer": tracer} if tracer is not None else {}
+            plane = LightServingPlane(
+                [client],
+                max_sessions=max_sessions,
+                max_inflight=max_inflight,
+                **kw,
+            )
+        else:
+            plane.adopt_client(client)
+        self.plane = plane
         self.primary = HTTPClient(primary_url)
         self.app = web.Application()
         self.app.router.add_get("/{method}", self._handle)
@@ -57,13 +87,12 @@ class LightProxy:
     # --- verified route implementations -------------------------------
 
     async def _verified_light_block(self, height: Optional[int]):
-        """Run the (blocking) light client off-loop."""
+        """Run the (blocking) serving plane off-loop: shared cache →
+        single-flight → coalesced verification (light/serving.py)."""
         if height is None:
             st = await self.primary.status()
             height = int(st["sync_info"]["latest_block_height"])
-        return await asyncio.to_thread(
-            self.lc.verify_light_block_at_height, height
-        )
+        return await asyncio.to_thread(self.plane.serve, height)
 
     async def _call(self, method: str, params: Dict[str, Any]):
         h = params.get("height")
@@ -130,6 +159,11 @@ class LightProxy:
             return await self._verified_block_results(h)
         if method == "consensus_params":
             return await self._verified_consensus_params(h)
+        if method == "serving_status":
+            # local introspection: sessions, admission gate, cache +
+            # coalesce stats (docs/PERF.md "Light-client serving
+            # plane") — never touches the primary
+            return self.plane.stats()
         # passthrough (tx submission, unverifiable routes)
         return await self.primary.call(method, **params)
 
@@ -340,10 +374,37 @@ class LightProxy:
         )
 
     async def _respond(self, method, params, id_) -> web.Response:
+        # each in-flight HTTP request is one serving session: the
+        # plane bounds them (max_sessions) and sheds-and-counts past
+        # the bound rather than queueing unbounded work
+        try:
+            session = self.plane.open_session()
+        except ServingOverloadError as e:
+            return web.json_response(
+                {
+                    "jsonrpc": "2.0",
+                    "id": id_,
+                    "error": {
+                        "code": RPC_OVERLOADED,
+                        "message": f"overloaded: {e}",
+                    },
+                }
+            )
         try:
             result = await self._call(method, params)
             return web.json_response(
                 {"jsonrpc": "2.0", "id": id_, "result": result}
+            )
+        except ServingOverloadError as e:
+            return web.json_response(
+                {
+                    "jsonrpc": "2.0",
+                    "id": id_,
+                    "error": {
+                        "code": RPC_OVERLOADED,
+                        "message": f"overloaded: {e}",
+                    },
+                }
             )
         except Exception as e:
             return web.json_response(
@@ -353,3 +414,5 @@ class LightProxy:
                     "error": {"code": -32603, "message": str(e)},
                 }
             )
+        finally:
+            session.close()

@@ -51,6 +51,7 @@ def verify_adjacent(
     now_ns: Optional[int] = None,
     max_clock_drift_ns: int = 10 * 10**9,
     cache: Optional[T.SignatureCache] = None,
+    engine=None,
 ) -> None:
     now_ns = now_ns or time.time_ns()
     if untrusted.height != trusted.height + 1:
@@ -64,6 +65,17 @@ def verify_adjacent(
         raise ErrInvalidHeader(
             "untrusted validators hash != trusted next validators hash"
         )
+    if engine is not None:
+        # cross-client coalesce seam (light/serving.py): concurrent
+        # sessions' commit checks land in one lane batch, verdicts
+        # serial-equivalent (same exception types as the direct call)
+        engine.verify_commit_light(
+            untrusted_vals,
+            untrusted.commit.block_id,
+            untrusted.height,
+            untrusted.commit,
+        )
+        return
     T.verify_commit_light(
         chain_id,
         untrusted_vals,
@@ -85,6 +97,7 @@ def verify_non_adjacent(
     max_clock_drift_ns: int = 10 * 10**9,
     trust_level: Fraction = DEFAULT_TRUST_LEVEL,
     cache: Optional[T.SignatureCache] = None,
+    engine=None,
 ) -> None:
     now_ns = now_ns or time.time_ns()
     if untrusted.height == trusted.height + 1:
@@ -94,6 +107,20 @@ def verify_non_adjacent(
     _verify_new_header(
         chain_id, trusted, untrusted, now_ns, max_clock_drift_ns
     )
+    if engine is not None:
+        try:
+            engine.verify_commit_light_trusting(
+                trusted_next_vals, untrusted.commit, trust_level
+            )
+        except T.ErrNotEnoughVotingPower as e:
+            raise ErrNewValSetCantBeTrusted(str(e))
+        engine.verify_commit_light(
+            untrusted_vals,
+            untrusted.commit.block_id,
+            untrusted.height,
+            untrusted.commit,
+        )
+        return
     try:
         T.verify_commit_light_trusting(
             chain_id,

@@ -201,6 +201,11 @@ class Node:
         self.grpc_server = None
         self._statesync_task = None
         self.statesync_error = None
+        # cross-client verified-header cache (light/serving.py):
+        # injectable so a co-resident serving plane and this node's
+        # statesync restore share verification work; lazily created
+        # by _statesync_routine otherwise
+        self.light_header_cache = None
         self.metrics = None
         self.metrics_server = None
         self.debug_server = None
@@ -305,6 +310,29 @@ class Node:
 
         cfg = self.config.statesync
         try:
+            # the restore shares verification work with any light
+            # serving plane in this process (light/serving.py): an
+            # injected node.light_header_cache wins; otherwise the
+            # node gets its own (a retried sync then re-pays
+            # nothing). Sharing contract guard: with a SINGLE rpc
+            # server the restore client has zero witnesses, so its
+            # cross-check is vacuous — what the sole (untrusted)
+            # primary serves must then only ever reach a cache
+            # PRIVATE to this restore, never process-shared state a
+            # serving plane would hand to every session
+            from ..light.serving import VerifiedHeaderCache
+
+            if len(cfg.rpc_servers) >= 2:
+                header_cache = self.light_header_cache
+                if header_cache is None:
+                    header_cache = VerifiedHeaderCache(
+                        self.genesis.chain_id
+                    )
+                    self.light_header_cache = header_cache
+            else:
+                header_cache = VerifiedHeaderCache(
+                    self.genesis.chain_id
+                )
             # constructor light-verifies the trust root (blocking
             # HTTP) — keep it off this event loop
             provider = await asyncio.to_thread(
@@ -317,6 +345,7 @@ class Node:
                 else cfg.trust_hash,
                 int(cfg.trust_period_s * 1e9),
                 genesis=self.genesis,
+                header_cache=header_cache,
             )
             try:
                 state = await self.statesync_reactor.sync(

@@ -26,11 +26,12 @@ Four coordinated pieces:
 
 from .budget import evaluate_budgets, format_verdicts, load_budgets
 from .profiler import SamplingProfiler
-from .queues import InstrumentedQueue, QueueRegistry
+from .queues import InstrumentedGate, InstrumentedQueue, QueueRegistry
 from .shutdown import ShutdownGuard
 from .watchdog import LoopWatchdog
 
 __all__ = [
+    "InstrumentedGate",
     "InstrumentedQueue",
     "LoopWatchdog",
     "QueueRegistry",

@@ -28,6 +28,7 @@ evaluated at read time.
 from __future__ import annotations
 
 import asyncio
+import threading
 from typing import Callable, Dict, Optional
 
 
@@ -60,6 +61,83 @@ class InstrumentedQueue(asyncio.Queue):
             "enqueued": self.enqueued,
             "dropped": self.dropped,
             "maxsize": self.maxsize,
+        }
+
+
+class InstrumentedGate:
+    """Thread-safe bounded-concurrency gate with the same stats
+    contract as InstrumentedQueue (depth = current holders).
+
+    The light-client serving plane admits request work through one of
+    these (light/serving.py): ``try_enter`` never blocks — overload is
+    a SHED (counted in ``dropped``), not a queue, so a thousand
+    stalled sessions can't pile unbounded work behind a slow verify.
+    Registered in a QueueRegistry exactly like a queue; ``maxsize``
+    keeps the health route's depth>=maxsize overload convention.
+    """
+
+    def __init__(self, limit: int, *, name: str = "") -> None:
+        if limit < 1:
+            raise ValueError("gate limit must be >= 1")
+        self.name = name
+        self.limit = limit
+        self._cond = threading.Condition()
+        self._holders = 0
+        self.high_watermark = 0
+        self.entered = 0
+        self.dropped = 0
+
+    def _admit_locked(self) -> None:
+        self._holders += 1
+        self.entered += 1
+        if self._holders > self.high_watermark:
+            self.high_watermark = self._holders
+
+    def try_enter(self) -> bool:
+        with self._cond:
+            if self._holders >= self.limit:
+                self.dropped += 1
+                return False
+            self._admit_locked()
+            return True
+
+    def enter(self, timeout: float = 0.0) -> bool:
+        """Admit, waiting up to ``timeout`` seconds for a slot (a
+        BOUNDED wait absorbs admission bursts without letting work
+        pile unbounded); past the timeout the request is shed and
+        counted."""
+        with self._cond:
+            if self._holders < self.limit:
+                self._admit_locked()
+                return True
+            if timeout > 0 and self._cond.wait_for(
+                lambda: self._holders < self.limit, timeout=timeout
+            ):
+                self._admit_locked()
+                return True
+            self.dropped += 1
+            return False
+
+    def exit(self) -> None:
+        with self._cond:
+            if self._holders > 0:
+                self._holders -= 1
+            self._cond.notify()
+
+    def count_drop(self, n: int = 1) -> None:
+        with self._cond:
+            self.dropped += n
+
+    def depth(self) -> int:
+        return self._holders
+
+    def stats(self) -> dict:
+        return {
+            "depth": self._holders,
+            "high_watermark": self.high_watermark,
+            "enqueued": self.entered,
+            "dropped": self.dropped,
+            "maxsize": self.limit,
         }
 
 

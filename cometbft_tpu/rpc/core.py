@@ -176,6 +176,14 @@ def health(env) -> Dict[str, Any]:
         conn["status"] = "degraded" if conn_reasons else "ok"
         out["connectivity"] = conn
         reasons.extend(conn_reasons)
+    hc_fn = getattr(env, "light_header_cache_fn", None)
+    hc = hc_fn() if hc_fn is not None else None
+    if hc is not None and len(hc):
+        # shared verified-header cache (light/serving.py): present
+        # once statesync restored through it or a co-resident serving
+        # plane injected one — hit/miss/flight counters for "is the
+        # serving side sharing verification work"
+        out["light_header_cache"] = hc.stats()
     bd = getattr(env.consensus_state, "last_commit_breakdown", None)
     if bd is not None:
         # per-phase attribution of the last committed height (ISSUE 7

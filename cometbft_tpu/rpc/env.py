@@ -29,6 +29,11 @@ class Environment:
     # (inspect mode / watchdog disabled)
     loop_watchdog: object = None
     queues: object = None  # obs.QueueRegistry
+    # () -> light.serving.VerifiedHeaderCache | None, read lazily:
+    # the node creates its shared header cache when statesync (or a
+    # co-resident serving plane) first needs it, which can be after
+    # this Environment was built
+    light_header_cache_fn: object = None
 
     def submit_tx(self, tx: bytes):
         """CheckTx + (app-mempool) gossip: RPC broadcast entry point
@@ -99,4 +104,7 @@ class Environment:
             mempool_reactor=node.mempool_reactor,
             loop_watchdog=getattr(node, "loop_watchdog", None),
             queues=getattr(node, "queues", None),
+            light_header_cache_fn=lambda: getattr(
+                node, "light_header_cache", None
+            ),
         )

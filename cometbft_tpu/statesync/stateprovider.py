@@ -27,6 +27,9 @@ class LightClientStateProvider:
         trust_hash: bytes,
         trust_period_ns: int,
         genesis=None,
+        header_cache=None,
+        signature_cache=None,
+        verify_engine=None,
     ):
         if not rpc_servers:
             raise ValueError("statesync requires at least one RPC server")
@@ -36,6 +39,13 @@ class LightClientStateProvider:
         self.witnesses = [
             HTTPProvider(chain_id, s) for s in rpc_servers[1:]
         ]
+        # shared serving seams (light/serving.py, ROADMAP item 3):
+        # a joining node is the ready-made first consumer of the
+        # cross-client VerifiedHeaderCache — heights that concurrent
+        # light sessions (or an earlier sync attempt) already verified
+        # restore without re-paying commit verification, and what THIS
+        # sync verifies is published for them (after cross-check)
+        self.header_cache = header_cache
         self.client = Client(
             chain_id,
             TrustOptions(
@@ -45,7 +55,17 @@ class LightClientStateProvider:
             ),
             primary=self.primary,
             witnesses=self.witnesses,
+            signature_cache=signature_cache,
+            header_cache=header_cache,
+            verify_engine=verify_engine,
         )
+
+    def cache_stats(self) -> dict:
+        """Shared-verification observability for the syncer's log."""
+        out = {"bisection_hops": self.client.hops}
+        if self.header_cache is not None:
+            out.update(self.header_cache.stats())
+        return out
 
     def app_hash(self, height: int) -> bytes:
         """App hash AFTER executing block `height` (header h+1)."""

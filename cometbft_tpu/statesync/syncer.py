@@ -144,7 +144,23 @@ class Syncer:
                 self.pool.reject(key)
                 continue
             try:
-                return await self._sync_one(key, peers)
+                result = await self._sync_one(key, peers)
+                # shared-verification accounting (light/serving.py):
+                # how much of the light-verified restore rode the
+                # cross-client header cache vs was verified fresh —
+                # the "joining node shares work with light sessions"
+                # story made auditable per sync
+                stats_fn = getattr(self.provider, "cache_stats", None)
+                if stats_fn is not None:
+                    try:
+                        _log.info(
+                            "light-verified restore complete",
+                            height=key.height,
+                            **stats_fn(),
+                        )
+                    except Exception:
+                        pass
+                return result
             except SnapshotRejected as e:
                 # logged: a run that ends in "no viable snapshots"
                 # after REJECTING offers is a different failure than

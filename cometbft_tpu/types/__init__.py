@@ -34,6 +34,7 @@ from .validation import (  # noqa: F401
     ErrInvalidSignature,
     ErrNotEnoughVotingPower,
     verify_commit,
+    verify_commit_jobs_coalesced,
     verify_commit_light,
     verify_commit_light_trusting,
     verify_extended_commit,

@@ -189,6 +189,116 @@ def verify_commit(
         )
 
 
+def _collect_light_lanes(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: Optional[BlockID],
+    height: int,
+    commit: Commit,
+    all_signatures: bool,
+    items: list,
+) -> list:
+    """Shared lane builder for LIGHT verification — the serial path
+    and the coalesced jobs path both run exactly this, so their
+    verdicts cannot drift. Appends (pubkey, sign_bytes, sig) lanes to
+    ``items``; returns [(lane_idx, validator_idx)]. Raises
+    CommitVerifyError on structural failures."""
+    _basic_checks(vals, commit, height, block_id)
+    total = vals.total_voting_power()
+    lanes = []
+    tallied_known = 0
+    for i, cs in enumerate(commit.signatures):
+        if not cs.for_block():
+            continue
+        val = vals.get_by_index(i)
+        if val.address != cs.validator_address:
+            raise CommitVerifyError(f"commit sig {i} address mismatch")
+        lanes.append((len(items), i))
+        items.append(
+            (val.pub_key, _commit_sign_bytes(chain_id, commit, cs), cs.signature)
+        )
+        tallied_known += val.voting_power
+        if not all_signatures and tallied_known * 3 > total * 2:
+            break  # enough power collected; verify just these lanes
+    return lanes
+
+
+def _fold_light_lanes(
+    lanes: list, oks: list, vals: ValidatorSet, commit: Commit
+) -> None:
+    """Shared tally/verdict fold for LIGHT verification."""
+    tallied = 0
+    for lane, i in lanes:
+        if not oks[lane]:
+            raise ErrInvalidSignature(f"invalid signature for validator {i}")
+        if commit.signatures[i].for_block():
+            tallied += vals.get_by_index(i).voting_power
+    total = vals.total_voting_power()
+    if not tallied * 3 > total * 2:
+        raise ErrNotEnoughVotingPower(
+            f"tallied {tallied} <= 2/3 of {total}"
+        )
+
+
+def _collect_trusting_lanes(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    trust_level: Fraction,
+    all_signatures: bool,
+    items: list,
+):
+    """Shared lane builder for TRUSTING verification (see
+    _collect_light_lanes). Returns ([(lane_idx, voting_power)],
+    total, need)."""
+    if commit is None:
+        raise CommitVerifyError("nil commit")
+    if trust_level.numerator * 3 < trust_level.denominator or (
+        trust_level.numerator > trust_level.denominator
+    ):
+        raise CommitVerifyError("trust level must be in [1/3, 1]")
+    total = vals.total_voting_power()
+    need = total * trust_level.numerator
+    lanes = []
+    seen = set()
+    tallied_known = 0
+    for cs in commit.signatures:
+        if not cs.for_block():
+            continue
+        idx, val = vals.get_by_address(cs.validator_address)
+        if idx < 0:
+            continue
+        if idx in seen:
+            raise CommitVerifyError("double vote from same validator")
+        seen.add(idx)
+        lanes.append((len(items), val.voting_power))
+        items.append(
+            (val.pub_key, _commit_sign_bytes(chain_id, commit, cs), cs.signature)
+        )
+        tallied_known += val.voting_power
+        if (
+            not all_signatures
+            and tallied_known * trust_level.denominator > need
+        ):
+            break
+    return lanes, total, need
+
+
+def _fold_trusting_lanes(
+    lanes: list, oks: list, total, need, trust_level: Fraction
+) -> None:
+    """Shared tally/verdict fold for TRUSTING verification."""
+    tallied = 0
+    for lane, power in lanes:
+        if not oks[lane]:
+            raise ErrInvalidSignature("invalid signature in trusted commit")
+        tallied += power
+    if not tallied * trust_level.denominator > need:
+        raise ErrNotEnoughVotingPower(
+            f"trusted tally {tallied} <= {trust_level} of {total}"
+        )
+
+
 def verify_commit_light(
     chain_id: str,
     vals: ValidatorSet,
@@ -201,33 +311,12 @@ def verify_commit_light(
     """Light verification: only signatures for block_id are checked and
     tallied up to the 2/3 threshold (reference :65; all_signatures=True
     checks every block signature — evidence mode, reference :96)."""
-    _basic_checks(vals, commit, height, block_id)
-    total = vals.total_voting_power()
-    items, tally_idx = [], []
-    tallied_known = 0
-    for i, cs in enumerate(commit.signatures):
-        if not cs.for_block():
-            continue
-        val = vals.get_by_index(i)
-        if val.address != cs.validator_address:
-            raise CommitVerifyError(f"commit sig {i} address mismatch")
-        items.append(
-            (val.pub_key, _commit_sign_bytes(chain_id, commit, cs), cs.signature)
-        )
-        tally_idx.append(i)
-        tallied_known += val.voting_power
-        if not all_signatures and tallied_known * 3 > total * 2:
-            break  # enough power collected; verify just these lanes
+    items: list = []
+    lanes = _collect_light_lanes(
+        chain_id, vals, block_id, height, commit, all_signatures, items
+    )
     oks = _run_batch(items, cache)
-    tallied = 0
-    for (i, ok) in zip(tally_idx, oks):
-        if not ok:
-            raise ErrInvalidSignature(f"invalid signature for validator {i}")
-        tallied += vals.get_by_index(i).voting_power
-    if not tallied * 3 > total * 2:
-        raise ErrNotEnoughVotingPower(
-            f"tallied {tallied} <= 2/3 of {total}"
-        )
+    _fold_light_lanes(lanes, oks, vals, commit)
 
 
 def verify_commits_coalesced_async(
@@ -342,6 +431,72 @@ def verify_commits_coalesced(
     ).result()
 
 
+def verify_commit_jobs_coalesced(
+    chain_id: str,
+    jobs,
+    cache: Optional[SignatureCache] = None,
+) -> list:
+    """Mixed-kind coalesced verification: MANY light and trusting
+    commit checks land in ONE lane batch (the light-client serving
+    plane's cross-client seam, light/serving.py — a bisection hop is
+    one trusting + one light check, and concurrent clients' hops
+    coalesce here).
+
+    jobs: list of either
+        ("light", vals, block_id, height, commit)
+        ("trusting", vals, commit, trust_level)
+
+    Returns one entry per job: None (success) or the exact
+    CommitVerifyError subclass the serial path raises —
+    serial-equivalence is BY CONSTRUCTION: collection and fold run
+    the same _collect_*/_fold_* helpers verify_commit_light and
+    verify_commit_light_trusting run, just over one shared lane
+    batch (asserted end to end by tests/test_light_serving.py and
+    in-bench)."""
+    items: list = []
+    metas: list = []
+    errors: list = [None] * len(jobs)
+    for j, job in enumerate(jobs):
+        kind = job[0]
+        try:
+            if kind == "light":
+                _, vals, block_id, height, commit = job
+                lanes = _collect_light_lanes(
+                    chain_id, vals, block_id, height, commit, False,
+                    items,
+                )
+                metas.append(("light", lanes, vals, commit))
+            elif kind == "trusting":
+                _, vals, commit, trust_level = job
+                lanes, total, need = _collect_trusting_lanes(
+                    chain_id, vals, commit, trust_level, False, items
+                )
+                metas.append(
+                    ("trusting", lanes, total, need, trust_level)
+                )
+            else:
+                raise CommitVerifyError(f"unknown job kind {kind!r}")
+        except CommitVerifyError as e:
+            errors[j] = e
+            metas.append(None)
+    oks = _run_batch(items, cache)
+    for j, meta in enumerate(metas):
+        if meta is None:
+            continue
+        try:
+            if meta[0] == "light":
+                _, lanes, vals, commit = meta
+                _fold_light_lanes(lanes, oks, vals, commit)
+            else:
+                _, lanes, total, need, trust_level = meta
+                _fold_trusting_lanes(
+                    lanes, oks, total, need, trust_level
+                )
+        except CommitVerifyError as e:
+            errors[j] = e
+    return errors
+
+
 def verify_commit_light_trusting(
     chain_id: str,
     vals: ValidatorSet,
@@ -353,46 +508,12 @@ def verify_commit_light_trusting(
     """Trusting verification against an *old* validator set: tally power
     of trusted validators who signed; require > trust_level of trusted
     total (reference :148; used by light bisection + evidence)."""
-    if commit is None:
-        raise CommitVerifyError("nil commit")
-    if trust_level.numerator * 3 < trust_level.denominator or (
-        trust_level.numerator > trust_level.denominator
-    ):
-        raise CommitVerifyError("trust level must be in [1/3, 1]")
-    total = vals.total_voting_power()
-    need = total * trust_level.numerator
-    items, powers = [], []
-    seen = set()
-    tallied_known = 0
-    for cs in commit.signatures:
-        if not cs.for_block():
-            continue
-        idx, val = vals.get_by_address(cs.validator_address)
-        if idx < 0:
-            continue
-        if idx in seen:
-            raise CommitVerifyError("double vote from same validator")
-        seen.add(idx)
-        items.append(
-            (val.pub_key, _commit_sign_bytes(chain_id, commit, cs), cs.signature)
-        )
-        powers.append(val.voting_power)
-        tallied_known += val.voting_power
-        if (
-            not all_signatures
-            and tallied_known * trust_level.denominator > need
-        ):
-            break
+    items: list = []
+    lanes, total, need = _collect_trusting_lanes(
+        chain_id, vals, commit, trust_level, all_signatures, items
+    )
     oks = _run_batch(items, cache)
-    tallied = 0
-    for ok, p in zip(oks, powers):
-        if not ok:
-            raise ErrInvalidSignature("invalid signature in trusted commit")
-        tallied += p
-    if not tallied * trust_level.denominator > need:
-        raise ErrNotEnoughVotingPower(
-            f"trusted tally {tallied} <= {trust_level} of {total}"
-        )
+    _fold_trusting_lanes(lanes, oks, total, need, trust_level)
 
 
 def verify_extended_commit(

@@ -39,6 +39,7 @@ FIXTURE_PATHS = {
     "ASY110": "cometbft_tpu/p2p/x.py",
     "ASY111": "cometbft_tpu/consensus/x.py",
     "ASY112": "cometbft_tpu/p2p/x.py",
+    "ASY113": "cometbft_tpu/light/x.py",
 }
 
 
@@ -407,6 +408,33 @@ FIXTURES = [
             # barriers route through the WAL group-commit seam
             self.wal.write_sync(msg)
             return self.wal.write_group(msg)
+        """,
+    ),
+    (
+        "ASY113",  # uncoalesced-verify-in-light (FIXTURE_PATHS)
+        """
+        from .. import types as T
+        def check(chain_id, vals, block_id, height, commit):
+            T.verify_commit_light(
+                chain_id, vals, block_id, height, commit
+            )
+            T.verify_commit_light_trusting(
+                chain_id, vals, commit, cache=None
+            )
+        """,
+        """
+        from .. import types as T
+        def check(self, chain_id, vals, block_id, height, commit):
+            T.verify_commit_light(
+                chain_id, vals, block_id, height, commit,
+                cache=self.cache,
+            )
+            self.engine.verify_commit_light(
+                vals, block_id, height, commit
+            )
+            engine.verify_commit_light_trusting(
+                vals, commit, level
+            )
         """,
     ),
     (

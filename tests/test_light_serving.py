@@ -1,0 +1,927 @@
+"""Light-client serving plane (ISSUE 13): shared verified-header
+cache, single-flight dedup, coalesced cross-client verification,
+bounded instrumented sessions — and the divergence-detection /
+cache-poisoning guarantees:
+
+- forked-header detection still fires when bisection anchors ride
+  cache HITS (a lunatic fork verifies crypto-wise, the witness
+  cross-check halts it);
+- a poisoned cache entry is impossible by construction: publication
+  happens only after verification + cross-check, failed verification
+  publishes nothing, and the cache re-validates internal consistency.
+"""
+
+import dataclasses
+import threading
+import time
+
+import pytest
+
+import cometbft_tpu.types as T
+from cometbft_tpu.light import serving
+from cometbft_tpu.light.client import Client, LightClientError, TrustOptions
+from cometbft_tpu.light.detector import DivergenceError
+from cometbft_tpu.light.provider import StoreBackedProvider
+from cometbft_tpu.light.serving import (
+    CachePoisonError,
+    CoalescedCommitVerifier,
+    LightServingPlane,
+    ServingOverloadError,
+    VerifiedHeaderCache,
+)
+from cometbft_tpu.light.types import LightBlock
+from cometbft_tpu.node.inprocess import make_genesis
+from cometbft_tpu.utils.chaingen import make_chain
+
+N_VALS = 4
+CHAIN_LEN = 14
+TRUST_PERIOD_NS = 24 * 3600 * 10**9
+
+
+@pytest.fixture(scope="module")
+def chain():
+    gen, pvs = make_genesis(N_VALS, chain_id="serve-chain")
+    node = make_chain(gen, [pv.priv_key for pv in pvs], CHAIN_LEN)
+    yield gen, pvs, node
+    node.close_stores()
+
+
+def _provider(gen, node):
+    return StoreBackedProvider(
+        gen.chain_id, node.block_store, node.state_store
+    )
+
+
+def _client(gen, node, provider=None, **kw):
+    provider = provider or _provider(gen, node)
+    root = provider.light_block(1)
+    return Client(
+        gen.chain_id,
+        TrustOptions(period_ns=TRUST_PERIOD_NS, height=1, hash=root.hash()),
+        provider,
+        **kw,
+    )
+
+
+# --- VerifiedHeaderCache ------------------------------------------------
+
+
+def test_cache_hit_miss_ttl_and_lru(chain, monkeypatch):
+    gen, _, node = chain
+    lb5 = _provider(gen, node).light_block(5)
+    cache = VerifiedHeaderCache(gen.chain_id, max_entries=2, ttl_s=100.0)
+    assert cache.get(5) is None and cache.misses == 1
+    cache.publish(lb5)
+    assert cache.get(5) is lb5 and cache.hits == 1
+
+    # TTL expiry (virtual clock)
+    now = [time.monotonic()]
+    monkeypatch.setattr(serving, "_monotonic", lambda: now[0])
+    cache2 = VerifiedHeaderCache(gen.chain_id, ttl_s=10.0)
+    cache2.publish(lb5)
+    assert cache2.get(5) is lb5
+    now[0] += 11.0
+    assert cache2.get(5) is None and cache2.expired == 1
+
+    # LRU bound: max_entries=2, publishing a third evicts the oldest
+    prov = _provider(gen, node)
+    cache.publish(prov.light_block(6))
+    cache.publish(prov.light_block(7))
+    assert len(cache) == 2 and cache.peek(5) is None
+    # latest_before respects the strict bound
+    assert cache.latest_before(7).height == 6
+    assert cache.latest_before(6) is None  # 5 was evicted
+
+
+def test_cache_refuses_inconsistent_blocks(chain):
+    """Defense in depth: even the sanctioned write path re-validates
+    the header/commit/valset binding — an internally inconsistent
+    block can never enter, whatever the caller's bug."""
+    gen, _, node = chain
+    lb = _provider(gen, node).light_block(5)
+    cache = VerifiedHeaderCache(gen.chain_id)
+    poisoned = LightBlock(
+        header=dataclasses.replace(lb.header, app_hash=b"\x55" * 32),
+        commit=lb.commit,  # commit binds to the REAL header
+        validator_set=lb.validator_set,
+    )
+    with pytest.raises(CachePoisonError):
+        cache.publish(poisoned)
+    assert len(cache) == 0
+    # wrong chain id is refused too
+    with pytest.raises(CachePoisonError):
+        VerifiedHeaderCache("other-chain").publish(lb)
+
+
+def test_failed_verification_publishes_nothing(chain):
+    """The ONLY insertion paths run post-verification: a verify_fn
+    that raises leaves the cache empty, and every waiting follower
+    shares the leader's error."""
+    gen, _, node = chain
+    cache = VerifiedHeaderCache(gen.chain_id)
+    calls = []
+
+    def bad_verify(height):
+        calls.append(height)
+        time.sleep(0.05)
+        raise LightClientError("verification failed")
+
+    errs = []
+
+    def req():
+        try:
+            cache.get_or_verify(9, bad_verify)
+        except LightClientError as e:
+            errs.append(e)
+
+    ths = [threading.Thread(target=req) for _ in range(6)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert len(calls) == 1  # single flight even on failure
+    assert len(errs) == 6
+    assert len(cache) == 0 and cache.peek(9) is None
+
+
+def test_single_flight_dedups_concurrent_requests(chain):
+    gen, _, node = chain
+    prov = _provider(gen, node)
+    cache = VerifiedHeaderCache(gen.chain_id)
+    calls = []
+    lb8 = prov.light_block(8)
+
+    def verify(height):
+        calls.append(height)
+        time.sleep(0.05)  # hold the flight so followers pile up
+        return lb8
+
+    got = []
+    ths = [
+        threading.Thread(
+            target=lambda: got.append(cache.get_or_verify(8, verify))
+        )
+        for _ in range(10)
+    ]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert len(calls) == 1, "N concurrent requests must verify ONCE"
+    assert all(b is lb8 for b in got)
+    assert cache.flight_waits >= 1
+    assert cache.peek(8) is lb8  # leader's result was published
+
+
+# --- coalesced verification --------------------------------------------
+
+
+def test_coalesced_verdicts_serial_equivalent(chain):
+    """The engine's verdicts — success AND failure kinds — must be
+    exactly what the serial verify_commit_light/_trusting produce,
+    including forged-signature and not-enough-power cases."""
+    from fractions import Fraction
+
+    gen, _, node = chain
+    prov = _provider(gen, node)
+    good = prov.light_block(5)
+    forged = dataclasses.replace(
+        good.commit,
+        signatures=[
+            dataclasses.replace(
+                good.commit.signatures[0], signature=bytes(64)
+            )
+        ]
+        + list(good.commit.signatures[1:]),
+    )
+    # a "trusting" check against a foreign valset: nobody overlaps ->
+    # not enough trusted power
+    foreign, _ = T.random_validator_set(4)
+
+    jobs = [
+        ("light", good.validator_set, good.commit.block_id,
+         good.height, good.commit),
+        ("light", good.validator_set, good.commit.block_id,
+         good.height, forged),
+        ("trusting", good.validator_set, good.commit, Fraction(1, 3)),
+        ("trusting", foreign, good.commit, Fraction(1, 3)),
+    ]
+
+    def serial(job):
+        try:
+            if job[0] == "light":
+                T.verify_commit_light(
+                    gen.chain_id, job[1], job[2], job[3], job[4]
+                )
+            else:
+                T.verify_commit_light_trusting(
+                    gen.chain_id, job[1], job[2], trust_level=job[3]
+                )
+            return None
+        except T.CommitVerifyError as e:
+            return type(e)
+
+    want = [serial(j) for j in jobs]
+    assert want[1] is T.ErrInvalidSignature
+    assert want[3] is T.ErrNotEnoughVotingPower
+
+    engine = CoalescedCommitVerifier(gen.chain_id, window_s=0.02)
+    got = [None] * len(jobs)
+
+    def submit(i, job):
+        try:
+            if job[0] == "light":
+                engine.verify_commit_light(
+                    job[1], job[2], job[3], job[4]
+                )
+            else:
+                engine.verify_commit_light_trusting(
+                    job[1], job[2], job[3]
+                )
+        except T.CommitVerifyError as e:
+            got[i] = type(e)
+
+    ths = [
+        threading.Thread(target=submit, args=(i, j))
+        for i, j in enumerate(jobs)
+    ]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert got == want
+    st = engine.stats()
+    assert st["submitted"] == 4
+    assert st["max_batch"] >= 2, "concurrent jobs must share a batch"
+
+
+def test_verdict_cache_skips_reverification(chain):
+    """The promoted cross-client verdict: the same commit verified by
+    one session resolves instantly for the next (keyed by content,
+    not object identity)."""
+    gen, _, node = chain
+    prov = _provider(gen, node)
+    cache = VerifiedHeaderCache(gen.chain_id)
+    engine = CoalescedCommitVerifier(
+        gen.chain_id, verdict_cache=cache, window_s=0.001
+    )
+    lb = prov.light_block(6)
+    engine.verify_commit_light(
+        lb.validator_set, lb.commit.block_id, lb.height, lb.commit
+    )
+    assert engine.dispatches == 1
+    # a FRESH fetch of the same height = different objects, same key
+    lb2 = _provider(gen, node).light_block(6)
+    assert lb2 is not lb
+    engine.verify_commit_light(
+        lb2.validator_set, lb2.commit.block_id, lb2.height, lb2.commit
+    )
+    assert engine.dispatches == 1  # no second crypto dispatch
+    assert engine.verdict_hits == 1
+    # failures were NOT recorded: a forged commit re-verifies (and
+    # fails again) rather than riding any cached verdict
+    forged = dataclasses.replace(
+        lb.commit,
+        signatures=[
+            dataclasses.replace(
+                lb.commit.signatures[0], signature=bytes(64)
+            )
+        ]
+        + list(lb.commit.signatures[1:]),
+    )
+    for _ in range(2):
+        with pytest.raises(T.ErrInvalidSignature):
+            engine.verify_commit_light(
+                lb.validator_set, lb.commit.block_id, lb.height, forged
+            )
+    assert engine.dispatches == 3
+
+
+# --- sessions / admission ----------------------------------------------
+
+
+def test_plane_shares_verification_across_sessions(chain):
+    gen, _, node = chain
+    prov = _provider(gen, node)
+    plane = LightServingPlane(
+        [_client(gen, node, prov), _client(gen, node, prov)]
+    )
+    fetched_before = None
+    results = []
+
+    def one(h):
+        with plane.open_session() as s:
+            results.append(s.verified_block(h))
+
+    ths = [
+        threading.Thread(target=one, args=(4 + (i % 3),))
+        for i in range(12)
+    ]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert len(results) == 12
+    by_h = {lb.height for lb in results}
+    assert by_h == {4, 5, 6}
+    st = plane.stats()
+    # single flight: three distinct heights -> at most three
+    # verifications entered the engine/flights no matter the 12
+    # sessions (anchors/pivots may add a few cache ops, but every
+    # served height was published exactly once)
+    assert st["cache"]["published"] <= CHAIN_LEN
+    # concurrent arrivals shared flights (or the late ones hit)
+    assert st["cache"]["flight_waits"] + st["cache"]["hits"] > 0
+    # second wave is pure cache
+    before = st["cache"]["published"]
+    for h in (4, 5, 6):
+        with plane.open_session() as s:
+            assert s.verified_block(h).height == h
+    st2 = plane.stats()
+    assert st2["cache"]["published"] == before
+    assert st2["cache"]["hits"] > 0
+    del fetched_before
+
+
+def test_plane_session_bound_sheds_and_counts(chain):
+    gen, _, node = chain
+    plane = LightServingPlane([_client(gen, node)], max_sessions=2)
+    s1 = plane.open_session()
+    s2 = plane.open_session()
+    with pytest.raises(ServingOverloadError):
+        plane.open_session()
+    assert plane.sessions_shed == 1
+    assert plane.gate.stats()["dropped"] >= 1
+    s1.close()
+    s3 = plane.open_session()  # freed slot admits again
+    s3.close()
+    s2.close()
+    assert plane.active_sessions() == 0
+
+
+def test_plane_inflight_gate_sheds_under_storm(chain):
+    gen, _, node = chain
+    plane = LightServingPlane(
+        [_client(gen, node)],
+        max_inflight=1,
+        admit_timeout_s=0.0,
+    )
+    release = threading.Event()
+    entered = threading.Event()
+    orig = plane._verify
+
+    def slow_verify(height):
+        entered.set()
+        release.wait(5.0)
+        return orig(height)
+
+    plane._verify = slow_verify
+    out = {}
+
+    def leader():
+        with plane.open_session() as s:
+            out["leader"] = s.verified_block(9)
+
+    t = threading.Thread(target=leader)
+    t.start()
+    assert entered.wait(5.0)
+    # gate full (the leader holds the one slot): shed, not queue
+    with pytest.raises(ServingOverloadError):
+        plane.serve(10)
+    assert plane.requests_shed == 1
+    release.set()
+    t.join()
+    assert out["leader"].height == 9
+
+
+def test_plane_queue_registry_contract(chain):
+    from cometbft_tpu.obs import QueueRegistry
+
+    gen, _, node = chain
+    plane = LightServingPlane([_client(gen, node)], max_inflight=7)
+    reg = QueueRegistry()
+    plane.register_queues(reg)
+    st = reg.get("light.serve")
+    # the maxsize convention: one bounded gate, depth>=maxsize is
+    # overload (obs/queues.py register docstring)
+    assert st["maxsize"] == 7
+    for k in ("depth", "high_watermark", "enqueued", "dropped"):
+        assert k in st
+
+
+def test_serve_spans_recorded(chain):
+    from cometbft_tpu.trace.tracer import Tracer
+
+    gen, _, node = chain
+    tracer = Tracer(name="t", size=4096)
+    plane = LightServingPlane([_client(gen, node)], tracer=tracer)
+    with plane.open_session() as s:
+        s.verified_block(5)
+        s.verified_block(5)
+    names = {e["name"] for e in tracer.snapshot()}
+    assert "light.serve.request" in names
+    assert "light.cache.miss" in names
+    assert "light.cache.hit" in names
+    assert "light.verify.coalesced" in names
+
+
+# --- divergence detection with the shared cache -------------------------
+
+
+def _forge_lunatic(gen, pvs, node, height):
+    """A valid-fork (lunatic) light block at ``height``: 2 of 4
+    validators (1/2 power — passes 1/3 trusting) sign a forged header
+    claiming a 2-validator set (passes its own 2/3)."""
+    real = node.block_store.load_block(height)
+    vs = gen.validator_set()
+    byz = [pvs[2], pvs[3]]
+    by_addr = {pv.pub_key().address(): pv for pv in byz}
+    fvs = T.ValidatorSet(
+        [
+            vs.get_by_address(pv.pub_key().address())[1]
+            for pv in byz
+        ]
+    )
+    forged_header = dataclasses.replace(
+        real.header,
+        app_hash=b"\x66" * 32,
+        validators_hash=fvs.hash(),
+        next_validators_hash=fvs.hash(),
+    )
+    fbid = T.BlockID(
+        forged_header.hash(), T.PartSetHeader(1, forged_header.hash())
+    )
+    ts = forged_header.time_ns
+    sigs = []
+    for i, val in enumerate(fvs.validators):
+        v = T.Vote(
+            type_=T.PRECOMMIT,
+            height=height,
+            round=0,
+            block_id=fbid,
+            timestamp_ns=ts,
+            validator_address=val.address,
+            validator_index=i,
+        )
+        sigs.append(
+            T.CommitSig(
+                block_id_flag=T.BLOCK_ID_FLAG_COMMIT,
+                validator_address=val.address,
+                timestamp_ns=ts,
+                signature=by_addr[val.address].priv_key.sign(
+                    v.sign_bytes(gen.chain_id)
+                ),
+            )
+        )
+    return LightBlock(
+        header=forged_header,
+        commit=T.Commit(height, 0, fbid, sigs),
+        validator_set=fvs,
+    )
+
+
+class _ForkingPrimary:
+    """Honest store-backed provider, except at the attack height."""
+
+    def __init__(self, gen, node, forged):
+        self.inner = StoreBackedProvider(
+            gen.chain_id, node.block_store, node.state_store
+        )
+        self.chain_id = gen.chain_id
+        self.forged = forged
+        self.reported = []
+
+    def light_block(self, height):
+        if height == self.forged.height:
+            return self.forged
+        return self.inner.light_block(height)
+
+    def report_evidence(self, ev):
+        self.reported.append(ev)
+
+
+def test_divergence_fires_through_cache_and_fork_never_cached(chain):
+    """The satellite's core claim, proven end to end: with bisection
+    anchors riding shared-cache HITS, a lunatic fork that VERIFIES
+    cryptographically still triggers witness divergence — and the
+    forked block never lands in the shared cache (publication is
+    gated on the cross-check)."""
+    gen, pvs, node = chain
+    ATTACK_H = 10
+    forged = _forge_lunatic(gen, pvs, node, ATTACK_H)
+    cache = VerifiedHeaderCache(gen.chain_id)
+
+    # session A (honest) verifies heights below the attack — the
+    # cache now holds anchors the attacked session will HIT
+    honest = _client(gen, node, header_cache=cache)
+    honest.verify_light_block_at_height(6)
+    assert cache.peek(6) is not None
+
+    # session B: forking primary, honest witness, SAME shared cache
+    primary = _ForkingPrimary(gen, node, forged)
+    witness = _provider(gen, node)
+    byz_client = _client(
+        gen,
+        node,
+        provider=primary,
+        witnesses=[witness],
+        header_cache=cache,
+    )
+    with pytest.raises(DivergenceError):
+        byz_client.verify_light_block_at_height(ATTACK_H)
+    # detection fired WHILE the trust anchor rode the cache: the
+    # attacked client's bisection anchor is the SHARED cached object
+    # session A verified (adopted via _best_trusted_before), not a
+    # re-verified copy
+    assert byz_client.store.get(6) is cache.peek(6)
+    # ...and the fork is NOT in the shared cache: nothing at the
+    # attack height, and every cached entry matches the honest chain
+    assert cache.peek(ATTACK_H) is None
+    for h in range(1, CHAIN_LEN + 1):
+        ent = cache.peek(h)
+        if ent is not None:
+            want = node.block_store.load_block_meta(h).block_id.hash
+            assert bytes(ent.hash()) == bytes(want)
+    # the attack was REPORTED (evidence built both ways)
+    assert primary.reported or witness.reported
+
+
+def test_intermediate_hops_cross_checked_before_publication(chain):
+    """Review-hardening regression: EVERY staged block — bisection
+    pivots / sequential hops, not just the target — is witness
+    cross-checked before ANY of them is published. A fork at a hop
+    height (the target itself agreeing with every witness) must halt
+    publication and leave the shared cache empty."""
+    from cometbft_tpu.light.client import SEQUENTIAL
+
+    gen, pvs, node = chain
+    HOP_H = 5
+    forged_at_hop = _forge_lunatic(gen, pvs, node, HOP_H)
+    cache = VerifiedHeaderCache(gen.chain_id)
+    # witness diverges at the HOP height only; primary fully honest —
+    # sequential mode makes every height 2..8 a staged hop
+    witness = _ForkingPrimary(gen, node, forged_at_hop)
+    client = _client(
+        gen,
+        node,
+        witnesses=[witness],
+        header_cache=cache,
+        verification_mode=SEQUENTIAL,
+    )
+    with pytest.raises(DivergenceError):
+        client.verify_light_block_at_height(8)
+    # nothing was published: the hop conflict aborted the whole
+    # publication batch (check-all-then-publish-all)
+    assert len(cache) == 0
+    assert cache.published == 0
+
+
+def test_cached_height_conflict_detected(chain):
+    """The direct conflict branch: a primary serving a header that
+    disagrees with a cross-client verified cache entry at the same
+    height is refused — detection on a cache hit, by hash compare,
+    no crypto needed."""
+    gen, pvs, node = chain
+    H = 8
+    cache = VerifiedHeaderCache(gen.chain_id)
+    honest = _client(gen, node, header_cache=cache)
+    honest.verify_light_block_at_height(H)
+    assert cache.peek(H) is not None
+
+    forged = _forge_lunatic(gen, pvs, node, H)
+    victim = _client(gen, node, header_cache=cache)
+    with pytest.raises(LightClientError, match="conflicts with"):
+        victim.verify_header(forged, time.time_ns())
+    # the honest entry survived untouched
+    assert bytes(cache.peek(H).hash()) == bytes(
+        node.block_store.load_block_meta(H).block_id.hash
+    )
+
+
+# --- statesync sharing --------------------------------------------------
+
+
+def test_statesync_provider_shares_header_cache(chain, monkeypatch):
+    """A joining node's light-verified restore rides verification
+    work concurrent sessions already did (and vice versa): heights a
+    serving client verified come out of the shared cache with ZERO
+    provider fetches by the statesync client."""
+    from cometbft_tpu.statesync import stateprovider as sp_mod
+
+    gen, _, node = chain
+
+    class FakeHTTPProvider(StoreBackedProvider):
+        """Counts fetches; stands in for the HTTP provider so the
+        statesync wiring is testable in-process."""
+
+        def __init__(self, chain_id, url, *a, **k):
+            super().__init__(chain_id, node.block_store, node.state_store)
+            self.fetches = 0
+
+        def light_block(self, height):
+            self.fetches += 1
+            return super().light_block(height)
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(sp_mod, "HTTPProvider", FakeHTTPProvider)
+
+    cache = VerifiedHeaderCache(gen.chain_id)
+    # a serving session verifies the restore heights first
+    serving_client = _client(gen, node, header_cache=cache)
+    for h in (5, 6, 7):
+        serving_client.verify_light_block_at_height(h)
+
+    root = _provider(gen, node).light_block(1)
+    provider = sp_mod.LightClientStateProvider(
+        gen.chain_id,
+        ["fake://primary"],
+        1,
+        bytes(root.hash()),
+        TRUST_PERIOD_NS,
+        header_cache=cache,
+    )
+    fetched_after_init = provider.primary.fetches
+    # the statesync surface: app_hash(5) needs header 6, commit(6),
+    # both already verified by the serving session
+    assert provider.app_hash(5) == bytes(
+        node.block_store.load_block_meta(6).header.app_hash
+    )
+    assert provider.commit(6).height == 6
+    assert provider.primary.fetches == fetched_after_init, (
+        "cached heights must not re-fetch (shared verification work)"
+    )
+    stats = provider.cache_stats()
+    assert stats["hits"] > 0
+    # ...and what statesync verifies is published for the sessions
+    before = cache.published
+    provider.commit(9)
+    assert cache.published > before
+    provider.close()
+
+
+# --- http provider retry ------------------------------------------------
+
+
+def test_http_provider_bounded_retry_with_jitter(chain):
+    import random
+
+    from cometbft_tpu.light.http_provider import HTTPProvider
+    from cometbft_tpu.light.provider import (
+        LightBlockNotFound,
+        ProviderError,
+    )
+    from cometbft_tpu.rpc.client import RPCClientError
+
+    gen, _, node = chain
+    lb3 = _provider(gen, node).light_block(3)
+    prov = HTTPProvider(
+        gen.chain_id,
+        "127.0.0.1:1",
+        timeout_s=1.0,
+        retries=3,
+        rng=random.Random(7),
+    )
+    try:
+        attempts = []
+
+        async def flaky(height):
+            attempts.append(height)
+            if len(attempts) < 3:
+                raise ConnectionError("transient")
+            return lb3
+
+        prov._light_block = flaky
+        t0 = time.monotonic()
+        got = prov.light_block(3)
+        assert got is lb3
+        assert len(attempts) == 3 and prov.retries_used == 2
+
+        # not-found never retries (a missing height is an answer)
+        attempts.clear()
+
+        async def not_found(height):
+            attempts.append(height)
+            raise RPCClientError(-32603, "height 99 not available")
+
+        prov._light_block = not_found
+        with pytest.raises(LightBlockNotFound):
+            prov.light_block(99)
+        assert len(attempts) == 1
+
+        # persistent failure surfaces after the bounded budget
+        attempts.clear()
+
+        async def dead(height):
+            attempts.append(height)
+            raise ConnectionError("down")
+
+        prov._light_block = dead
+        with pytest.raises(ProviderError, match="after 3 attempts"):
+            prov.light_block(3)
+        assert len(attempts) == 3
+
+        # a result-timeout is NOT retried (the coroutine is still
+        # in flight — retrying would stack duplicate RPCs) and the
+        # abandoned coroutine is cancelled
+        attempts.clear()
+        prov._timeout_s = 0.2
+        cancelled = []
+
+        async def slow(height):
+            import asyncio
+
+            attempts.append(height)
+            try:
+                await asyncio.sleep(5.0)
+            except asyncio.CancelledError:
+                cancelled.append(height)
+                raise
+            return lb3
+
+        prov._light_block = slow
+        with pytest.raises(ProviderError, match="timed out"):
+            prov.light_block(3)
+        assert len(attempts) == 1  # no retry pile-up
+        deadline = time.monotonic() + 2.0
+        while not cancelled and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert cancelled == [3]
+        del t0
+    finally:
+        prov.close()
+
+
+def test_http_client_session_reused():
+    """One aiohttp session per provider: repeated calls ride the same
+    ClientSession object (keep-alive), not a connection per call."""
+    import asyncio
+
+    from cometbft_tpu.rpc.client import HTTPClient
+
+    async def main():
+        c = HTTPClient("127.0.0.1:1")
+        s1 = await c._sess()
+        s2 = await c._sess()
+        assert s1 is s2
+        await c.close()
+
+    asyncio.run(main())
+
+
+# --- metrics (both prometheus tiers) ------------------------------------
+
+
+def _emit_light_spans(tracer):
+    t0 = time.monotonic_ns()
+    tracer.complete("light.cache.hit", t0, 0, "light", height=5)
+    tracer.complete("light.cache.hit", t0, 0, "light", height=5)
+    tracer.complete("light.cache.miss", t0, 0, "light", height=6)
+    tracer.complete("light.verify.coalesced", t0, 1000, "light", n=7)
+
+
+def test_light_metrics_real_tier(chain):
+    from cometbft_tpu.trace.tracer import Tracer
+    from cometbft_tpu.utils import metrics as metrics_mod
+
+    if not metrics_mod.HAVE_PROMETHEUS:
+        pytest.skip("prometheus_client wheel not installed")
+    gen, _, node = chain
+    m = metrics_mod.NodeMetrics("serve-metrics")
+    tracer = Tracer(name="m", size=256)
+    plane = LightServingPlane([_client(gen, node)])
+    sess = plane.open_session()
+    m.attach_light_serving(tracer, plane)
+    _emit_light_spans(tracer)
+    body = m.render().decode()
+    assert "cometbft_light_cache_hits_total" in body
+    assert "cometbft_light_cache_misses_total" in body
+    assert "cometbft_light_verify_batch_size" in body
+    assert "cometbft_light_sessions" in body
+
+    def val(name):
+        for line in body.splitlines():
+            if line.startswith(name + "{"):
+                return float(line.rsplit(" ", 1)[1])
+        raise AssertionError(f"{name} not rendered")
+
+    assert val("cometbft_light_cache_hits_total") == 2.0
+    assert val("cometbft_light_cache_misses_total") == 1.0
+    assert val("cometbft_light_sessions") == 1.0
+    assert val("cometbft_light_verify_batch_size_count") == 1.0
+    assert val("cometbft_light_verify_batch_size_sum") == 7.0
+    sess.close()
+    assert m.render().decode()  # render still healthy post-close
+
+
+def test_light_metrics_shim_tier(chain):
+    """With the wheel absent everything degrades to the no-op shim:
+    the plane attaches, spans flow, render serves the placeholder."""
+    import importlib
+    import sys
+
+    from cometbft_tpu.trace.tracer import Tracer
+    from cometbft_tpu.utils import metrics as metrics_mod
+
+    gen, _, node = chain
+    saved = {
+        k: v
+        for k, v in sys.modules.items()
+        if k == "prometheus_client"
+        or k.startswith("prometheus_client.")
+    }
+    for k in saved:
+        sys.modules[k] = None
+    sys.modules["prometheus_client"] = None
+    try:
+        shimmed = importlib.reload(metrics_mod)
+        assert not shimmed.HAVE_PROMETHEUS
+        m = shimmed.NodeMetrics("serve-metrics-shim")
+        tracer = Tracer(name="m", size=256)
+        plane = LightServingPlane([_client(gen, node)])
+        m.attach_light_serving(tracer, plane)
+        _emit_light_spans(tracer)
+        assert b"unavailable" in m.render()
+    finally:
+        for k in list(sys.modules):
+            if k == "prometheus_client" or k.startswith(
+                "prometheus_client."
+            ):
+                del sys.modules[k]
+        sys.modules.update(saved)
+        importlib.reload(metrics_mod)
+
+
+def test_health_reports_shared_header_cache(chain):
+    """rpc wiring: once the node's shared header cache holds verified
+    entries (statesync restore / co-resident plane), the health route
+    surfaces its stats."""
+    from cometbft_tpu.rpc.core import health
+    from cometbft_tpu.rpc.env import Environment
+
+    gen, _, node = chain
+    cache = VerifiedHeaderCache(gen.chain_id)
+    env = Environment(
+        chain_id=gen.chain_id,
+        block_store=node.block_store,
+        light_header_cache_fn=lambda: cache,
+    )
+    assert "light_header_cache" not in health(env)  # empty: omitted
+    _client(gen, node, header_cache=cache).verify_light_block_at_height(5)
+    out = health(env)
+    assert out["light_header_cache"]["entries"] >= 1
+    assert out["light_header_cache"]["published"] >= 1
+
+
+# --- proxy integration --------------------------------------------------
+
+
+def test_proxy_serves_through_plane_and_sheds(chain):
+    import asyncio
+
+    import aiohttp
+
+    from cometbft_tpu.light.proxy import RPC_OVERLOADED, LightProxy
+
+    gen, _, node = chain
+
+    async def main():
+        client = _client(gen, node)
+        proxy = LightProxy(
+            client, "127.0.0.1:1", max_sessions=2, max_inflight=4
+        )
+        await proxy.start("127.0.0.1:0")
+        try:
+            base = f"http://{proxy.listen_addr}"
+            async with aiohttp.ClientSession() as http:
+                async with http.get(f"{base}/header?height=5") as r:
+                    body = await r.json()
+                assert body["result"]["verified"] is True
+                # second request = cache hit, same payload
+                async with http.get(f"{base}/header?height=5") as r:
+                    body2 = await r.json()
+                assert (
+                    body2["result"]["header_b64"]
+                    == body["result"]["header_b64"]
+                )
+                async with http.get(f"{base}/serving_status") as r:
+                    st = (await r.json())["result"]
+                assert st["requests"] >= 2
+                assert st["cache"]["hits"] >= 1
+                # exhaust the session bound -> JSON-RPC overload code
+                held = [
+                    proxy.plane.open_session() for _ in range(2)
+                ]
+                async with http.get(f"{base}/header?height=6") as r:
+                    shed = await r.json()
+                assert shed["error"]["code"] == RPC_OVERLOADED
+                for s in held:
+                    s.close()
+                async with http.get(f"{base}/header?height=6") as r:
+                    ok = await r.json()
+                assert ok["result"]["verified"] is True
+        finally:
+            await proxy.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 120))

@@ -87,6 +87,17 @@ echo "== chaos smoke: 5-scenario factory matrix, budget-gated =="
 JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos matrix --seed "$SEED" \
     --count 5 --budget --out "$TRACE_DIR/matrix"
 
+echo "== chaos smoke: 200-session light serving storm against a live ChaosNet node =="
+# the light-client serving plane (docs/PERF.md): after the fault
+# schedule settles, 200 seeded light sessions storm the most advanced
+# node through the shared verified-header cache + coalesced verify —
+# every served block hash-asserted against the node's store, the
+# light.serve.request spans budget-gated (exit 2 on breach), and the
+# per-height commit waterfalls must stay complete under the storm
+JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" --light-storm 200 \
+    --trace-dump "$TRACE_DIR/light_storm" --budget
+python -m cometbft_tpu.trace timeline "$TRACE_DIR/light_storm" --strict
+
 echo "== chaos smoke: un-pinned partition x statesync_join x churn + reconnect span budget =="
 # the compound the matrix previously pinned out (ISSUE 12): a
 # partitioned net churns its valset, heals, and a fresh node joins by
