@@ -13,6 +13,7 @@ import threading
 from concurrent.futures import Future
 from typing import Callable, List, Optional
 
+from ..analysis.runtime import sanitized_lock
 from . import types as abci
 
 
@@ -21,7 +22,9 @@ class LocalClient:
         self.app = app
         # one shared lock across the 4 "connections" mirrors the local
         # client's global mutex in the reference
-        self._lock = lock or threading.RLock()
+        self._lock = lock or sanitized_lock(
+            threading.RLock(), "abci.app"
+        )
 
     # consensus connection
     def init_chain(self, req):

@@ -160,6 +160,8 @@ class ABCIServer:
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
+        except asyncio.CancelledError:
+            raise  # server stop cancels handlers; never swallow it
         except Exception as e:  # malformed frame: report then drop conn
             try:
                 writer.write(
@@ -168,14 +170,14 @@ class ABCIServer:
                     )
                 )
                 await writer.drain()
-            except Exception:
-                pass
+            except (OSError, RuntimeError):
+                pass  # peer already gone / transport torn down
         finally:
             try:
                 writer.close()
                 await writer.wait_closed()
-            except Exception:
-                pass
+            except (OSError, RuntimeError):
+                pass  # double-close on a dead transport is fine
 
 
 GRPC_METHOD = "/cometbft.abci.ABCI/Call"

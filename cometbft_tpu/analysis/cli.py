@@ -15,7 +15,7 @@ from typing import List, Optional
 
 from . import baseline as baseline_mod
 from .engine import REPO_ROOT, run
-from .registry import all_rules
+from .registry import all_project_rules, all_rules
 
 DEFAULT_BASELINE = REPO_ROOT / "tools" / "bftlint_baseline.json"
 
@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--list-rules", action="store_true",
         help="print the rule table and exit",
     )
+    p.add_argument(
+        "--timings", action="store_true",
+        help="print per-rule wall time (the interprocedural pass's "
+        "cost must stay visible as the tree grows)",
+    )
     return p
 
 
@@ -64,13 +69,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.list_rules:
         for r in all_rules():
             print(f"{r.rule_id}  {r.name}\n    {r.doc}", file=out)
+        for pr in all_project_rules():
+            print(
+                f"{pr.rule_id}* {pr.name} (interprocedural)\n"
+                f"    {pr.doc}",
+                file=out,
+            )
         return 0
 
+    timings = {} if args.timings else None
     try:
-        findings = run(args.paths)
+        findings = run(args.paths, timings=timings)
     except FileNotFoundError as e:
         print(f"bftlint: {e}", file=sys.stderr)
         return 2
+    if timings:
+        total = sum(timings.values())
+        print("bftlint rule timings (wall):", file=out)
+        for name, secs in sorted(
+            timings.items(), key=lambda kv: -kv[1]
+        ):
+            print(f"  {name:<18} {secs * 1e3:9.1f} ms", file=out)
+        print(f"  {'total':<18} {total * 1e3:9.1f} ms", file=out)
 
     if args.update_baseline:
         entries = baseline_mod.build(findings)

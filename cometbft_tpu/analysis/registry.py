@@ -29,7 +29,21 @@ class Rule(NamedTuple):
     check: Callable[[FileContext], Iterable[Finding]]
 
 
+class ProjectRule(NamedTuple):
+    """A whole-program rule: ``check(project) -> Iterable[Finding]``
+    over the callgraph.Project model instead of one FileContext.
+    Project rules may share a rule id with a file rule (the ASY102
+    deep-chain upgrade reports under the same id as the single-file
+    pass); suppressions and the baseline treat them identically."""
+
+    rule_id: str
+    name: str
+    doc: str
+    check: Callable[["object"], Iterable[Finding]]
+
+
 _RULES: Dict[str, Rule] = {}
+_PROJECT_RULES: Dict[str, ProjectRule] = {}
 
 
 def rule(rule_id: str, name: str, doc: str):
@@ -46,20 +60,42 @@ def rule(rule_id: str, name: str, doc: str):
     return deco
 
 
+def project_rule(rule_id: str, name: str, doc: str):
+    """Decorator registering an interprocedural rule."""
+
+    def deco(fn):
+        if rule_id in _PROJECT_RULES:
+            raise ValueError(f"duplicate project rule {rule_id}")
+        _PROJECT_RULES[rule_id] = ProjectRule(
+            rule_id, name, fn.__doc__ or doc, fn
+        )
+        return fn
+
+    return deco
+
+
 def all_rules() -> List[Rule]:
     _load_builtin()
     return [r for _, r in sorted(_RULES.items())]
+
+
+def all_project_rules() -> List[ProjectRule]:
+    _load_builtin()
+    return [r for _, r in sorted(_PROJECT_RULES.items())]
 
 
 def resolve(spec: str) -> str | None:
     """Map an id or name (as written in a suppression) to a rule id."""
     _load_builtin()
     spec = spec.strip()
-    if spec in _RULES:
+    if spec in _RULES or spec in _PROJECT_RULES:
         return spec
     for r in _RULES.values():
         if r.name == spec:
             return r.rule_id
+    for pr in _PROJECT_RULES.values():
+        if pr.name == spec:
+            return pr.rule_id
     return None
 
 

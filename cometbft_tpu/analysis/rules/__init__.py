@@ -1,2 +1,7 @@
 """Built-in bftlint rules; importing this package registers them."""
-from . import async_rules, jax_rules, trace_rules  # noqa: F401
+from . import (  # noqa: F401
+    async_rules,
+    interproc_rules,
+    jax_rules,
+    trace_rules,
+)

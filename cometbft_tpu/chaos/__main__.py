@@ -63,6 +63,14 @@ def main(argv=None) -> int:
         "frame (pair with a schedule carrying a stall event)",
     )
     ap.add_argument(
+        "--expect-lock-inversion",
+        action="store_true",
+        help="sanitizer check: exit 0 iff the runtime concurrency "
+        "sanitizer reported the scheduled lock_inversion's ABBA "
+        "cycle AND the foreign-thread affinity touch (pair with a "
+        "schedule carrying a lock_inversion event)",
+    )
+    ap.add_argument(
         "--trace-dump",
         metavar="DIR",
         help="export every node's trace ring here (JSONL per node + "
@@ -150,6 +158,7 @@ def main(argv=None) -> int:
                     "shutdown_stalls": report.shutdown_stalls,
                     "proposers": report.proposers,
                     "light_storm": report.light_storm,
+                    "sanitizer_findings": report.sanitizer_findings,
                 },
                 f,
                 indent=2,
@@ -164,6 +173,26 @@ def main(argv=None) -> int:
             "CAPTURED (chaos_stall frame in snapshot)"
             if caught
             else "MISSED",
+        )
+        if not caught:
+            return 1
+    if args.expect_lock_inversion:
+        from ..analysis.runtime import injected_finding
+
+        # only the INJECTED findings count as detection (a real,
+        # un-injected cycle elsewhere must not mask a missed
+        # injection — same filter run_schedule applies)
+        kinds = {
+            f.get("kind")
+            for f in report.sanitizer_findings
+            if injected_finding(f)
+        }
+        caught = {"lock-order-cycle", "loop-affinity"} <= kinds
+        print(
+            "sanitizer lock-inversion:",
+            "DETECTED (ABBA cycle + foreign-thread touch reported)"
+            if caught
+            else f"MISSED (got {sorted(kinds)})",
         )
         if not caught:
             return 1

@@ -191,6 +191,13 @@ class Nemesis:
                 "hold_s": ev.hold_s,
                 "gap_s": ev.gap_s,
             }
+        if ev.action == "lock_inversion":
+            # deterministic sanitizer exercise (analysis/runtime.py):
+            # sequential ABBA + a foreign-thread affinity touch — no
+            # timing race, so detection replays from the seed line
+            from ..analysis.runtime import inject_lock_inversion
+
+            return inject_lock_inversion()
         if ev.action == "statesync_join":
             name = await net.statesync_join(via=ev.via)
             return {"joined": name}

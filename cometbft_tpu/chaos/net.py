@@ -113,6 +113,12 @@ class ChaosReport:
     # --light-storm N): session/latency/cache stats, or empty when
     # the leg did not run
     light_storm: Dict[str, object] = field(default_factory=dict)
+    # runtime concurrency sanitizer (analysis/runtime.py): every
+    # finding the per-process sanitizer recorded during the run.
+    # Un-injected findings also land in ``violations`` (the matrix
+    # hunts races for free); findings from a scheduled
+    # lock_inversion are EXPECTED and stay here only.
+    sanitizer_findings: List[dict] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -142,6 +148,10 @@ class ChaosReport:
                 lines.append(f"  {link}: {counts}")
         for v in self.violations:
             lines.append(f"VIOLATION: {v}")
+        for f in self.sanitizer_findings:
+            lines.append(
+                f"sanitizer[{f.get('kind')}]: {f.get('message')}"
+            )
         if self.workload:
             lines.append(f"workload: {self.workload}")
         if self.light_storm:
@@ -916,6 +926,15 @@ async def run_schedule(
     )
     report = ChaosReport(seed=seed, schedule_json=schedule.to_json())
     nemesis = Nemesis(net, schedule)
+    # runtime concurrency sanitizer (analysis/runtime.py): chaos nodes
+    # build with it ON (test_config); isolate this run's findings
+    from ..analysis.runtime import get_sanitizer, injected_finding
+
+    sanitizer = get_sanitizer()
+    sanitizer.reset()
+    inversion_scheduled = any(
+        e.action == "lock_inversion" for e in schedule.events
+    )
     driver = None
     if workload is not None and workload.pattern != "none":
         from .workload import WorkloadDriver
@@ -1059,6 +1078,29 @@ async def run_schedule(
         report.shutdown_stalls = net.shutdown_stall_records()
         report.dial_failures = net.dial_failures
         report.conns_killed = net.conns_killed
+        # sanitizer findings ride the pipeline as invariant-style
+        # violations: an un-injected lock-order cycle or affinity
+        # breach fails the run (trace dump + seed-line replay), and a
+        # scheduled lock_inversion must PROVE detection — a sanitizer
+        # that cannot flag its own injection proves nothing
+        report.sanitizer_findings = sanitizer.snapshot()
+        for f in report.sanitizer_findings:
+            if not injected_finding(f):
+                report.violations.append(
+                    f"sanitizer[{f.get('kind')}]: {f.get('message')}"
+                )
+        if inversion_scheduled:
+            got = {
+                f.get("kind")
+                for f in report.sanitizer_findings
+                if injected_finding(f)
+            }
+            for want in ("lock-order-cycle", "loop-affinity"):
+                if want not in got:
+                    report.violations.append(
+                        "lock_inversion injected but the sanitizer "
+                        f"reported no {want} finding"
+                    )
         if budget_file:
             # evaluated over the in-memory rings so a breach can force
             # the dump below even when no invariant tripped

@@ -78,6 +78,20 @@ deterministic and fast):
                       without waiting out ping_interval+pong_timeout.
                       Persistent-peer reconnect (p2p/reconnect.py)
                       must heal every kill.
+``lock_inversion``    deliberately exercise the runtime concurrency
+                      sanitizer (analysis/runtime.py, docs/LINT.md
+                      "Runtime sanitizer"): acquire two
+                      sanitizer-wrapped locks in A-B then B-A order
+                      (a deterministic ABBA inversion — the
+                      lock-order graph records ORDER, not
+                      contention) and touch a tagged loop-affine
+                      probe from a foreign thread. The run asserts
+                      the sanitizer REPORTS both (a sanitizer that
+                      cannot flag an injected inversion proves
+                      nothing — the same checker-validation
+                      discipline as ``byzantine``); the injected
+                      findings themselves are expected, not
+                      violations.
 ``reconnect_storm``   ``node=i``: ``cycles`` repetitions of
                       {partition the victim off, pong-timeout-kill its
                       conns, hold ``hold_s``, heal, wait ``gap_s``} —
@@ -102,7 +116,7 @@ from typing import Dict, List, Optional
 ACTIONS = (
     "partition", "heal", "set_link", "crash", "restart", "byzantine",
     "stall", "crash_wave", "statesync_join", "valset_churn",
-    "wal_torn_tail", "conn_kill", "reconnect_storm",
+    "wal_torn_tail", "conn_kill", "reconnect_storm", "lock_inversion",
 )
 
 

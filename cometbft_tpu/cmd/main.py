@@ -514,6 +514,8 @@ def cmd_light(args) -> int:
                 while True:
                     try:
                         await asyncio.to_thread(cli.update)
+                    except asyncio.CancelledError:
+                        raise  # ctrl-C path below handles shutdown
                     except Exception as e:
                         # a transient primary hiccup must not tear the
                         # proxy daemon down; log and keep polling

@@ -250,6 +250,17 @@ class InstrumentationConfig:
     # fd release above all) still run. Turns the stop-path wedge
     # class into a diagnosed bounded failure.
     shutdown_stage_budget_s: float = 5.0
+    # runtime concurrency sanitizer (analysis/runtime.py, docs/LINT.md
+    # "Runtime sanitizer"): lock-order graph with deadlock-potential
+    # cycle detection, loop-affinity guard on hot-plane objects, and
+    # stall attribution for the watchdog's flight records. The
+    # enablement is PER-PROCESS and construction-time (hot-plane
+    # locks are wrapped as planes are built), matching the per-
+    # process lock-order graph. Default OFF for production nodes —
+    # disabled mode costs nothing (raw locks come back unchanged);
+    # config.test_config and the chaos net switch it ON, so the whole
+    # tier-1 suite + 50-scenario matrix run sanitized.
+    sanitizer: bool = False
 
 
 @dataclass
@@ -321,6 +332,9 @@ def test_config(root_dir: str = ".") -> Config:
     c.base.db_backend = "memdb"
     c.rpc.laddr = "tcp://127.0.0.1:0"  # ephemeral port per test node
     c.p2p.laddr = "tcp://127.0.0.1:0"
+    # tests run with the runtime concurrency sanitizer ON (the
+    # "race detector in CI" default; docs/LINT.md)
+    c.instrumentation.sanitizer = True
     return c
 
 

@@ -76,6 +76,11 @@ class ConsensusState:
         on_decided: Optional[Callable] = None,
     ):
         self.config = config
+        # loop-affinity guard (analysis/runtime.py): consensus
+        # state is mutated only on its event loop
+        from ..analysis.runtime import get_sanitizer
+
+        self._sanitizer = get_sanitizer()
         self.block_exec = block_exec
         self.block_store = block_store
         self.mempool = mempool
@@ -154,6 +159,8 @@ class ConsensusState:
 
     async def start(self) -> None:
         from ..obs.queues import InstrumentedQueue
+
+        self._sanitizer.tag("consensus.state")
 
         self.queue = InstrumentedQueue(10000, name="consensus.inbox")
         self.event_bus.set_loop(asyncio.get_running_loop())
@@ -342,6 +349,8 @@ class ConsensusState:
                 self._vote_coalescer.flush()
 
     def _handle_msg(self, kind: str, payload, peer_id: str) -> None:
+        if self._sanitizer.enabled:
+            self._sanitizer.touch("consensus.state")
         if self.tracer.enabled:
             self._trace_handle(kind, payload, peer_id)
         if kind == "proposal":
@@ -1223,6 +1232,8 @@ class ConsensusState:
                     await asyncio.to_thread(
                         self.privval.sign_proposal, chain_id, prop
                     )
+                except asyncio.CancelledError:
+                    raise  # consensus stop cancels in-flight signs
                 except Exception:
                     traceback.print_exc()
                     return  # propose timeout moves the round along
@@ -1645,6 +1656,8 @@ class ConsensusState:
             async def sign_off_loop():
                 try:
                     await asyncio.to_thread(do_sign)
+                except asyncio.CancelledError:
+                    raise  # consensus stop cancels in-flight signs
                 except Exception as e:
                     from ..privval import DoubleSignError
 

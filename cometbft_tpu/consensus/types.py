@@ -7,6 +7,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..analysis.runtime import sanitized_lock
 from .. import types as T
 
 
@@ -39,7 +40,9 @@ class HeightVoteSet:
         self.round = 0
         self._prevotes: Dict[int, T.VoteSet] = {}
         self._precommits: Dict[int, T.VoteSet] = {}
-        self._lock = threading.RLock()
+        self._lock = sanitized_lock(
+            threading.RLock(), "consensus.votes"
+        )
         self.set_round(0)
 
     def _ensure(self, round_: int) -> None:

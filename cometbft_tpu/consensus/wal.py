@@ -53,6 +53,7 @@ import zlib
 from dataclasses import dataclass
 from typing import Callable, Iterator, List, Optional
 
+from ..analysis.runtime import sanitized_lock
 from ..trace import NOOP as TRACE_NOOP
 from ..utils import proto
 from ..utils.fail import fail_point
@@ -228,8 +229,9 @@ class WAL:
         self._head_size = self._f.tell()
         # one RLock over every file mutation: the consensus loop
         # appends, the group flusher fsyncs, and a pipelined-finalize
-        # worker may write_end_height concurrently
-        self._lock = threading.RLock()
+        # worker may write_end_height concurrently (sanitized:
+        # the lock-order graph watches it, docs/LINT.md)
+        self._lock = sanitized_lock(threading.RLock(), "wal.append")
         self._pending: List[SyncTicket] = []
         self._flush_wakeup = threading.Condition(self._lock)
         self._flusher: Optional[threading.Thread] = None
@@ -365,9 +367,16 @@ class WAL:
         t0 = time.perf_counter()
         try:
             with self.tracer.span(name, tid="wal", n=len(tickets) or 1):
-                os.fsync(fd)
+                # the WAL seam is the ONE sanctioned blocking sink
+                # (cf. ASY111): strict-inline routing is calibrated
+                # (EWMA, sub-ms fsyncs only), the group path runs on
+                # the off-loop flusher, and rotation's in-lock
+                # barrier is required by the rename-atomicity +
+                # ticket-prefix-durability contract
+                os.fsync(fd)  # bftlint: disable=ASY114
                 if _FSYNC_MODEL_S > 0:
-                    time.sleep(_FSYNC_MODEL_S)  # slow-disk model
+                    # synthetic slow-disk model for bench/chaos legs
+                    time.sleep(_FSYNC_MODEL_S)  # bftlint: disable=ASY114
         except OSError:
             with self._lock:
                 self._pending = tickets + self._pending

@@ -125,6 +125,8 @@ class CoalescingVerifier:
             # off the event loop: the batch may compile/dispatch to the
             # device or grind host crypto — both release the GIL
             _, oks = await asyncio.to_thread(verifier.verify)
+        except asyncio.CancelledError:
+            raise  # engine stop cancels the dispatch task
         except Exception as e:
             # A transient backend/device failure must not discard a
             # whole wave of valid votes (the reactor already announced

@@ -99,6 +99,8 @@ class LoadGenerator:
                         res.accepted += 1
                     else:
                         res.rejected += 1
+                except asyncio.CancelledError:
+                    raise  # gather() cancellation must propagate
                 except Exception:
                     res.rejected += 1
 

@@ -513,8 +513,10 @@ class Runner:
                     self._rpc_post, rn, "broadcast_tx_sync",
                     {"tx": tx}, 2.0,
                 )
-            except Exception:
-                pass
+            except asyncio.CancelledError:
+                raise  # run teardown cancels the load routine
+            except (OSError, ValueError):
+                pass  # node restarting mid-perturbation; keep loading
             await asyncio.sleep(interval)
 
     def _benchmark_intervals(self) -> None:
@@ -664,6 +666,8 @@ class Runner:
                     await asyncio.to_thread(
                         self._rpc, rn, "unsafe_disconnect_peers"
                     )
+                except asyncio.CancelledError:
+                    raise
                 except Exception as e:
                     print(f"[perturb] disconnect failed: {e}", flush=True)
                     continue
@@ -676,6 +680,8 @@ class Runner:
                     await asyncio.to_thread(
                         self._rpc, rn, f"dial_peers?peers=[{peers}]"
                     )
+                except asyncio.CancelledError:
+                    raise
                 except Exception as e:
                     print(f"[perturb] reconnect failed: {e}", flush=True)
             elif pert.kind == "upgrade":
@@ -707,8 +713,10 @@ class Runner:
                         if got == pert.upgrade_version:
                             self._upgraded_ok = True
                             break
-                    except Exception:
-                        continue
+                    except asyncio.CancelledError:
+                        raise
+                    except (OSError, ValueError, KeyError):
+                        continue  # node still rebooting; poll again
                 else:
                     self.failures.append(
                         f"{rn.spec.name} never reported upgraded "
@@ -739,6 +747,8 @@ class Runner:
                             await asyncio.to_thread(inject, rn)
                             self._evidence_injected = True
                             break
+                        except asyncio.CancelledError:
+                            raise
                         except Exception as e:
                             last_err = e
                             print(

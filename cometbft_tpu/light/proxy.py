@@ -406,6 +406,8 @@ class LightProxy:
                     },
                 }
             )
+        except asyncio.CancelledError:
+            raise  # server stop cancels in-flight handlers
         except Exception as e:
             return web.json_response(
                 {

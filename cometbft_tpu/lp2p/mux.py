@@ -77,8 +77,10 @@ class MuxStream:
                 await asyncio.wait_for(
                     self.mux._send_frame(self.stream_id, FIN, b""), 2.0
                 )
-            except Exception:
-                pass
+            except asyncio.CancelledError:
+                raise
+            except (asyncio.TimeoutError, OSError, RuntimeError):
+                pass  # dead conn: the FIN courtesy just didn't land
             self.mux._drop_stream(self.stream_id)
 
     def abort(self) -> None:
@@ -160,12 +162,10 @@ class Muxer:
                 # must not wedge stop — the fd close below kills its
                 # I/O anyway
                 await asyncio.wait_for(t, 2.0)
-            except (
-                asyncio.TimeoutError,
-                asyncio.CancelledError,
-                Exception,
-            ):
-                pass
+            except asyncio.CancelledError:
+                pass  # we cancelled t ourselves two lines up
+            except Exception:
+                pass  # routine died on a torn conn; fd close follows
         self.sconn.close()
 
     # --- stream open --------------------------------------------------

@@ -211,6 +211,8 @@ class Lp2pPeer:
             await asyncio.wait_for(self._ready.wait(), 10.0)
             await self._out[chan_id].send(msg)
             return True
+        except asyncio.CancelledError:
+            raise  # peer stop cancels senders; never report "sent"
         except Exception:
             return False
 

@@ -31,6 +31,7 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..analysis.runtime import get_sanitizer, sanitized_lock
 from ..abci import types as abci
 from ..trace import NOOP as TRACE_NOOP
 
@@ -82,7 +83,9 @@ class TxCache:
     def __init__(self, size: int = 10000):
         self.size = size
         self._od: "OrderedDict[bytes, None]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = sanitized_lock(
+            threading.Lock(), "mempool.txcache"
+        )
 
     def push(self, key: bytes) -> bool:
         """False if already present."""
@@ -191,7 +194,9 @@ class CListMempool(Mempool):
         self.max_txs = max_txs
         self.recheck = recheck
         self.async_recheck = async_recheck
-        self._lock = threading.RLock()
+        self._lock = sanitized_lock(
+            threading.RLock(), "mempool.pool"
+        )
         self._txs_available = threading.Event()
         self._notify = notify
         # async-recheck state, all guarded by self._lock: keys of the
@@ -452,6 +457,11 @@ class CListMempool(Mempool):
         releasing consensus (reference clist_mempool.go:583). With
         async_recheck the recheck leaves the critical section: wall
         time here no longer scales with the pooled tx count."""
+        # loop-affinity: commit-path entry; first caller adopts
+        # ownership (analysis/runtime.py, docs/LINT.md)
+        san = get_sanitizer()
+        if san.enabled:
+            san.touch_adopt("mempool.pool")
         self.height = height
         committed_keys = tx_keys(txs) if txs else []
         for key, res in zip(committed_keys, results):

@@ -109,6 +109,22 @@ def build_node(
     wal: bool = False,
 ) -> NodeParts:
     config = config or test_config(home or ".")
+    if config.instrumentation.sanitizer:
+        # runtime concurrency sanitizer (docs/LINT.md "Runtime
+        # sanitizer"): MUST enable before any plane below constructs
+        # its locks — wrapping is a construction-time decision, which
+        # is what makes disabled mode free. Per-process, like the
+        # lock-order graph it feeds.
+        from ..analysis import runtime as _sanitizer
+
+        _sanitizer.enable()
+    # the native wirecodec's one-time g++ build runs on a daemon
+    # thread NOW so no event loop ever pays it (ASY114 found the
+    # subprocess.run reachable from reactor hot paths; module() falls
+    # back to the portable codec while the build is in flight)
+    from ..utils import wirecodec as _wirecodec
+
+    _wirecodec.prewarm()
     # tracing plane: one ring per node; cross-node planes (the crypto
     # worker pool) land on the process-wide tracer, enabled the first
     # time any tracing node is built

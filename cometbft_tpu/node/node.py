@@ -370,6 +370,8 @@ class Node:
                 self._cs_started = True
                 self.consensus_reactor.switch_to_consensus()
             await self.blocksync_reactor.activate(state)
+        except asyncio.CancelledError:
+            raise  # node stop cancels the statesync task
         except Exception as e:
             # statesync failure is fatal (reference node/setup.go
             # performStateSync): a node that can't bootstrap must not
@@ -394,6 +396,8 @@ class Node:
             await self.parts.cs.start()
             self._cs_started = True
             self.consensus_reactor.switch_to_consensus()
+        except asyncio.CancelledError:
+            raise  # node stop cancels the handoff task
         except Exception:
             traceback.print_exc()
 

@@ -55,15 +55,18 @@ _ARG_TRUNC = 1800  # chars of stack embedded in a trace instant
 
 
 def _format_frame_stack(frame, depth: int = _STACK_DEPTH) -> List[str]:
-    """Innermost-first "file.py:lineno func" lines for one frame."""
+    """Innermost-first "pkg/file.py:lineno func" lines for one frame.
+
+    The parent directory is kept so stall attribution
+    (analysis/runtime.attribute_frames) can bucket the frame by
+    owning subsystem — "wal.py" alone cannot name its plane."""
     out: List[str] = []
     f = frame
     while f is not None and len(out) < depth:
         code = f.f_code
-        out.append(
-            f"{code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno} "
-            f"{code.co_name}"
-        )
+        fname = code.co_filename.replace("\\", "/")
+        short = "/".join(fname.rsplit("/", 2)[-2:])
+        out.append(f"{short}:{f.f_lineno} {code.co_name}")
         f = f.f_back
     return out
 
@@ -212,6 +215,14 @@ class LoopWatchdog:
             "threads": threads,
             "tasks": [t["name"] for t in tasks],
         }
+        try:
+            # stall attribution (docs/LINT.md "Runtime sanitizer"):
+            # name the guilty subsystem, not just the raw stack
+            from ..analysis.runtime import attribute_stall
+
+            record["subsystem"] = attribute_stall(record)
+        except Exception:
+            record["subsystem"] = "unknown"
         self.stalls.append(record)
         self.stall_count += 1
         self._last_stall_t = _monotonic()
@@ -222,6 +233,7 @@ class LoopWatchdog:
                 "obs.stall",
                 tid="watchdog",
                 stalled_ms=round(stalled_s * 1e3, 1),
+                subsystem=record["subsystem"],
                 loop_stack=" <- ".join(loop_stack)[:_ARG_TRUNC],
             )
             tr.instant(
@@ -238,6 +250,7 @@ class LoopWatchdog:
             "event loop stalled (flight record captured)",
             node=self.name,
             stalled_s=round(stalled_s, 2),
+            subsystem=record["subsystem"],
             loop_stack=" <- ".join(loop_stack[:6]),
         )
 

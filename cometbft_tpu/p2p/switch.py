@@ -27,6 +27,7 @@ import time
 import traceback
 from typing import Dict, List, Optional
 
+from ..analysis.runtime import get_sanitizer
 from ..trace import NOOP as TRACE_NOOP
 from ..utils.log import get_logger
 from ..utils.tasks import spawn
@@ -70,6 +71,9 @@ class Switch:
         self.channel_descs: List[ChannelDescriptor] = []
         self._chan_caps: Dict[int, int] = {}
         self.peers: Dict[str, Peer] = {}
+        # loop-affinity guard (analysis/runtime.py): the peer map
+        # is mutated only on the switch's event loop
+        self._sanitizer = get_sanitizer()
         self.persistent_addrs: Dict[str, str] = {}  # id -> addr
         self.banned: set = set()
         self.max_peers = max_peers
@@ -117,6 +121,7 @@ class Switch:
     # --- lifecycle ----------------------------------------------------
 
     async def start(self) -> None:
+        self._sanitizer.tag("p2p.switch.peers")
         if self._use_autopool:
             from ..utils.autopool import AutoPool
 
@@ -350,6 +355,8 @@ class Switch:
         """Synchronous removal of a duplicate-resolution loser: the
         conn must be DEAD before the replacement registers (never
         awaits — same floor as abort())."""
+        if self._sanitizer.enabled:
+            self._sanitizer.touch("p2p.switch.peers")
         if self.peers.get(peer.peer_id) is peer:
             del self.peers[peer.peer_id]
             self.tracer.counter("p2p.peers", len(self.peers), tid="p2p")
@@ -380,6 +387,8 @@ class Switch:
         """Shared tail of peer construction: register, start, announce
         to reactors, feed the self-healing plane."""
         peer.established_at = time.monotonic()
+        if self._sanitizer.enabled:
+            self._sanitizer.touch("p2p.switch.peers")
         self.peers[peer.peer_id] = peer
         self.tracer.counter("p2p.peers", len(self.peers), tid="p2p")
         _log.info(
@@ -482,6 +491,8 @@ class Switch:
     async def _remove_peer(self, peer, exc, reconnect=False) -> None:
         if self.peers.get(peer.peer_id) is not peer:
             return
+        if self._sanitizer.enabled:
+            self._sanitizer.touch("p2p.switch.peers")
         del self.peers[peer.peer_id]
         self.tracer.counter("p2p.peers", len(self.peers), tid="p2p")
         _log.info(

@@ -23,6 +23,7 @@ import threading
 import traceback
 from typing import Optional
 
+from ..analysis.runtime import sanitized_lock
 from ..crypto.keys import Ed25519PrivKey, Ed25519PubKey
 from ..p2p.conn.secret_connection import SecretConnection
 from ..types.vote import Proposal, Vote
@@ -95,7 +96,7 @@ class SignerClient:
         self._thread.start()
         self._sconn: Optional[SecretConnection] = None
         self._connected = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = sanitized_lock(threading.Lock(), "privval.sign")
         self.listen_addr = ""
         fut = asyncio.run_coroutine_threadsafe(
             self._listen(laddr), self._loop
@@ -111,7 +112,11 @@ class SignerClient:
                 sconn = await SecretConnection.handshake(
                     reader, writer, self._auth_priv
                 )
-            except Exception:
+            except asyncio.CancelledError:
+                writer.close()
+                raise
+            except (OSError, ValueError, asyncio.IncompleteReadError):
+                # failed auth / torn conn: drop it, keep listening
                 writer.close()
                 return
             self._sconn = sconn
@@ -370,6 +375,8 @@ class SignerServer:
                 return
             try:
                 await self._handle(sconn, mtype, body)
+            except asyncio.CancelledError:
+                raise  # server stop cancels the serve loop
             except Exception as e:
                 traceback.print_exc()
                 await _send(

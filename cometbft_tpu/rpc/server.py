@@ -132,7 +132,9 @@ class RPCServer:
     async def _handle_post(self, request: web.Request) -> web.Response:
         try:
             body = await request.json()
-        except Exception:
+        except asyncio.CancelledError:
+            raise
+        except (ValueError, UnicodeDecodeError):
             return web.json_response(
                 _rpc_response(None, error=_rpc_error(-32700, "parse error"))
             )
@@ -153,6 +155,8 @@ class RPCServer:
                 out.append(
                     _rpc_response(id_, error=_rpc_error(-32602, str(e)))
                 )
+            except asyncio.CancelledError:
+                raise  # server stop cancels in-flight handlers
             except Exception as e:
                 traceback.print_exc()
                 out.append(
@@ -181,6 +185,8 @@ class RPCServer:
             return web.json_response(
                 _rpc_response(-1, error=_rpc_error(-32602, str(e)))
             )
+        except asyncio.CancelledError:
+            raise  # server stop cancels in-flight handlers
         except Exception as e:
             traceback.print_exc()
             return web.json_response(
@@ -283,6 +289,8 @@ class RPCServer:
                                 id_, error=_rpc_error(e.code, str(e))
                             )
                         )
+                    except asyncio.CancelledError:
+                        raise  # server stop cancels the ws handler
                     except Exception as e:
                         traceback.print_exc()
                         await ws.send_json(

@@ -18,6 +18,7 @@ import threading
 from dataclasses import dataclass
 from typing import List, Optional
 
+from ..analysis.runtime import sanitized_lock
 from ..types.block import Block, BlockID, Commit, Header
 from ..types.part_set import Part, PartSet
 from ..utils import codec, kv, proto
@@ -62,7 +63,7 @@ class BlockMeta:
 class BlockStore:
     def __init__(self, db: kv.KV):
         self.db = db
-        self._lock = threading.RLock()
+        self._lock = sanitized_lock(threading.RLock(), "store.block")
         self._base = int.from_bytes(db.get(b"base") or b"\0" * 8, "big")
         self._height = int.from_bytes(db.get(b"height") or b"\0" * 8, "big")
 

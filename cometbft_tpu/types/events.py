@@ -12,6 +12,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from ..analysis.runtime import sanitized_lock
 from ..obs.queues import InstrumentedQueue
 
 # per-subscriber queue bound: a subscriber that stops draining sheds
@@ -79,7 +80,7 @@ class EventBus:
     def __init__(self):
         self._subs: List[Subscription] = []
         self._sync_listeners: List[Callable[[Event], None]] = []
-        self._lock = threading.Lock()
+        self._lock = sanitized_lock(threading.Lock(), "events.bus")
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self.dropped = 0  # events shed across all subscribers
 

@@ -40,13 +40,38 @@ _tried = False
 _lock = threading.Lock()
 
 
+def prewarm():
+    """Kick the one-time native build on a daemon thread so no event
+    loop ever pays the compile (node/inprocess.build_node calls this;
+    ASY114 found the g++ run reachable from reactor hot paths).
+    Free once the build has happened."""
+    if _tried:
+        return None
+    t = threading.Thread(
+        target=module, name="wirecodec-prewarm", daemon=True
+    )
+    t.start()
+    return t
+
+
 def module():
-    """The extension module, or None (no compiler / disabled)."""
+    """The extension module, or None (no compiler / disabled).
+
+    Loop-safe by construction: while another thread is mid-build the
+    lock acquire is NON-blocking and we return None for now — every
+    caller already handles the no-native fallback, and the next call
+    after the build finishes gets the module. Only the thread that
+    wins the lock ever runs the compiler."""
     global _mod, _tried
     if _tried:
         return _mod
-    with _lock:
-        if _tried:  # pragma: no cover - race
+    if not _lock.acquire(blocking=False):
+        # a build is in flight elsewhere (usually the prewarm
+        # thread): fall back rather than park this thread on a
+        # multi-second g++ run
+        return None
+    try:
+        if _tried:
             return _mod
         _tried = True
         if os.environ.get("GRAFT_NATIVE_CODEC") == "0":
@@ -57,7 +82,10 @@ def module():
                 or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
             ):
                 os.makedirs(os.path.dirname(_SO), exist_ok=True)
-                subprocess.run(
+                # one-time lazy native build; loop callers never park
+                # here (non-blocking acquire above + build_node
+                # prewarm thread) — sanctioned blocking sink
+                subprocess.run(  # bftlint: disable=ASY114
                     [
                         "g++",
                         "-O2",
@@ -85,3 +113,5 @@ def module():
         except Exception:  # pragma: no cover - toolchain-dependent
             _mod = None
         return _mod
+    finally:
+        _lock.release()

@@ -18,9 +18,14 @@ SEED="${CHAOS_SEED:-1337}"
 TRACE_DIR="$(mktemp -d -t chaos_smoke_trace.XXXXXX)"
 trap 'rm -rf "$TRACE_DIR"' EXIT
 
-echo "== chaos smoke: invariants + span budgets must hold (seed=$SEED) =="
+echo "== chaos smoke: invariants + span budgets + sanitizer must hold (seed=$SEED) =="
 # --budget evaluates tools/span_budgets.toml over the run's rings and
-# prints the verdict table in the report (docs/OBS.md); a breach exits 2
+# prints the verdict table in the report (docs/OBS.md); a breach exits 2.
+# This leg is ALSO the sanitizer-enabled zero-findings assert: every
+# chaos node runs the runtime concurrency sanitizer (docs/LINT.md
+# "Runtime sanitizer"), and any lock-order cycle or foreign-thread
+# touch of a loop-affine object during the run is an invariant-style
+# violation (exit 1)
 JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" \
     --trace-dump "$TRACE_DIR" --budget
 
@@ -50,6 +55,22 @@ EOF
 JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" \
     --schedule "$TRACE_DIR/stall_schedule.json" --expect-stall \
     --trace-dump "$TRACE_DIR/stall"
+
+echo "== chaos smoke: seeded lock inversion must be DETECTED =="
+# checker validation (same discipline as the byzantine leg): a
+# deliberate ABBA ordering + a foreign-thread affinity touch are
+# injected at height 2; the sanitizer must report BOTH,
+# deterministically from this seed line (exit 1 on a miss)
+cat > "$TRACE_DIR/lockinv_schedule.json" <<'EOF'
+[
+  {"action": "lock_inversion", "at_height": 2},
+  {"action": "crash", "at_height": 3, "node": 1},
+  {"action": "restart", "after_s": 0.5, "node": 1}
+]
+EOF
+JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" \
+    --schedule "$TRACE_DIR/lockinv_schedule.json" --expect-lock-inversion \
+    --trace-dump "$TRACE_DIR/lockinv"
 
 echo "== chaos smoke: byzantine corruption must be DETECTED =="
 # --trace-dump keeps the EXPECTED violation's auto-dump inside the

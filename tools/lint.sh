@@ -14,4 +14,6 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 python -m compileall -q cometbft_tpu tests
-python -m cometbft_tpu.analysis cometbft_tpu
+# --fail-on-stale: a shrinking baseline must be ratcheted, never rot;
+# --timings: the interprocedural pass's cost stays visible per rule
+python -m cometbft_tpu.analysis cometbft_tpu --fail-on-stale --timings
