@@ -6,8 +6,10 @@
 4. replay      — 10k-block x 150-validator blocksync replay wall-clock
 5. bisect      — light-client bisection over a 50k-height skip
 6. mixed       — mixed-curve (ed25519 + secp256k1) split batch
-(+ host legs: ingest, live, pipeline, and serve — the 1k-session
-light-client serving storm, baseline vs shared-cache vs coalesced)
+(+ host legs: ingest, live, pipeline, serve — the 1k-session
+light-client serving storm, baseline vs shared-cache vs coalesced —
+and rpcfanout — the 10k-subscriber outbound event fan-out storm,
+one-encode-per-group vs per-subscriber serialization)
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} with
 every config's numbers under "detail.configs". Baselines are the host
@@ -67,6 +69,7 @@ _DEFAULT_BUDGETS_S = {
     "pipeline": 900.0,
     "live": 1500.0,
     "serve": 1200.0,
+    "rpcfanout": 1200.0,
 }
 
 
@@ -1652,6 +1655,391 @@ def bench_serve() -> dict:
     }
 
 
+def bench_rpcfanout() -> dict:
+    """Outbound event fan-out storm (ISSUE 15, docs/PERF.md "Outbound
+    fan-out plane"): 10k websocket subscribers over a handful of
+    query shapes receive a sustained committed block/tx event stream,
+    ablated two ways over the SAME seeded events and the SAME sink
+    sockets:
+
+    - baseline — the pre-plane rpc/server.py shape: one pump per
+      subscriber, attrs flattened AND the full payload JSON-encoded
+      per subscriber per event;
+    - fanout   — the FanoutHub: attrs once per event, ONE encode per
+      (event, query shape), per-subscriber frames spliced from the
+      shared payload.
+
+    Pass-interleaved medians; parity of delivered event streams
+    asserted across modes (sampled subscribers, parsed-JSON
+    equality); ZERO sheds required (the sinks drain instantly, so
+    any drop is a plane bug); end-to-end delivery p99 and the
+    fanout.deliver span gated against tools/span_budgets.toml.
+    Gate: >=5x delivered-frames/s vs the baseline."""
+    import asyncio
+    import hashlib
+    import statistics
+    import time as _time
+
+    import cometbft_tpu.types as T
+    from cometbft_tpu.abci import types as abci
+    from cometbft_tpu.obs.budget import (
+        default_budget_file,
+        evaluate_budgets,
+        load_budgets,
+    )
+    from cometbft_tpu.rpc.fanout import (
+        FanoutHub,
+        _event_attrs,
+        _event_json,
+    )
+    from cometbft_tpu.trace import summarize
+    from cometbft_tpu.trace.tracer import Tracer
+    from cometbft_tpu.types import events as ev
+    from cometbft_tpu.utils.pubsub_query import parse as parse_query
+
+    SUBS = int(os.environ.get("BENCH_FANOUT_SUBS", "10000"))
+    HEIGHTS = int(os.environ.get("BENCH_FANOUT_HEIGHTS", "16"))
+    TXS = int(os.environ.get("BENCH_FANOUT_TXS", "2"))
+    REPEATS = int(os.environ.get("BENCH_FANOUT_REPEATS", "3"))
+    chain_id = "bench-fanout"
+
+    # --- seeded sustained-ingest event stream (the PR 5/PR 10
+    # workload driver's tx shape: deterministic k=v payloads) --------
+    from cometbft_tpu.chaos.workload import WorkloadSpec
+
+    wl = WorkloadSpec(pattern="sustained", tx_bytes=64)
+    tx_rng = np.random.default_rng(4242)
+    vs, _ = T.random_validator_set(1)
+    t0_ns = time.time_ns() - (HEIGHTS + 60) * 1_000_000_000
+
+    def make_height(h, prev_bid):
+        txs = [
+            b"bench/f%d_%d=%s"
+            % (h, i, tx_rng.bytes(wl.tx_bytes // 2).hex().encode())
+            for i in range(TXS)
+        ]
+        data = T.Data(txs=txs)
+        last_commit = (
+            T.Commit(h - 1, 0, prev_bid, []) if h > 1 else None
+        )
+        header = T.Header(
+            chain_id=chain_id,
+            height=h,
+            time_ns=t0_ns + h * 1_000_000_000,
+            last_block_id=prev_bid,
+            validators_hash=vs.hash(),
+            next_validators_hash=vs.hash(),
+            app_hash=b"\x01" * 32,
+            proposer_address=vs.validators[0].address,
+            data_hash=data.hash(),
+            last_commit_hash=last_commit.hash() if last_commit else b"",
+        )
+        return T.Block(header=header, data=data, last_commit=last_commit)
+
+    def tx_result(i):
+        return abci.ExecTxResult(
+            code=0,
+            events=[
+                abci.Event(
+                    "transfer",
+                    [abci.EventAttribute("lane", f"l{i % 4}", True)],
+                )
+            ],
+        )
+
+    events = []
+    prev = T.BlockID()
+    for h in range(1, HEIGHTS + 1):
+        blk = make_height(h, prev)
+        prev = T.BlockID(blk.hash(), T.PartSetHeader(1, blk.hash()))
+        events.append(
+            ev.Event(
+                ev.EVENT_NEW_BLOCK,
+                {"block": blk, "block_id": None, "result_events": []},
+                {"height": str(h)},
+            )
+        )
+        for i, tx in enumerate(blk.data.txs):
+            events.append(
+                ev.Event(
+                    ev.EVENT_TX,
+                    {
+                        "height": h,
+                        "index": i,
+                        "tx": tx,
+                        "result": tx_result(i),
+                    },
+                    {"hash": hashlib.sha256(tx).hexdigest()},
+                )
+            )
+
+    # query shapes: most subscribers follow new blocks (the real-world
+    # exchange/wallet mix), the rest follow tx streams
+    SHAPES = [
+        ("tm.event='NewBlock'", 70),
+        ("tm.event='Tx'", 20),
+        ("tm.event='Tx' AND transfer.lane='l1'", 7),
+        ("tm.event='NewBlockHeader'", 3),  # matches nothing published
+    ]
+    weights = [w for _, w in SHAPES]
+    srng = np.random.default_rng(99)
+    draws = srng.choice(len(SHAPES), size=SUBS, p=[w / 100 for w in weights])
+    shape_of = [int(x) for x in draws]  # subscriber -> shape (seeded)
+    queries = [(qs, parse_query(qs)) for qs, _ in SHAPES]
+
+    def expected_frames(shape_idx) -> int:
+        qs, q = queries[shape_idx]
+        return sum(1 for e in events if q.matches(_event_attrs(e)))
+
+    per_shape_frames = [expected_frames(i) for i in range(len(SHAPES))]
+    total_expected = sum(
+        per_shape_frames[s] for s in shape_of
+    )
+
+    class SinkWS:
+        __slots__ = ("frames", "stamps")
+
+        def __init__(self):
+            self.frames = []
+            self.stamps = []
+
+        async def send_str(self, s):
+            self.frames.append(s)
+            self.stamps.append(_time.monotonic())
+
+    SAMPLE = [  # parity sample: first subscriber of each shape
+        shape_of.index(i) for i in range(len(SHAPES)) if i in shape_of
+    ]
+
+    def baseline_pass() -> tuple:
+        """The pre-ISSUE-15 rpc/server.py architecture, faithfully:
+        one bus Subscription + one pump task PER SUBSCRIBER, each
+        pump flattening attrs, matching its query and json-encoding
+        the whole response itself (what pump + ws.send_json paid) —
+        N subscribers, N serializations per event."""
+        sinks = [SinkWS() for _ in range(SUBS)]
+        encode_box = [0]
+
+        async def run() -> float:
+            bus = ev.EventBus()
+            bus.set_loop(asyncio.get_running_loop())
+            tasks = []
+
+            async def pump(sub, sink, sid):
+                qs, q = queries[shape_of[sid]]
+                try:
+                    while True:
+                        e = await sub.queue.get()
+                        attrs = _event_attrs(e)
+                        if not q.matches(attrs):
+                            continue
+                        frame = json.dumps(
+                            {
+                                "jsonrpc": "2.0",
+                                "id": sid,
+                                "result": {
+                                    "query": qs,
+                                    "data": _event_json(e),
+                                    "events": attrs,
+                                },
+                            }
+                        )
+                        encode_box[0] += 1
+                        await sink.send_str(frame)
+                except asyncio.CancelledError:
+                    pass
+
+            for sid in range(SUBS):
+                sub = bus.subscribe()
+                tasks.append(
+                    asyncio.ensure_future(
+                        pump(sub, sinks[sid], sid)
+                    )
+                )
+            t0 = _time.monotonic()
+            for e in events:
+                bus.publish(e)
+                await asyncio.sleep(0)
+            deadline = asyncio.get_running_loop().time() + 600
+            while (
+                sum(len(s.frames) for s in sinks) < total_expected
+            ):
+                if asyncio.get_running_loop().time() > deadline:
+                    raise RuntimeError("baseline delivery stalled")
+                await asyncio.sleep(0.005)
+            wall = _time.monotonic() - t0
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            return wall
+
+        wall = asyncio.run(run())
+        return sinks, encode_box[0], wall
+
+    tracer = Tracer(name="rpcfanout", size=1 << 16)
+
+    def fanout_pass() -> tuple:
+        sinks = [SinkWS() for _ in range(SUBS)]
+        pub_stamps = {}
+
+        async def run() -> tuple:
+            bus = ev.EventBus()
+            bus.set_loop(asyncio.get_running_loop())
+            hub = FanoutHub(bus, tracer=tracer)
+            for sid in range(SUBS):
+                qs, q = queries[shape_of[sid]]
+                hub.attach(sinks[sid], qs, q, sid)
+            t0 = _time.monotonic()
+            for i, e in enumerate(events):
+                pub_stamps[i] = _time.monotonic()
+                bus.publish(e)
+                # sustained ingest: yield so delivery interleaves
+                # with publishing (the live loop's shape) instead of
+                # batching every event behind the last publish
+                await asyncio.sleep(0)
+            deadline = asyncio.get_running_loop().time() + 120
+            while (
+                sum(len(s.frames) for s in sinks) < total_expected
+            ):
+                if asyncio.get_running_loop().time() > deadline:
+                    raise RuntimeError(
+                        "fanout delivery stalled: "
+                        f"{sum(len(s.frames) for s in sinks)}"
+                        f"/{total_expected}"
+                    )
+                await asyncio.sleep(0.002)
+            wall = _time.monotonic() - t0
+            stats = hub.queue_stats()
+            encodes = hub.encodes
+            await hub.close()
+            return wall, stats, encodes
+
+        wall, stats, encodes = asyncio.run(run())
+        return sinks, encodes, wall, stats, pub_stamps
+
+    runs = {"baseline": [], "fanout": []}
+    parity_checked = False
+    shed_total = 0
+    delivery_lat_ms: list = []
+    for _ in range(REPEATS):
+        b_sinks, b_encodes, b_wall = baseline_pass()
+        f_sinks, f_encodes, f_wall, f_stats, pub_stamps = fanout_pass()
+        shed_total += f_stats["dropped"]
+        runs["baseline"].append(
+            {
+                "wall_s": b_wall,
+                "frames_per_s": total_expected / b_wall,
+                "encodes": b_encodes,
+            }
+        )
+        runs["fanout"].append(
+            {
+                "wall_s": f_wall,
+                "frames_per_s": total_expected / f_wall,
+                "encodes": f_encodes,
+            }
+        )
+        # end-to-end delivery latency per frame: sink stamp minus the
+        # LAST publish at or before it (frames deliver in publish
+        # order, so that publish is the frame's own event or a later
+        # one — an upper bound on staleness, never an undercount)
+        all_stamps = sorted(
+            ts for s in f_sinks for ts in s.stamps
+        )
+        pub_sorted = sorted(pub_stamps.values())
+        import bisect as _bisect
+
+        for ts in all_stamps:
+            i = _bisect.bisect_right(pub_sorted, ts) - 1
+            if i >= 0:
+                delivery_lat_ms.append((ts - pub_sorted[i]) * 1e3)
+        if not parity_checked:
+            # parity: parsed frame streams identical per sampled
+            # subscriber across modes
+            for sid in SAMPLE:
+                bl = [json.loads(x) for x in b_sinks[sid].frames]
+                fl = [json.loads(x) for x in f_sinks[sid].frames]
+                assert bl == fl, (
+                    f"fan-out delivery diverged for subscriber {sid} "
+                    f"({len(bl)} vs {len(fl)} frames)"
+                )
+            parity_checked = True
+
+    assert shed_total == 0, (
+        f"{shed_total} frames shed with instant-drain sinks — the "
+        "fan-out plane dropped deliverable work"
+    )
+    med = {
+        mode: {
+            k: round(statistics.median(r[k] for r in rs), 3)
+            for k in ("wall_s", "frames_per_s", "encodes")
+        }
+        for mode, rs in runs.items()
+    }
+    ratio = _ratio(
+        med["fanout"]["frames_per_s"], med["baseline"]["frames_per_s"]
+    )
+    assert ratio is not None and ratio >= 5.0, (
+        f"fan-out delivery only {ratio}x the per-subscriber-"
+        "serialization baseline (gate: >=5x)"
+    )
+    delivery_lat_ms.sort()
+
+    def pct(p):
+        return round(
+            delivery_lat_ms[int(p * (len(delivery_lat_ms) - 1))], 3
+        )
+
+    # span-budget gate (tools/span_budgets.toml fanout.deliver)
+    tsum = summarize({"rpcfanout": tracer.snapshot()})
+    verdicts = [
+        v
+        for v in evaluate_budgets(
+            tsum, load_budgets(default_budget_file())
+        )
+        if v["span"] == "fanout.deliver"
+    ]
+    budget_ok = all(v["ok"] for v in verdicts)
+    assert budget_ok, f"fanout.deliver budget breached: {verdicts}"
+
+    events_per_height = 1 + TXS
+    return {
+        "rate": med["fanout"]["frames_per_s"],
+        "subscribers": SUBS,
+        "heights": HEIGHTS,
+        "events": len(events),
+        "expected_frames": total_expected,
+        "repeats": REPEATS,
+        "shapes": [qs for qs, _ in SHAPES],
+        "baseline": med["baseline"],
+        "fanout": med["fanout"],
+        "throughput_ratio": ratio,
+        "encode_ratio": _ratio(
+            med["baseline"]["encodes"], med["fanout"]["encodes"]
+        ),
+        "delivery_p50_ms": pct(0.50),
+        "delivery_p99_ms": pct(0.99),
+        "blocks_per_s_delivered": round(
+            HEIGHTS
+            * events_per_height
+            / max(med["fanout"]["wall_s"], 1e-9)
+            / events_per_height,
+            2,
+        ),
+        "sheds": shed_total,
+        "parity_ok": True,
+        "budget": {"ok": budget_ok, "verdicts": verdicts},
+        "note": (
+            "baseline = per-subscriber attrs+JSON encode per event "
+            "(the pre-ISSUE-15 pump shape) into the same sink "
+            "sockets; fanout = FanoutHub one-encode-per-(event,"
+            "query-shape). Pass-interleaved medians; parity = parsed "
+            "frame streams identical per sampled subscriber; "
+            "delivery latency = publish->sink per frame."
+        ),
+    }
+
+
 def bench_commit150(gen, parts) -> dict:
     import cometbft_tpu.types as T
 
@@ -2134,6 +2522,7 @@ def main() -> None:
             "ingest",
             "live",
             "serve",
+            "rpcfanout",
         }
         if which == "all"
         else set(which.split(","))
@@ -2268,6 +2657,11 @@ def main() -> None:
         # baseline vs shared-cache vs coalesced ablation + a live
         # LocalNet sub-leg, p99 budget-gated
         run_config("serve", bench_serve)
+    if "rpcfanout" in todo:
+        # host-only outbound fan-out storm (ISSUE 15): 10k websocket
+        # subscribers, one-encode-per-group vs per-subscriber
+        # serialization, >=5x gate + delivery p99 budget-gated
+        run_config("rpcfanout", bench_rpcfanout)
     budget_skip = {
         "skipped": f"host budget ({host_budget_s:.0f}s) "
         "exhausted before this config"

@@ -166,9 +166,17 @@ class Project:
         self,
         files: List[Tuple[str, ast.Module]],
         sanctioned=None,
+        suppressed=None,
     ):
         self.files = files
         self._sanctioned = sanctioned or (lambda path, line: False)
+        # ``suppressed(path, line, rule_id)`` — generic per-line
+        # suppression lookup for rules that sanction LEAF lines in a
+        # different file than the finding (ASY116's listener chains);
+        # the engine wires it to the parsed suppression tables
+        self._suppressed = suppressed or (
+            lambda path, line, rule_id: False
+        )
         self.functions: Dict[str, FunctionInfo] = {}
         self.classes: Dict[str, List[ClassInfo]] = {}  # by bare name
         self.module_functions: Dict[str, Dict[str, FunctionInfo]] = {}

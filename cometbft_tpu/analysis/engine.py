@@ -116,8 +116,16 @@ def _run_project_rules(
         sup = sups.get(path)
         return sup is not None and sup.is_suppressed(line, "ASY114")
 
+    def suppressed(path: str, line: int, rule_id: str) -> bool:
+        # generic per-line lookup for rules whose chains cross files
+        # (ASY116 sanctions listener-registration lines by id)
+        sup = sups.get(path)
+        return sup is not None and sup.is_suppressed(line, rule_id)
+
     t0 = time.perf_counter()
-    project = Project(list(files), sanctioned=sanctioned)
+    project = Project(
+        list(files), sanctioned=sanctioned, suppressed=suppressed
+    )
     if timings is not None:
         timings["callgraph-build"] = (
             timings.get("callgraph-build", 0.0)

@@ -175,6 +175,112 @@ def await_holding_lock(project: Project) -> List[Finding]:
     return out
 
 
+# DB-write leaves by method spelling: a `*.write_batch(...)` /
+# `*.executemany(...)` call is a sync disk write regardless of the
+# receiver's inferred type (the KV seam is an abstract base, so
+# type-resolved chains die at the interface — the spelling doesn't)
+_DB_WRITE_SUFFIXES = {
+    "write_batch": "is a sync DB batch write",
+    "executemany": "is a sync DB write",
+    "fsync": "is a disk barrier",
+    "fdatasync": "is a disk barrier",
+}
+
+# listener BFS bound: chains deeper than this are beyond what a
+# reviewer can audit anyway and the walk must terminate on cycles
+_ASY116_MAX_DEPTH = 8
+
+
+def _listener_blocking_chain(
+    project: Project, start, suppressed
+) -> Optional[str]:
+    """BFS from a sync-listener callback through resolved SYNC
+    callees; returns a rendered chain when any reachable function
+    contains a blocking leaf (BLOCKING_LEAVES or a DB-write
+    spelling), else None. Leaf lines suppressed for ASY116 in their
+    own file are sanctioned (same escape-hatch contract as ASY114's
+    sinks — justification comment required)."""
+    seen = {start.qualname}
+    queue = [(start, [f"`{start.name}`"], 0)]
+    while queue:
+        fn, chain, depth = queue.pop(0)
+        for node in walk_with_lambdas(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name is None:
+                continue
+            reason = BLOCKING_LEAVES.get(name) or _DB_WRITE_SUFFIXES.get(
+                name.rsplit(".", 1)[-1]
+            )
+            if reason is None:
+                continue
+            if suppressed(fn.path, node.lineno):
+                continue
+            return " -> ".join(chain + [f"`{name}` ({reason})"])
+        if depth >= _ASY116_MAX_DEPTH:
+            continue
+        for cs in fn.calls:
+            callee = project.functions.get(cs.callee)
+            if (
+                callee is None
+                or callee.is_async
+                or callee.qualname in seen
+            ):
+                continue
+            seen.add(callee.qualname)
+            queue.append(
+                (callee, chain + [f"`{cs.spelling}`"], depth + 1)
+            )
+    return None
+
+
+@project_rule(
+    "ASY116",
+    "sync-listener-blocking-call",
+    "a bus.add_sync_listener callback reaches a blocking leaf (DB "
+    "write, fsync, sync I/O) through its call chain: sync listeners "
+    "run INSIDE every publish, on the publisher's thread — the "
+    "consensus finalize path pays the write. Accumulate in memory "
+    "and flush from a bounded async drain instead (the "
+    "state/indexer.py shape)",
+)
+def sync_listener_blocking_call(project: Project) -> List[Finding]:
+    def suppressed(path: str, line: int) -> bool:
+        return project._suppressed(path, line, "ASY116")
+
+    out: List[Finding] = []
+    for fi in project.functions.values():
+        for node in walk_with_lambdas(fi.node):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = dotted(node.func)
+            if name is None or not name.endswith("add_sync_listener"):
+                continue
+            cb_name = dotted(node.args[0])
+            if cb_name is None:
+                continue
+            cb = project._resolve_dotted(fi, cb_name)
+            if cb is None or cb.is_async:
+                continue
+            msg = _listener_blocking_chain(project, cb, suppressed)
+            if msg is None:
+                continue
+            out.append(
+                Finding(
+                    fi.path, node.lineno, node.col_offset,
+                    "ASY116", "sync-listener-blocking-call",
+                    f"sync listener `{cb_name}` registered here "
+                    f"reaches a blocking call: {msg} — every "
+                    "bus.publish (the consensus finalize path "
+                    "included) pays it inline; accumulate in memory "
+                    "and flush from a bounded async drain "
+                    "(state/indexer.py IndexerService)",
+                )
+            )
+    return out
+
+
 @project_rule(
     "ASY102",
     "unawaited-coroutine-deep",

@@ -90,6 +90,18 @@ def main(argv=None) -> int:
         "with --budget)",
     )
     ap.add_argument(
+        "--subscriber-storm",
+        type=int,
+        default=0,
+        metavar="N",
+        help="after the fault schedule settles, open N websocket "
+        "subscribers against a live node's RPC and require every one "
+        "to receive consecutive NewBlock events store-verified on "
+        "the node — zero sheds, one serialization per event "
+        "(rpc/fanout.py; budget-gated with --budget via the "
+        "fanout.deliver span)",
+    )
+    ap.add_argument(
         "--fastpath",
         action="store_true",
         help="run every node with the live-consensus fast path "
@@ -130,6 +142,7 @@ def main(argv=None) -> int:
                     budget_file=budget_file,
                     config_hook=config_hook,
                     light_storm=args.light_storm,
+                    subscriber_storm=args.subscriber_storm,
                 )
             )
     finally:
@@ -158,6 +171,7 @@ def main(argv=None) -> int:
                     "shutdown_stalls": report.shutdown_stalls,
                     "proposers": report.proposers,
                     "light_storm": report.light_storm,
+                    "subscriber_storm": report.subscriber_storm,
                     "sanitizer_findings": report.sanitizer_findings,
                 },
                 f,

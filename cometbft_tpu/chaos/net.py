@@ -113,6 +113,9 @@ class ChaosReport:
     # --light-storm N): session/latency/cache stats, or empty when
     # the leg did not run
     light_storm: Dict[str, object] = field(default_factory=dict)
+    # websocket subscriber storm against a live node's fan-out plane
+    # (ISSUE 15; --subscriber-storm N): delivery/encode/shed stats
+    subscriber_storm: Dict[str, object] = field(default_factory=dict)
     # runtime concurrency sanitizer (analysis/runtime.py): every
     # finding the per-process sanitizer recorded during the run.
     # Un-injected findings also land in ``violations`` (the matrix
@@ -162,6 +165,16 @@ class ChaosReport:
                 f"{ls.get('top_height')}), request p50 "
                 f"{ls.get('p50_ms')}ms p99 {ls.get('p99_ms')}ms, "
                 f"cache {ls.get('plane', {}).get('cache', {})}"
+            )
+        if self.subscriber_storm:
+            ss = self.subscriber_storm
+            lines.append(
+                f"subscriber storm: {ss.get('subscribers')} websocket "
+                f"subscribers on {ss.get('target_node')} — "
+                f"{ss.get('delivered')} frames from "
+                f"{ss.get('encodes')} serializations, "
+                f"{ss.get('dropped')} shed, parity "
+                + ("OK" if ss.get("parity_ok") else "BROKEN")
             )
         if self.dial_failures or self.conns_killed:
             lines.append(
@@ -878,6 +891,138 @@ def _run_light_storm_sync(
     }
 
 
+async def _run_subscriber_storm(
+    net: "ChaosNet", n: int, seed: int, events_each: int = 2
+) -> dict:
+    """N real websocket subscribers storm the most advanced LIVE
+    node's fan-out plane (rpc/fanout.py, ISSUE 15) while consensus
+    keeps committing: every subscriber must receive ``events_each``
+    consecutive NewBlock events whose heights exist in the node's
+    store (delivery parity), zero frames may be shed (the stub
+    sockets drain at network speed), and the hub must have paid ~one
+    serialization per event, not per subscriber."""
+    import json as _json
+
+    import aiohttp
+
+    running = [
+        (name, node)
+        for name, node in net.running_nodes()
+        if getattr(node, "rpc_server", None) is not None
+    ]
+    if not running:
+        raise RuntimeError("no running RPC node to storm")
+    name, node = max(running, key=lambda t: t[1].height)
+    hub = node.rpc_server.fanout
+    encodes0, delivered0 = hub.encodes, hub.delivered
+    dropped0 = hub.queue_stats()["dropped"]
+    base = "http://" + node.rpc_server.listen_addr
+    q = "tm.event='NewBlock'"
+    t0 = asyncio.get_running_loop().time()
+    # default connector caps 100 conns/host — websocket conns never
+    # free their slot, so subscriber 101 would deadlock the storm
+    connector = aiohttp.TCPConnector(limit=0)
+    async with aiohttp.ClientSession(connector=connector) as sess:
+        wss = []
+        try:
+            for i in range(n):
+                ws = await sess.ws_connect(base + "/websocket")
+                await ws.send_json(
+                    {
+                        "jsonrpc": "2.0",
+                        "id": i,
+                        "method": "subscribe",
+                        "params": {"query": q},
+                    }
+                )
+                wss.append(ws)
+
+            async def collect(ws) -> list:
+                heights = []
+                while len(heights) < events_each:
+                    msg = await asyncio.wait_for(ws.receive(), 90.0)
+                    if msg.type != aiohttp.WSMsgType.TEXT:
+                        raise RuntimeError(
+                            f"storm socket closed early: {msg.type}"
+                        )
+                    body = _json.loads(msg.data)
+                    if body.get("error"):
+                        raise RuntimeError(
+                            f"storm subscribe error: {body['error']}"
+                        )
+                    res = body.get("result") or {}
+                    if res.get("query") == q:
+                        heights.append(
+                            int(
+                                res["data"]["value"]["block"]["header"][
+                                    "height"
+                                ]
+                            )
+                        )
+                return heights
+
+            results = await asyncio.wait_for(
+                asyncio.gather(*[collect(ws) for ws in wss]), 180.0
+            )
+        finally:
+            for ws in wss:
+                try:
+                    await asyncio.wait_for(ws.close(), 5.0)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    pass  # a dead socket is already closed
+    wall_s = asyncio.get_running_loop().time() - t0
+    parity_ok = True
+    store = node.parts.block_store
+    for hs in results:
+        # consecutive heights, every one a block this node committed
+        if [h - hs[0] for h in hs] != list(range(len(hs))):
+            parity_ok = False
+        for h in hs:
+            if store.load_block_meta(h) is None:
+                raise RuntimeError(
+                    f"storm delivered height {h} missing from the "
+                    f"store of {name}"
+                )
+    stats = hub.queue_stats()
+    dropped = stats["dropped"] - dropped0
+    encodes = hub.encodes - encodes0
+    delivered = hub.delivered - delivered0
+    if dropped:
+        raise RuntimeError(
+            f"subscriber storm shed {dropped} frames — the fan-out "
+            "plane must deliver a draining subscriber everything"
+        )
+    if not parity_ok:
+        raise RuntimeError(
+            "subscriber storm: non-consecutive event stream delivered"
+        )
+    # one-pass check: ~one serialization per DISTINCT event for the
+    # single query group. Bound on events, not delivered//subscriber:
+    # a block committed during the sequential attach phase costs a
+    # full encode while only a few subscribers are attached, which a
+    # frames-per-subscriber bound misreads as per-subscriber
+    # encoding. Late joiners may also split one event across group
+    # membership snapshots — so bound (2x + slack), don't pin.
+    distinct_events = len({h for hs in results for h in hs})
+    if delivered and encodes > 4 + 2 * distinct_events:
+        raise RuntimeError(
+            f"fan-out paid {encodes} serializations for {delivered} "
+            "frames — per-subscriber encoding crept back"
+        )
+    return {
+        "subscribers": n,
+        "events_each": events_each,
+        "target_node": name,
+        "encodes": encodes,
+        "delivered": delivered,
+        "dropped": dropped,
+        "parity_ok": parity_ok,
+        "wall_s": round(wall_s, 3),
+    }
+
+
 async def run_schedule(
     schedule: FaultSchedule,
     seed: int,
@@ -893,6 +1038,7 @@ async def run_schedule(
     workload=None,
     enable_rpc: Optional[bool] = None,
     light_storm: int = 0,
+    subscriber_storm: int = 0,
 ) -> ChaosReport:
     """Execute one seeded chaos run end-to-end and return its report
     (violations recorded, not raised — callers assert on report.ok).
@@ -911,10 +1057,12 @@ async def run_schedule(
     violation (report.budget_ok goes False, the CLI exits nonzero)."""
     table = LinkTable(seed, fuzz_config=fuzz_config)
     if enable_rpc is None:
-        # the statesync joiner bootstraps over the sources' RPC —
-        # switch the listeners on exactly when the schedule needs them
-        enable_rpc = any(
-            e.action == "statesync_join" for e in schedule.events
+        # the statesync joiner bootstraps over the sources' RPC, and
+        # the subscriber storm needs a websocket endpoint — switch
+        # the listeners on exactly when the run needs them
+        enable_rpc = (
+            any(e.action == "statesync_join" for e in schedule.events)
+            or subscriber_storm > 0
         )
     net = ChaosNet(
         n_nodes,
@@ -1023,6 +1171,21 @@ async def run_schedule(
                 except Exception as e:
                     report.violations.append(
                         f"light serving storm failed: {e!r}"
+                    )
+            if subscriber_storm > 0 and net.running_nodes():
+                # fan-out plane leg (ISSUE 15): websocket subscribers
+                # storm a live node while consensus keeps committing;
+                # a shed, parity break or per-subscriber-encode
+                # regression is a violation
+                try:
+                    report.subscriber_storm = await _run_subscriber_storm(
+                        net, subscriber_storm, seed
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    report.violations.append(
+                        f"subscriber storm failed: {e!r}"
                     )
         finally:
             stop_polling.set()

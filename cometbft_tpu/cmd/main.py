@@ -347,7 +347,12 @@ def cmd_reindex_event(args) -> int:
     """Rebuild tx/block indexes from stored blocks + finalize
     responses (reference commands/reindex_event.go)."""
     from ..state.execution import decode_finalize_response
-    from ..state.indexer import BlockIndexer, TxIndexer
+    from ..state.indexer import (
+        LAST_INDEXED_KEY,
+        BlockIndexer,
+        TxIndexer,
+        _enc_height,
+    )
     from ..state.store import Store as StateStore
     from ..store.block_store import BlockStore
     from ..utils import kv
@@ -368,10 +373,27 @@ def cmd_reindex_event(args) -> int:
         if blk is None or raw is None:
             continue
         resp = decode_finalize_response(raw)
+        # ONE atomic batch per height — rows + the idx:last marker —
+        # exactly the live IndexerService flush shape (ISSUE 15), so
+        # a killed reindex resumes where it stopped
+        sets = []
         for i, tx in enumerate(blk.data.txs):
             if i < len(resp.tx_results):
-                txi.index_tx(h, i, tx, resp.tx_results[i])
-        bli.index_block(h, resp.events)
+                sets.extend(txi.tx_sets(h, i, tx, resp.tx_results[i]))
+        sets.extend(bli.block_sets(h, resp.events))
+        # marker advances CONTIGUOUSLY only (same contract as the
+        # live flush): an explicit --start-height above idx:last+1
+        # must not jump the marker over never-indexed heights, or
+        # IndexerService.replay() would skip them forever. A gap
+        # that lies entirely below the store base is pruned —
+        # unindexable — so jumping it is safe (replay's anchored
+        # walk does the same).
+        last = txi.last_indexed_height()
+        if last >= h - 1 or bs.base() >= h:
+            sets.append(
+                (LAST_INDEXED_KEY, _enc_height(max(last, h)))
+            )
+        index_db.write_batch(sets)
         count += 1
     print(f"Reindexed {count} blocks [{start},{end}]")
     for db in (block_db, state_db, index_db):

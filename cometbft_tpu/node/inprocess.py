@@ -80,6 +80,10 @@ class NodeParts:
     tx_indexer: object = None
     block_indexer: object = None
     index_db: object = None
+    # per-height batched indexing drain (state/indexer.py, ISSUE 15);
+    # retained so Node.start can upgrade it to async + crash replay
+    # and Node._shutdown can flush it bounded
+    indexer_service: object = None
     # per-node tracing plane (trace/, docs/TRACE.md); NOOP when
     # [instrumentation] trace_enabled = false
     tracer: object = TRACE_NOOP
@@ -187,10 +191,12 @@ def build_node(
 
     evpool = EvidencePool(kv.MemKV(), state_store, block_store)
     # indexing is config-gated (reference [tx_index] indexer = "kv" |
-    # "null"); the kv indexer runs as a sync event listener — nodes
-    # that never serve tx_search should set "null" to keep the commit
-    # path free of indexing work
-    tx_indexer = block_indexer = index_db = None
+    # "null"); the service accumulates a height's events in-memory on
+    # the bus and flushes ONE write_batch per height — off the commit
+    # path entirely once Node.start upgrades it to the async drain
+    # (state/indexer.py, ISSUE 15). "null" keeps even the
+    # accumulation off the publish path.
+    tx_indexer = block_indexer = index_db = indexer_service = None
     if config.tx_index.indexer == "kv":
         index_db = kv.open_kv(
             config.base.db_backend,
@@ -200,14 +206,20 @@ def build_node(
         )
         tx_indexer = TxIndexer(index_db)
         block_indexer = BlockIndexer(index_db)
-        IndexerService(tx_indexer, block_indexer, event_bus).start()
+        indexer_service = IndexerService(
+            tx_indexer, block_indexer, event_bus
+        )
+        indexer_service.tracer = tracer
+        indexer_service.start()
     elif config.tx_index.indexer == "psql":
         # write-only relational sink (reference state/indexer/sink/psql);
         # retained on the parts so Node.stop can flush + close it
         from ..state.psql_sink import PsqlSink
 
         sink = PsqlSink(config.tx_index.psql_conn, genesis.chain_id)
-        IndexerService(sink, sink, event_bus).start()
+        indexer_service = IndexerService(sink, sink, event_bus)
+        indexer_service.tracer = tracer
+        indexer_service.start()
         tx_indexer = block_indexer = sink
     # mempool flavor by config: clist | app (fork) | nop (ADR-111)
     if config.mempool.type_ == "app":
@@ -273,6 +285,7 @@ def build_node(
         tx_indexer=tx_indexer,
         block_indexer=block_indexer,
         index_db=index_db,
+        indexer_service=indexer_service,
         tracer=tracer,
     )
 

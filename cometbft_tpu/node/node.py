@@ -199,6 +199,7 @@ class Node:
         self._cs_started = False
         self.rpc_server = None
         self.grpc_server = None
+        self.rpc_env = None
         self._statesync_task = None
         self.statesync_error = None
         # cross-client verified-header cache (light/serving.py):
@@ -249,6 +250,22 @@ class Node:
             else None,
         )
         q.register("events.subs", self.parts.event_bus.queue_stats)
+        # outbound fan-out plane (rpc/fanout.py): per-websocket-
+        # subscriber frame queues, aggregated; None until the RPC
+        # server exists
+        q.register(
+            "rpc.fanout",
+            lambda: self.rpc_server.fanout.queue_stats()
+            if getattr(self, "rpc_server", None) is not None
+            else None,
+        )
+        # per-height batched index drain (state/indexer.py)
+        q.register(
+            "state.index",
+            lambda: self.parts.indexer_service.queue_stats()
+            if self.parts.indexer_service is not None
+            else None,
+        )
 
         def p2p_send():
             depth = hwm = dropped = enqueued = 0
@@ -417,6 +434,13 @@ class Node:
             chain=self.genesis.chain_id,
             height=self.parts.block_store.height(),
         )
+        if self.parts.indexer_service is not None:
+            # per-height batched indexing (state/indexer.py): replay
+            # any crash gap past the idx:last marker, then flush from
+            # the bounded async drain instead of inline at seal time
+            await self.parts.indexer_service.start_async(
+                self.parts.block_store, self.parts.state_store
+            )
         rpc_env = None
         if self.config.rpc.laddr:
             from ..rpc import Environment, RPCServer
@@ -426,17 +450,21 @@ class Node:
             await self.rpc_server.start(_strip_proto(self.config.rpc.laddr))
         if self.config.rpc.grpc_laddr:
             # legacy gRPC broadcast API (reference rpc/grpc) — serves
-            # even when the JSON-RPC listener is disabled
+            # even when the JSON-RPC listener is disabled; shares the
+            # env's CommitWaiterMap with the JSON-RPC route
             from ..rpc import Environment
             from ..rpc.grpc_api import GRPCBroadcastServer
 
+            rpc_env = rpc_env or Environment.from_node(self)
             self.grpc_server = GRPCBroadcastServer(
-                rpc_env or Environment.from_node(self),
+                rpc_env,
                 _strip_proto(self.config.rpc.grpc_laddr),
                 asyncio.get_running_loop(),
                 timeout_s=self.config.rpc.timeout_broadcast_tx_commit_s,
             )
             self.grpc_server.start()
+        # retained so _shutdown can release the commit-waiter drain
+        self.rpc_env = rpc_env
         if self.config.instrumentation.prometheus:
             from ..utils.metrics import MetricsServer, NodeMetrics
 
@@ -532,6 +560,10 @@ class Node:
             self.grpc_server.stop()
         if self.rpc_server is not None:
             await guard.stage("rpc", self.rpc_server.stop())
+        if getattr(self, "rpc_env", None) is not None:
+            # commit-waiter drain (rpc/fanout.py): after both servers
+            # so no route can re-create it mid-teardown
+            await guard.stage("rpc_env", self.rpc_env.close())
         if self._cs_started:
             await guard.stage(
                 "consensus",
@@ -553,6 +585,12 @@ class Node:
                 self.switch.abort()
             except Exception:
                 traceback.print_exc()
+        if self.parts.indexer_service is not None:
+            # after consensus/switch: nothing publishes anymore, so
+            # stop() can flush the remaining sealed heights bounded
+            await guard.stage(
+                "indexer", self.parts.indexer_service.stop()
+            )
         # release store handles (psql sink flush+close; logdb flocks;
         # sqlite fds) — a restart in the same process must be able to
         # reopen every database. Last on purpose: it must run even

@@ -407,7 +407,12 @@ def block_results(env, height=None) -> Dict[str, Any]:
     return {
         "height": str(h),
         "txs_results": [enc.tx_result_json(r) for r in resp.tx_results],
-        "finalize_block_events": [],
+        # block-level events persist with the response now (ISSUE 15:
+        # the stored record is the indexer's crash-replay source, so
+        # it must carry everything live indexing saw)
+        "finalize_block_events": [
+            enc.abci_event_json(e) for e in resp.events
+        ],
         "app_hash": enc.hexb(resp.app_hash),
         "validator_updates": [
             {"power": str(u.power), "pub_key_type": u.pub_key_type,
@@ -563,14 +568,17 @@ async def broadcast_tx_sync(env, tx=None) -> Dict[str, Any]:
 
 
 async def broadcast_tx_commit(env, tx=None, timeout_s: float = 10.0):
-    """Subscribe to the tx event, CheckTx, await inclusion (reference
-    rpc/core/mempool.go:70)."""
+    """CheckTx, then await inclusion through the height-keyed
+    CommitWaiterMap (rpc/fanout.py): ONE lossless sync bus listener
+    total and a dict lookup per committed tx, instead of the per-RPC
+    predicate subscription the reference shape
+    (rpc/core/mempool.go:70) pays on every publish."""
     raw = _bytes_param(tx)
     key = _tx_hash(raw)
-    bus = env.event_bus
-    sub = bus.subscribe(
-        lambda e: e.type_ == "Tx" and e.attrs.get("hash") == key.hex()
-    )
+    waiters = env.commit_waiters()
+    # register BEFORE submitting (the subscribe-before-CheckTx
+    # ordering): a commit can never race past the waiter
+    fut = waiters.register(key.hex())
     try:
         res = await env.submit_tx_async(raw)
         if res.code != 0:
@@ -580,7 +588,7 @@ async def broadcast_tx_commit(env, tx=None, timeout_s: float = 10.0):
                 "hash": enc.hexb(key),
                 "height": "0",
             }
-        event = await asyncio.wait_for(sub.queue.get(), timeout_s)
+        event = await asyncio.wait_for(fut, timeout_s)
         return {
             "check_tx": {"code": 0, "log": ""},
             "tx_result": enc.tx_result_json(event.data["result"]),
@@ -590,7 +598,9 @@ async def broadcast_tx_commit(env, tx=None, timeout_s: float = 10.0):
     except asyncio.TimeoutError:
         raise RPCError(-32603, "timed out waiting for tx to be included")
     finally:
-        sub.unsubscribe()
+        # timeout, cancellation (gRPC grace expiry) and success all
+        # release the map entry here — no leak, no stale resolution
+        waiters.unregister(key.hex(), fut)
 
 
 def _tx_hash(tx: bytes) -> bytes:
@@ -652,9 +662,20 @@ def abci_query(env, path="", data=None, height=0, prove=False) -> Dict[str, Any]
 # --- tx / block search (indexer-backed) ---------------------------------
 
 
-def tx(env, hash=None, prove=False) -> Dict[str, Any]:
+async def _index_barrier(env) -> None:
+    """Read-your-writes for index queries: indexing flushes per
+    height from a bounded async drain (state/indexer.py), so a query
+    racing the commit that published its tx waits (bounded) for the
+    sealed heights to land before scanning."""
+    svc = getattr(env, "indexer_service", None)
+    if svc is not None:
+        await svc.barrier()
+
+
+async def tx(env, hash=None, prove=False) -> Dict[str, Any]:
     if env.tx_indexer is None:
         raise RPCError(-32603, "tx indexing is disabled")
+    await _index_barrier(env)
     key = _bytes_param(hash)
     res = env.tx_indexer.get(key)
     if res is None:
@@ -716,11 +737,12 @@ def _tx_proof(env, height: int, index: int, tx_bytes: bytes, cache: dict):
     }
 
 
-def tx_search(
+async def tx_search(
     env, query="", prove=False, page=1, per_page=30, order_by="asc"
 ) -> Dict[str, Any]:
     if env.tx_indexer is None:
         raise RPCError(-32603, "tx indexing is disabled")
+    await _index_barrier(env)
     q = parse_query(str(query))
     hits = env.tx_indexer.search(q)
     if str(order_by) == "desc":
@@ -746,9 +768,10 @@ def tx_search(
     return {"txs": out, "total_count": str(len(hits))}
 
 
-def block_search(env, query="", page=1, per_page=30, order_by="asc"):
+async def block_search(env, query="", page=1, per_page=30, order_by="asc"):
     if env.block_indexer is None:
         raise RPCError(-32603, "block indexing is disabled")
+    await _index_barrier(env)
     q = parse_query(str(query))
     heights = env.block_indexer.search(q)
     if str(order_by) == "desc":

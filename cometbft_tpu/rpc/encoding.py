@@ -117,6 +117,16 @@ def validator_set_json(vs: T.ValidatorSet) -> Dict[str, Any]:
     }
 
 
+def abci_event_json(e) -> Dict[str, Any]:
+    return {
+        "type": e.type_,
+        "attributes": [
+            dict(zip(("key", "value", "index"), attr_kvi(a)))
+            for a in e.attributes
+        ],
+    }
+
+
 def tx_result_json(r) -> Dict[str, Any]:
     return {
         "code": r.code,
@@ -129,13 +139,6 @@ def tx_result_json(r) -> Dict[str, Any]:
         # from this JSON (light/proxy.py _verified_block_results)
         "codespace": getattr(r, "codespace", ""),
         "events": [
-            {
-                "type": e.type_,
-                "attributes": [
-                    dict(zip(("key", "value", "index"), attr_kvi(a)))
-                    for a in e.attributes
-                ],
-            }
-            for e in getattr(r, "events", [])
+            abci_event_json(e) for e in getattr(r, "events", [])
         ],
     }

@@ -34,6 +34,36 @@ class Environment:
     # co-resident serving plane) first needs it, which can be after
     # this Environment was built
     light_header_cache_fn: object = None
+    # outbound fan-out plane (rpc/fanout.py, ISSUE 15)
+    tracer: object = None  # node trace ring (fanout.* spans)
+    indexer_service: object = None  # batched per-height index drain
+    # height-keyed commit waiters, shared by broadcast_tx_commit AND
+    # the gRPC broadcast API: lazily built so inspect-mode envs never
+    # subscribe (field, not ctor arg — see commit_waiters())
+    _commit_waiters: object = None
+
+    def commit_waiters(self):
+        """The ONE CommitWaiterMap for this env (one lossless sync
+        bus listener total, O(1) publish cost in in-flight commit
+        RPCs)."""
+        if self._commit_waiters is None:
+            from .fanout import CommitWaiterMap
+
+            self._commit_waiters = CommitWaiterMap(self.event_bus)
+        return self._commit_waiters
+
+    async def close(self) -> None:
+        """Release env-owned background plumbing (the commit-waiter
+        drain); bounded (ASY110), safe to call twice."""
+        import asyncio
+
+        cw = self._commit_waiters
+        self._commit_waiters = None
+        if cw is not None:
+            try:
+                await asyncio.wait_for(cw.close(), 5.0)
+            except asyncio.TimeoutError:
+                pass
 
     def submit_tx(self, tx: bytes):
         """CheckTx + (app-mempool) gossip: RPC broadcast entry point
@@ -107,4 +137,6 @@ class Environment:
             light_header_cache_fn=lambda: getattr(
                 node, "light_header_cache", None
             ),
+            tracer=p.tracer,
+            indexer_service=getattr(p, "indexer_service", None),
         )

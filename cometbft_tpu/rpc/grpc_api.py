@@ -62,8 +62,12 @@ class GRPCBroadcastServer:
             )
             try:
                 # small grace over the coroutine's own deadline; on
-                # expiry CANCEL the future so the event-bus
-                # subscription inside broadcast_tx_commit is released
+                # expiry CANCEL the future so the height-keyed
+                # CommitWaiterMap entry inside broadcast_tx_commit is
+                # released (rpc/fanout.py — this API rides the same
+                # one-subscription waiter plane as the JSON-RPC route,
+                # so N concurrent gRPC broadcasts cost one dict entry
+                # each, not one bus predicate each)
                 res = fut.result(timeout_s + 5.0)
             except Exception as e:
                 fut.cancel()

@@ -1,7 +1,9 @@
 """JSON-RPC 2.0 server over HTTP + WebSocket (reference
 rpc/jsonrpc/server/): POST bodies, GET URI params, and a `/websocket`
 endpoint with subscribe/unsubscribe event streaming backed by the
-node's EventBus and the pubsub query language."""
+node's EventBus through the outbound fan-out plane (rpc/fanout.py —
+one serialization pass per event × query shape, not per
+subscriber)."""
 
 from __future__ import annotations
 
@@ -12,11 +14,12 @@ from typing import Any, Dict, Optional
 
 from aiohttp import WSMsgType, web
 
-from ..types import events as ev
 from ..utils.pubsub_query import parse as parse_query
 from . import core
-from . import encoding as enc
 from .env import Environment
+from .fanout import FanoutHub, _event_attrs, _event_json  # noqa: F401
+# _event_attrs/_event_json re-exported for compat: they lived here
+# before the fan-out plane (tests and the bench baseline import them)
 
 
 def _rpc_response(id_, result=None, error=None) -> Dict[str, Any]:
@@ -35,50 +38,14 @@ def _rpc_error(code: int, message: str, data: str = "") -> Dict[str, Any]:
     return e
 
 
-def _event_attrs(e: ev.Event) -> Dict[str, list]:
-    """Flatten an Event into query-matchable attributes, mirroring the
-    reference's composite keys (tm.event + abci event attributes)."""
-    attrs: Dict[str, list] = {"tm.event": [e.type_]}
-    for k, v in e.attrs.items():
-        attrs.setdefault(f"tm.{k}", []).append(str(v))
-    if e.type_ == ev.EVENT_TX and isinstance(e.data, dict):
-        attrs["tx.height"] = [str(e.data.get("height", ""))]
-        if "hash" in e.attrs:
-            attrs["tx.hash"] = [e.attrs["hash"].upper()]
-        result = e.data.get("result")
-        from ..abci.types import attr_kvi
-
-        for evt in getattr(result, "events", []) or []:
-            for a in evt.attributes:
-                k, v, _ = attr_kvi(a)
-                attrs.setdefault(f"{evt.type_}.{k}", []).append(v)
-    return attrs
-
-
-def _event_json(e: ev.Event) -> Dict[str, Any]:
-    if e.type_ == ev.EVENT_NEW_BLOCK and isinstance(e.data, dict):
-        return {
-            "type": "tendermint/event/NewBlock",
-            "value": {"block": enc.block_json(e.data["block"])},
-        }
-    if e.type_ == ev.EVENT_TX and isinstance(e.data, dict):
-        return {
-            "type": "tendermint/event/Tx",
-            "value": {
-                "TxResult": {
-                    "height": str(e.data["height"]),
-                    "index": e.data["index"],
-                    "tx": enc.b64(e.data["tx"]),
-                    "result": enc.tx_result_json(e.data["result"]),
-                }
-            },
-        }
-    return {"type": f"tendermint/event/{e.type_}", "value": {}}
-
-
 class RPCServer:
     def __init__(self, env: Environment):
         self.env = env
+        # outbound fan-out plane: ONE bus subscription, one
+        # serialization per event × query shape (docs/PERF.md)
+        self.fanout = FanoutHub(
+            env.event_bus, tracer=getattr(env, "tracer", None)
+        )
         self.app = web.Application()
         self.app.router.add_post("/", self._handle_post)
         self.app.router.add_get("/websocket", self._handle_ws)
@@ -115,6 +82,12 @@ class RPCServer:
                 await asyncio.wait_for(self._runner.cleanup(), 5.0)
             except asyncio.TimeoutError:
                 pass
+        # fan-out plane after the handlers: their exit paths detach
+        # cleanly; close() reaps whatever a breached cleanup left
+        try:
+            await asyncio.wait_for(self.fanout.close(), 5.0)
+        except asyncio.TimeoutError:
+            pass
 
     # --- dispatch -----------------------------------------------------
 
@@ -198,29 +171,10 @@ class RPCServer:
     async def _handle_ws(self, request: web.Request):
         ws = web.WebSocketResponse()
         await ws.prepare(request)
-        subs: Dict[str, tuple] = {}  # query string -> (Subscription, task)
-
-        async def pump(query_str: str, sub, sub_id):
-            try:
-                while True:
-                    event = await sub.queue.get()
-                    attrs = _event_attrs(event)
-                    if not sub.query_obj.matches(attrs):
-                        continue
-                    await ws.send_json(
-                        _rpc_response(
-                            sub_id,
-                            {
-                                "query": query_str,
-                                "data": _event_json(event),
-                                "events": attrs,
-                            },
-                        )
-                    )
-            except (asyncio.CancelledError, ConnectionError):
-                pass
-            except Exception:
-                traceback.print_exc()
+        # query string -> FanoutSubscriber: the hub owns the bus
+        # subscription + delivery; this handler only manages
+        # membership for this socket's lifetime
+        subs: Dict[str, object] = {}
 
         try:
             async for msg in ws:
@@ -261,22 +215,18 @@ class RPCServer:
                             )
                         )
                         continue
-                    sub = self.env.event_bus.subscribe()
-                    sub.query_obj = q
-                    task = asyncio.create_task(pump(qs, sub, id_))
-                    subs[qs] = (sub, task)
+                    subs[qs] = self.fanout.attach(ws, qs, q, id_)
                     await ws.send_json(_rpc_response(id_, {}))
                 elif method == "unsubscribe":
                     qs = str(params.get("query", ""))
-                    pair = subs.pop(qs, None)
-                    if pair:
-                        pair[0].unsubscribe()
-                        pair[1].cancel()
+                    sub = subs.pop(qs, None)
+                    if sub is not None:
+                        # awaits the cancelled writer (bounded): no
+                        # mid-send task may outlive the subscription
+                        await self.fanout.detach(sub)
                     await ws.send_json(_rpc_response(id_, {}))
                 elif method == "unsubscribe_all":
-                    for sub, task in subs.values():
-                        sub.unsubscribe()
-                        task.cancel()
+                    await self.fanout.detach_all(subs.values())
                     subs.clear()
                     await ws.send_json(_rpc_response(id_, {}))
                 else:
@@ -299,7 +249,9 @@ class RPCServer:
                             )
                         )
         finally:
-            for sub, task in subs.values():
-                sub.unsubscribe()
-                task.cancel()
+            # handler exit (socket closed / server cleanup): detach
+            # AND await every writer task bounded — fire-and-forget
+            # cancel here used to leak mid-send tasks into loop
+            # teardown (ASY110)
+            await self.fanout.detach_all(subs.values())
         return ws

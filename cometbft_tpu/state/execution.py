@@ -33,7 +33,41 @@ def results_hash(tx_results: List[abci.ExecTxResult]) -> bytes:
     return merkle.hash_from_byte_slices([r.encode() for r in tx_results])
 
 
+def _enc_abci_event(e: abci.Event) -> bytes:
+    out = proto.field_string(1, e.type_)
+    for a in e.attributes:
+        k, v, idx = abci.attr_kvi(a)
+        out += proto.field_bytes(
+            2,
+            proto.field_string(1, k)
+            + proto.field_string(2, v)
+            + proto.field_varint(3, 1 if idx else 0),
+        )
+    return out
+
+
+def _dec_abci_event(b: bytes) -> abci.Event:
+    m = proto.parse(b)
+    attrs = []
+    for ab in m.get(2, []):
+        am = proto.parse(ab)
+        attrs.append(
+            abci.EventAttribute(
+                key=proto.get1(am, 1, b"").decode(),
+                value=proto.get1(am, 2, b"").decode(),
+                index=bool(proto.get1(am, 3, 0)),
+            )
+        )
+    return abci.Event(type_=proto.get1(m, 1, b"").decode(), attributes=attrs)
+
+
 def encode_finalize_response(resp: abci.ResponseFinalizeBlock) -> bytes:
+    # NOTE: per-tx events ride NEW fields (4: block events, 5: one
+    # aligned event-list per tx_result) because r.encode() feeds
+    # LastResultsHash and must stay byte-stable (ISSUE 15: the stored
+    # response is the indexer's crash-replay source — events lost
+    # here would be index rows lost to a crash). Old records simply
+    # lack fields 4/5 and decode event-less, as before.
     out = b""
     for r in resp.tx_results:
         out += proto.field_message(1, r.encode())
@@ -45,6 +79,19 @@ def encode_finalize_response(resp: abci.ResponseFinalizeBlock) -> bytes:
             + proto.field_varint(3, vu.power),
         )
     out += proto.field_bytes(3, resp.app_hash)
+    for e in resp.events:
+        out += proto.field_message(4, _enc_abci_event(e))
+    for i, r in enumerate(resp.tx_results):
+        if not r.events:
+            continue  # empty fields encode to nothing; key by index
+        out += proto.field_message(
+            5,
+            proto.field_varint(1, i)
+            + b"".join(
+                proto.field_message(2, _enc_abci_event(e))
+                for e in r.events
+            ),
+        )
     return out
 
 
@@ -62,6 +109,13 @@ def decode_finalize_response(b: bytes) -> abci.ResponseFinalizeBlock:
                 codespace=proto.get1(rm, 8, b"").decode() if proto.get1(rm, 8) else "",
             )
         )
+    for evb in m.get(5, []):
+        em = proto.parse(evb)
+        i = proto.get1(em, 1, 0)
+        if 0 <= i < len(txrs):
+            txrs[i].events = [
+                _dec_abci_event(eb) for eb in em.get(2, [])
+            ]
     vus = []
     for vb in m.get(2, []):
         vm = proto.parse(vb)
@@ -73,6 +127,7 @@ def decode_finalize_response(b: bytes) -> abci.ResponseFinalizeBlock:
             )
         )
     return abci.ResponseFinalizeBlock(
+        events=[_dec_abci_event(eb) for eb in m.get(4, [])],
         tx_results=txrs,
         validator_updates=vus,
         app_hash=proto.get1(m, 3, b""),

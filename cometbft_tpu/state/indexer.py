@@ -6,20 +6,49 @@ KV layout (order-preserving big-endian heights for prefix scans):
   tx:h:<hash>                  -> record(height, index, tx, result)
   tx:a:<key>=<value>:<height8>:<index4> -> tx hash   (attribute index)
   blk:e:<key>=<value>:<height8>         -> b""       (block events)
+  idx:last                     -> height8 (last FULLY indexed height)
 Search evaluates the pubsub query against the attribute index;
-height conditions constrain the scan range."""
+height conditions constrain the scan range.
+
+ISSUE 15 (outbound fan-out plane): ``IndexerService`` no longer
+writes the DB inside the bus publish — the sync listener only
+ACCUMULATES a height's tx + block events in memory and, once the
+height is complete, hands the bundle to a bounded async drain that
+flushes everything (rows + the ``idx:last`` marker) in ONE
+``db.write_batch`` per height off the consensus hot path. The marker
+rides the same atomic batch, so a crash leaves it pointing at the
+last fully indexed height and ``replay()`` re-indexes forward
+idempotently (keys are deterministic — a re-run overwrites identical
+rows, never duplicates them)."""
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import struct
 import threading
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..abci import types as abci
+from ..obs.queues import InstrumentedQueue
+from ..trace import NOOP as TRACE_NOOP
 from ..types import events as ev
 from ..utils import kv, proto
 from ..utils.pubsub_query import Query
+from ..utils.tasks import spawn
+
+# last fully indexed height, written ATOMICALLY with that height's
+# rows (crash consistency: the marker can never run ahead of rows,
+# and rows without the marker are re-written identically on replay)
+LAST_INDEXED_KEY = b"idx:last"
+
+
+def _enc_height(h: int) -> bytes:
+    return struct.pack(">Q", h)
+
+
+def _dec_height(b: Optional[bytes]) -> int:
+    return struct.unpack(">Q", b)[0] if b else 0
 
 
 def _enc_record(height: int, index: int, tx: bytes, result) -> bytes:
@@ -105,9 +134,12 @@ class TxIndexer:
         self.db = db
         self._lock = threading.Lock()
 
-    def index_tx(
+    def tx_sets(
         self, height: int, index: int, tx: bytes, result: abci.ExecTxResult
-    ) -> None:
+    ) -> List[Tuple[bytes, bytes]]:
+        """The (key, value) rows for one tx — pure, deterministic:
+        re-running on the same inputs produces byte-identical rows,
+        which is what makes crash replay idempotent."""
         h = hashlib.sha256(tx).digest()
         sets = [(b"tx:h:" + h, _enc_record(height, index, tx, result))]
         # implicit attributes (reference: tx.height is always indexed)
@@ -120,8 +152,19 @@ class TxIndexer:
                 sets.append(
                     (_attr_key(f"{e.type_}.{k}", v, height, index), h)
                 )
+        return sets
+
+    def index_tx(
+        self, height: int, index: int, tx: bytes, result: abci.ExecTxResult
+    ) -> None:
         with self._lock:
-            self.db.write_batch(sets)
+            self.db.write_batch(self.tx_sets(height, index, tx, result))
+
+    def last_indexed_height(self) -> int:
+        """The crash-consistency marker (``idx:last``): every height
+        <= this is FULLY indexed (rows + marker land in one atomic
+        batch per height)."""
+        return _dec_height(self.db.get(LAST_INDEXED_KEY))
 
     def get(self, tx_hash: bytes):
         raw = self.db.get(b"tx:h:" + tx_hash)
@@ -213,7 +256,11 @@ class BlockIndexer:
     def __init__(self, db: kv.KV):
         self.db = db
 
-    def index_block(self, height: int, events: List[abci.Event]) -> None:
+    def block_sets(
+        self, height: int, events: List[abci.Event]
+    ) -> List[Tuple[bytes, bytes]]:
+        """Pure (key, value) rows for one block's events (same
+        idempotency contract as TxIndexer.tx_sets)."""
         sets = [
             (
                 b"blk:e:block.height="
@@ -237,7 +284,10 @@ class BlockIndexer:
                         b"",
                     )
                 )
-        self.db.write_batch(sets)
+        return sets
+
+    def index_block(self, height: int, events: List[abci.Event]) -> None:
+        self.db.write_batch(self.block_sets(height, events))
 
     def search(self, q: Query) -> List[int]:
         heights: Optional[set] = None
@@ -277,25 +327,365 @@ class BlockIndexer:
         return sorted(heights or ())
 
 
+class HeightBundle:
+    """Everything one height needs indexed, sealed once complete."""
+
+    __slots__ = ("height", "txs", "block_events")
+
+    def __init__(self, height: int, txs: list, block_events: list):
+        self.height = height
+        self.txs = txs  # [(index, tx_bytes, ExecTxResult)]
+        self.block_events = block_events
+
+
 class IndexerService:
     """Event-bus-driven indexing (reference
-    state/txindex/indexer_service.go:29,43)."""
+    state/txindex/indexer_service.go:29,43) with per-height batched,
+    off-hot-path flushing (ISSUE 15).
 
-    def __init__(self, tx_indexer: TxIndexer, block_indexer: BlockIndexer, event_bus):
+    The sync listener is now PURE ACCUMULATION: ``EVENT_NEW_BLOCK``
+    opens a height bundle (block events + expected tx count from the
+    block itself), each ``EVENT_TX`` appends, and the bundle seals
+    when the last tx of the height lands — all in-memory, no DB work
+    inside ``bus.publish`` (bftlint ASY116 exists to keep it that
+    way). Sealed bundles flush from a bounded async drain
+    (``start_async``), ONE ``db.write_batch`` per height carrying the
+    rows AND the ``idx:last`` marker; without a running loop (CLI
+    reindex, sync tests) sealing flushes inline — still one batch per
+    height, the pre-ISSUE-15 consistency semantics.
+
+    ``barrier()`` gives RPC index queries read-your-writes over the
+    async drain; ``replay()`` closes the crash hole: on restart every
+    height past the marker is re-indexed from the stored blocks +
+    finalize responses, idempotently."""
+
+    # a drain this deep means indexing itself is the bottleneck; the
+    # overflow path flushes off-loop without queueing (never drops)
+    QUEUE_SIZE = 256
+
+    def __init__(
+        self, tx_indexer: TxIndexer, block_indexer: BlockIndexer, event_bus
+    ):
         self.tx_indexer = tx_indexer
         self.block_indexer = block_indexer
         self.bus = event_bus
+        self.tracer = TRACE_NOOP
+        # one atomic batch per height requires both indexers on the
+        # SAME kv db (the node wiring); the psql sink (no .db) keeps
+        # its per-item API, still moved off the publish path
+        db = getattr(tx_indexer, "db", None)
+        self._kv_db = (
+            db
+            if db is not None
+            and getattr(block_indexer, "db", None) is db
+            and hasattr(tx_indexer, "tx_sets")
+            and hasattr(block_indexer, "block_sets")
+            else None
+        )
+        self._pending: Dict[int, dict] = {}
+        self._plock = threading.Lock()
+        self._flush_lock = threading.Lock()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._queue: InstrumentedQueue = InstrumentedQueue(
+            self.QUEUE_SIZE, name="state.index"
+        )
+        self._task = None
+        self._inflight = 0
+        self.sealed_heights = 0
+        self.flushed_heights = 0
+        self.flush_failures = 0
+        self.replayed_heights = 0
+        # flushed-but-not-yet-marker-covered heights (out-of-order
+        # flushes via the overflow path): the idx:last marker only
+        # advances CONTIGUOUSLY, so a crash can never skip a height
+        # that was still queued in memory
+        self._done_heights: set = set()
+        # in-flight overflow-path flushes: stop() must await them or
+        # a graceful stop races Node._shutdown's store close and
+        # loses the height's rows until the next restart's replay
+        self._overflow_tasks: set = set()
+        # first height ever sealed live in this process: heights
+        # below it can only land via replay()'s anchored walk, so it
+        # floors the contiguity check — without it a statesync-
+        # restored joiner (marker 0, live heights starting at
+        # snapshot+1, the gap pruned) would park every height in
+        # _done_heights forever and never advance the marker
+        self._first_sealed: Optional[int] = None
+
+    # --- lifecycle ----------------------------------------------------
 
     def start(self) -> None:
-        self.bus.add_sync_listener(self._on_event)
+        """Attach the accumulator (build time, loop not required).
+
+        ASY116-sanctioned: the accumulator's only blocking reach is
+        the no-running-loop inline degrade in _seal (CLI tools / sync
+        embedders — no loop exists to stall in that mode); with a
+        loop, sealing hands the bundle to the bounded async drain."""
+        self.bus.add_sync_listener(self._on_event)  # bftlint: disable=ASY116
+
+    async def start_async(self, block_store=None, state_store=None) -> None:
+        """Upgrade to the async drain (Node.start): replay any
+        crash gap first, then flush sealed bundles off-loop."""
+        if block_store is not None and state_store is not None:
+            await asyncio.to_thread(self.replay, block_store, state_store)
+        self._loop = asyncio.get_running_loop()
+        if self._task is None:
+            self._task = spawn(self._drain(), name="indexer-flush")
+
+    async def stop(self) -> None:
+        """Bounded stop (ASY110): reap the drain, then flush whatever
+        was still queued synchronously — a graceful stop loses no
+        index rows (a crash is what replay() is for)."""
+        t, self._task = self._task, None
+        self._loop = None
+        if t is not None:
+            t.cancel()
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(t, return_exceptions=True), 2.0
+                )
+            except asyncio.TimeoutError:
+                pass
+        while not self._queue.empty():
+            await asyncio.to_thread(self._flush, self._queue.get_nowait())
+        # overflow-path flushes still in flight write to the same db
+        # Node._shutdown is about to close — await them (bounded)
+        pending = [t for t in self._overflow_tasks if not t.done()]
+        if pending:
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*pending, return_exceptions=True), 5.0
+                )
+            except asyncio.TimeoutError:
+                pass
+
+    # --- accumulation (sync listener: in-memory only) ------------------
 
     def _on_event(self, e: ev.Event) -> None:
-        if e.type_ == ev.EVENT_TX and isinstance(e.data, dict):
-            self.tx_indexer.index_tx(
-                e.data["height"], e.data["index"], e.data["tx"], e.data["result"]
-            )
-        elif e.type_ == ev.EVENT_NEW_BLOCK and isinstance(e.data, dict):
+        bundle = None
+        if e.type_ == ev.EVENT_NEW_BLOCK and isinstance(e.data, dict):
             blk = e.data["block"]
-            self.block_indexer.index_block(
-                blk.height, e.data.get("result_events") or []
+            with self._plock:
+                p = self._pending.setdefault(
+                    blk.height, {"txs": [], "events": [], "expected": None}
+                )
+                p["events"] = list(e.data.get("result_events") or [])
+                p["expected"] = len(blk.data.txs)
+                bundle = self._maybe_seal_locked(blk.height)
+        elif e.type_ == ev.EVENT_TX and isinstance(e.data, dict):
+            d = e.data
+            with self._plock:
+                p = self._pending.setdefault(
+                    d["height"], {"txs": [], "events": [], "expected": None}
+                )
+                p["txs"].append((d["index"], d["tx"], d["result"]))
+                bundle = self._maybe_seal_locked(d["height"])
+        if bundle is not None:
+            self._seal(bundle)
+
+    def _maybe_seal_locked(self, height: int) -> Optional[HeightBundle]:
+        p = self._pending.get(height)
+        if p is None or p["expected"] is None:
+            return None
+        if len(p["txs"]) < p["expected"]:
+            return None
+        self._pending.pop(height, None)
+        # bound the accumulator: anything older than the sealed
+        # height can never complete (its NEW_BLOCK already passed)
+        for h in [h for h in self._pending if h < height]:
+            self._pending.pop(h, None)
+        return HeightBundle(
+            height, sorted(p["txs"], key=lambda t: t[0]), p["events"]
+        )
+
+    def _seal(self, bundle: HeightBundle) -> None:
+        self.sealed_heights += 1
+        if self._first_sealed is None:
+            self._first_sealed = bundle.height
+        loop = self._loop
+        if loop is not None and not loop.is_closed():
+            loop.call_soon_threadsafe(self._offer, bundle)
+            return
+        # no drain running (build-time commits, CLI tools, sync
+        # tests): flush inline — one batch per height, and there is
+        # no event loop in this mode to stall (the sanctioned reach
+        # behind start()'s ASY116 suppression)
+        self._flush(bundle)
+
+    def _offer(self, bundle: HeightBundle) -> None:
+        try:
+            self._queue.put_nowait(bundle)
+        except asyncio.QueueFull:
+            # overflow of last resort: never drop index rows — flush
+            # off-loop immediately (ordering is safe: flushes
+            # serialize on _flush_lock and the marker is monotonic)
+            self._queue.count_drop()
+            t = spawn(
+                self._overflow_flush(bundle),
+                name="indexer-overflow-flush",
             )
+            self._overflow_tasks.add(t)
+            t.add_done_callback(self._overflow_tasks.discard)
+
+    async def _overflow_flush(self, bundle: "HeightBundle") -> None:
+        try:
+            await asyncio.to_thread(self._flush, bundle)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # same accounting as _drain: a failed flush must land in
+            # the ledger or barrier() burns its full timeout on every
+            # index query for the rest of the process
+            self.flush_failures += 1
+            import traceback
+
+            traceback.print_exc()
+
+    # --- flushing -----------------------------------------------------
+
+    async def _drain(self) -> None:
+        while True:
+            bundle = await self._queue.get()
+            self._inflight += 1
+            try:
+                await asyncio.to_thread(self._flush, bundle)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # one transient DB failure (locked sqlite, disk
+                # hiccup) must not kill the drain for the rest of the
+                # process — the height stays unmarked, so a restart's
+                # replay() re-indexes it; counted so barrier() does
+                # not burn its timeout on a height that will not land
+                self.flush_failures += 1
+                import traceback
+
+                traceback.print_exc()
+            finally:
+                self._inflight -= 1
+
+    def _flush(self, bundle: HeightBundle, anchored: bool = False) -> None:
+        """ONE write_batch per height: every tx row, every block
+        event row and the idx:last marker, atomically."""
+        with self._flush_lock:
+            span = self.tracer.span(
+                "fanout.index.flush",
+                height=bundle.height,
+                n_txs=len(bundle.txs),
+            )
+            with span:
+                if self._kv_db is not None:
+                    sets: List[Tuple[bytes, bytes]] = []
+                    for i, tx, res in bundle.txs:
+                        sets.extend(
+                            self.tx_indexer.tx_sets(bundle.height, i, tx, res)
+                        )
+                    sets.extend(
+                        self.block_indexer.block_sets(
+                            bundle.height, bundle.block_events
+                        )
+                    )
+                    # marker advances CONTIGUOUSLY only: an
+                    # out-of-order flush (overflow path) parks its
+                    # height in _done_heights until the gap below it
+                    # lands — "every height <= marker is FULLY
+                    # indexed" must survive a crash with older
+                    # bundles still queued in memory. ``anchored``
+                    # (replay: ascending from a floor below which
+                    # nothing exists/is unindexed) may jump directly.
+                    prev = self.tx_indexer.last_indexed_height()
+                    if anchored:
+                        marker = max(prev, bundle.height)
+                    else:
+                        self._done_heights.add(bundle.height)
+                        marker = prev
+                        # anchor at the first live-sealed height:
+                        # anything below it can only arrive via
+                        # replay()'s anchored walk, never through
+                        # this path — a joiner whose history is
+                        # pruned must not wait on it (same rule as
+                        # reindex-event's below-base jump)
+                        first = self._first_sealed
+                        if first is not None and first - 1 > marker:
+                            marker = first - 1
+                        while marker + 1 in self._done_heights:
+                            marker += 1
+                            self._done_heights.discard(marker)
+                    if marker > prev:
+                        sets.append(
+                            (LAST_INDEXED_KEY, _enc_height(marker))
+                        )
+                    self._done_heights -= {
+                        h for h in self._done_heights if h <= marker
+                    }
+                    self._kv_db.write_batch(sets)
+                else:
+                    # sink indexers (psql): per-item API, but off the
+                    # publish path now
+                    for i, tx, res in bundle.txs:
+                        self.tx_indexer.index_tx(bundle.height, i, tx, res)
+                    self.block_indexer.index_block(
+                        bundle.height, bundle.block_events
+                    )
+            self.flushed_heights += 1
+
+    async def barrier(self, timeout_s: float = 5.0) -> None:
+        """Wait (bounded) until every height sealed so far has
+        flushed: read-your-writes for index queries racing a commit.
+        Counter-based (sealed vs flushed), so the window between a
+        seal and its bundle landing on the queue can't slip through."""
+        if self._loop is None:
+            return  # inline mode is always consistent
+        target = self.sealed_heights
+        deadline = asyncio.get_running_loop().time() + timeout_s
+        while (
+            self.flushed_heights + self.flush_failures < target
+            and asyncio.get_running_loop().time() < deadline
+        ):
+            await asyncio.sleep(0.005)
+
+    # --- crash replay -------------------------------------------------
+
+    def replay(self, block_store, state_store) -> int:
+        """Re-index every height past the idx:last marker from the
+        stored blocks + finalize responses (which persist tx AND
+        block events since ISSUE 15, state/execution.py). Idempotent:
+        deterministic keys mean a partially-written height (crash
+        between rows... impossible — batch is atomic — but also a
+        marker behind a re-run) just overwrites identical rows."""
+        if self._kv_db is None:
+            return 0
+        from .execution import decode_finalize_response
+
+        last = self.tx_indexer.last_indexed_height()
+        top = block_store.height()
+        n = 0
+        for h in range(max(last + 1, block_store.base()), top + 1):
+            blk = block_store.load_block(h)
+            raw = state_store.load_finalize_block_response(h)
+            if blk is None or raw is None:
+                continue
+            resp = decode_finalize_response(raw)
+            txs = [
+                (i, tx, resp.tx_results[i])
+                for i, tx in enumerate(blk.data.txs)
+                if i < len(resp.tx_results)
+            ]
+            self.sealed_heights += 1  # keep the barrier's
+            # sealed-vs-flushed ledger balanced across replay.
+            # anchored: replay walks ascending from a floor below
+            # which every height is indexed or absent from the store,
+            # so the marker may jump straight to h (a pruned store's
+            # base > marker+1 would otherwise park it forever)
+            self._flush(HeightBundle(h, txs, resp.events), anchored=True)
+            n += 1
+        self.replayed_heights += n
+        return n
+
+    def queue_stats(self) -> dict:
+        """obs registry entry (state.index): the bounded drain's
+        backlog; ``dropped`` counts overflow-path flushes (work moved
+        off the queue, never lost)."""
+        s = self._queue.stats()
+        s["flushed_heights"] = self.flushed_heights
+        return s

@@ -102,6 +102,11 @@ class EventBus:
         with self._lock:
             self._sync_listeners.append(fn)
 
+    def remove_sync_listener(self, fn: Callable[[Event], None]) -> None:
+        with self._lock:
+            if fn in self._sync_listeners:
+                self._sync_listeners.remove(fn)
+
     def _remove(self, sub: Subscription):
         with self._lock:
             if sub in self._subs:

@@ -504,6 +504,43 @@ FIXTURES = [
         """,
     ),
     (
+        "ASY116",  # sync-listener-blocking-call (interprocedural):
+        # the pre-ISSUE-15 indexer shape — a bus sync listener whose
+        # chain ends in a DB batch write runs INSIDE every publish,
+        # so the consensus finalize path pays the disk write
+        """
+        class Indexer:
+            def __init__(self, db):
+                self.db = db
+            def index(self, e):
+                self.db.write_batch([(b"k", b"v")])
+        class Service:
+            def __init__(self, bus, idx: Indexer):
+                self.idx = idx
+                bus.add_sync_listener(idx.index)
+        """,
+        """
+        import asyncio
+        class Indexer:
+            def __init__(self, db):
+                self.db = db
+            def flush(self, bundle):
+                self.db.write_batch(bundle)
+        class Service:
+            def __init__(self, bus, idx: Indexer):
+                self.idx = idx
+                self.pending = []
+                bus.add_sync_listener(self.on_event)
+            def on_event(self, e):
+                # accumulate-only: the listener never touches the DB
+                self.pending.append(e)
+            async def drain(self):
+                # the flush is OFFLOADED — a function reference is an
+                # argument, not a call: no edge, no finding
+                await asyncio.to_thread(self.idx.flush, self.pending)
+        """,
+    ),
+    (
         "SYN000",  # syntax errors are findings, not crashes
         """
         def f(:
@@ -553,6 +590,41 @@ def test_asy107_scoped_to_trace_package():
     """
     assert "ASY107" not in ids_of(src)  # outside the plane: fine
     assert "ASY107" in ids_of(src, "cometbft_tpu/trace/export.py")
+
+
+def test_asy116_sanctioned_registration():
+    """A justified suppression at the registration line is the
+    escape hatch (state/indexer.py start(): the only blocking reach
+    is the no-loop inline degrade)."""
+    src = textwrap.dedent(
+        """
+        class Indexer:
+            def __init__(self, db):
+                self.db = db
+            def index(self, e):
+                self.db.write_batch([(b"k", b"v")])
+        class Service:
+            def __init__(self, bus, idx: Indexer):
+                self.idx = idx
+                bus.add_sync_listener(idx.index)  # bftlint: disable=ASY116
+        """
+    )
+    assert "ASY116" not in ids_of(src)
+
+
+def test_asy116_repo_indexer_shape_stays_clean():
+    """The shipped IndexerService accumulates in memory — the one
+    suppression in state/indexer.py must remain the ONLY one needed
+    (the whole-repo gate below enforces zero new findings, this
+    pins the specific rule)."""
+    from cometbft_tpu.analysis.engine import REPO_ROOT, run
+
+    findings = [
+        f
+        for f in run([str(REPO_ROOT / "cometbft_tpu" / "state")])
+        if f.rule_id == "ASY116"
+    ]
+    assert findings == [], findings
 
 
 def test_at_least_eight_distinct_rules_have_fixtures():

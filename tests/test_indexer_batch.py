@@ -1,0 +1,427 @@
+"""Per-height batched indexing + crash-consistent replay
+(state/indexer.py, ISSUE 15)."""
+
+import asyncio
+import time
+
+from cometbft_tpu import types as T
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.state.execution import encode_finalize_response
+from cometbft_tpu.state.indexer import (
+    LAST_INDEXED_KEY,
+    BlockIndexer,
+    IndexerService,
+    TxIndexer,
+)
+from cometbft_tpu.state.store import Store as StateStore
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types import events as ev
+from cometbft_tpu.utils import codec, kv
+from cometbft_tpu.utils.pubsub_query import parse as parse_query
+
+NOW = int(time.time() * 1e9)
+CHAIN = "idx-chain"
+
+
+class CountingKV(kv.MemKV):
+    def __init__(self):
+        super().__init__()
+        self.batches = 0
+
+    def write_batch(self, sets, deletes=()):
+        self.batches += 1
+        super().write_batch(sets, deletes)
+
+
+def _tx_result(i):
+    return abci.ExecTxResult(
+        code=0,
+        events=[
+            abci.Event(
+                "transfer",
+                [abci.EventAttribute("sender", f"addr{i}", True)],
+            )
+        ],
+    )
+
+
+def _make_block(vs, height, prev_bid, txs):
+    data = T.Data(txs=txs)
+    last_commit = (
+        T.Commit(height - 1, 0, prev_bid, []) if height > 1 else None
+    )
+    header = T.Header(
+        chain_id=CHAIN,
+        height=height,
+        time_ns=NOW + height,
+        last_block_id=prev_bid,
+        validators_hash=vs.hash(),
+        next_validators_hash=vs.hash(),
+        app_hash=b"\x01" * 32,
+        proposer_address=vs.validators[0].address,
+        data_hash=data.hash(),
+        last_commit_hash=last_commit.hash() if last_commit else b"",
+    )
+    return T.Block(header=header, data=data, last_commit=last_commit)
+
+
+def _publish_height(bus, blk, block_events):
+    """The exact _fire_events shape (state/execution.py)."""
+    bus.publish_type(
+        ev.EVENT_NEW_BLOCK,
+        {"block": blk, "block_id": None, "result_events": block_events},
+        height=blk.height,
+    )
+    import hashlib
+
+    for i, tx in enumerate(blk.data.txs):
+        bus.publish_type(
+            ev.EVENT_TX,
+            {
+                "height": blk.height,
+                "index": i,
+                "tx": tx,
+                "result": _tx_result(i),
+            },
+            hash=hashlib.sha256(tx).hexdigest(),
+        )
+
+
+def _blocks(n, txs_per_height=2):
+    vs, _ = T.random_validator_set(1)
+    prev = T.BlockID()
+    out = []
+    for h in range(1, n + 1):
+        txs = [b"k%d_%d=v" % (h, i) for i in range(txs_per_height)]
+        blk = _make_block(vs, h, prev, txs)
+        prev = T.BlockID(
+            blk.hash(), T.PartSet.from_data(codec.encode_block(blk)).header
+        )
+        out.append(blk)
+    return out
+
+
+def _service(db=None):
+    db = db if db is not None else CountingKV()
+    svc = IndexerService(TxIndexer(db), BlockIndexer(db), ev.EventBus())
+    return db, svc
+
+
+BLOCK_EVENTS = [
+    abci.Event("commit_meta", [abci.EventAttribute("lane", "a", True)])
+]
+
+
+def test_one_write_batch_per_height_inline():
+    """No drain running (sync embedders): sealing a height flushes
+    ONE atomic batch carrying every row AND the marker."""
+    db, svc = _service()
+    svc.start()
+    blks = _blocks(3)
+    for blk in blks:
+        _publish_height(svc.bus, blk, BLOCK_EVENTS)
+    assert db.batches == 3  # one per height, never per tx
+    assert svc.tx_indexer.last_indexed_height() == 3
+    # every tx row + attribute row queryable
+    for h in (1, 2, 3):
+        hits = svc.tx_indexer.search(parse_query(f"tx.height={h}"))
+        assert len(hits) == 2
+    hits = svc.tx_indexer.search(parse_query("transfer.sender='addr1'"))
+    assert len(hits) == 3  # one per height (tx index 1)
+    assert svc.block_indexer.search(
+        parse_query("commit_meta.lane='a'")
+    ) == [1, 2, 3]
+
+
+def test_async_drain_flush_and_barrier():
+    """With the drain running, publishes do ZERO db work inline; the
+    barrier gives read-your-writes."""
+
+    async def main():
+        db, svc = _service()
+        svc.start()
+        svc.bus.set_loop(asyncio.get_running_loop())
+        await svc.start_async()
+        blks = _blocks(4)
+        for blk in blks:
+            _publish_height(svc.bus, blk, BLOCK_EVENTS)
+        # publish path touched NO db (seal handed to the drain)
+        assert db.batches <= 4
+        await svc.barrier()
+        assert db.batches == 4
+        assert svc.tx_indexer.last_indexed_height() == 4
+        assert svc.flushed_heights == 4
+        await svc.stop()
+
+    asyncio.run(main())
+
+
+def test_zero_tx_height_seals_immediately():
+    db, svc = _service()
+    svc.start()
+    blk = _blocks(1, txs_per_height=0)[0]
+    _publish_height(svc.bus, blk, BLOCK_EVENTS)
+    assert db.batches == 1
+    assert svc.tx_indexer.last_indexed_height() == 1
+
+
+def _stores_with_chain(n_heights):
+    """A block store + state store holding n committed heights with
+    stored finalize responses (tx + block events persisted — the
+    replay source)."""
+    bdb, sdb = kv.MemKV(), kv.MemKV()
+    bs, ss = BlockStore(bdb), StateStore(sdb)
+    blks = _blocks(n_heights)
+    for blk in blks:
+        pset = T.PartSet.from_data(codec.encode_block(blk))
+        bs.save_block(
+            blk, pset, T.Commit(blk.height, 0, T.BlockID(blk.hash(), pset.header), [])
+        )
+        resp = abci.ResponseFinalizeBlock(
+            events=BLOCK_EVENTS,
+            tx_results=[
+                _tx_result(i) for i in range(len(blk.data.txs))
+            ],
+            app_hash=b"\x01" * 32,
+        )
+        ss.save_finalize_block_response(
+            blk.height, encode_finalize_response(resp)
+        )
+    return bs, ss, blks
+
+
+def test_kill_mid_index_restart_replays_no_gap_no_dup():
+    """Crash contract: the idx:last marker rides the same atomic
+    batch as its height's rows, so a kill between heights leaves
+    marker == last fully indexed height; a restarted service replays
+    forward from the marker and the result has NO gap and NO
+    duplicate attribute rows — and replaying again changes nothing
+    (idempotent)."""
+    bs, ss, blks = _stores_with_chain(5)
+    db, svc = _service()
+    svc.start()
+    # live-index heights 1..3, then "kill" (drop the service; height
+    # 4-5 events never processed — the mid-index crash)
+    for blk in blks[:3]:
+        _publish_height(svc.bus, blk, BLOCK_EVENTS)
+    assert svc.tx_indexer.last_indexed_height() == 3
+    snapshot_after_crash = dict(db._d)
+
+    # restart: a FRESH service over the same db replays from marker
+    db2, svc2 = _service(db)
+    assert svc2.replay(bs, ss) == 2  # heights 4..5 only
+    assert svc2.tx_indexer.last_indexed_height() == 5
+    # NO GAP: every height's txs and attributes are queryable
+    for h in range(1, 6):
+        hits = svc2.tx_indexer.search(parse_query(f"tx.height={h}"))
+        assert len(hits) == 2, h
+    assert svc2.block_indexer.search(
+        parse_query("commit_meta.lane='a'")
+    ) == [1, 2, 3, 4, 5]
+    # NO DUP: exact attribute-row census — 2 tx.height rows + 2
+    # transfer.sender rows per height, 5 heights
+    tx_attr_rows = [
+        k for k, _ in db.iter_prefix(b"tx:a:tx.height=")
+    ]
+    assert len(tx_attr_rows) == len(set(tx_attr_rows)) == 10
+    sender_rows = [
+        k for k, _ in db.iter_prefix(b"tx:a:transfer.sender=")
+    ]
+    assert len(sender_rows) == len(set(sender_rows)) == 10
+    # the crash-surviving prefix was not rewritten differently
+    for k, v in snapshot_after_crash.items():
+        if k != LAST_INDEXED_KEY:
+            assert db._d[k] == v, k
+    # IDEMPOTENT: a second replay is a no-op on content
+    full = dict(db._d)
+    assert svc2.replay(bs, ss) == 0  # marker says all done
+    assert dict(db._d) == full
+    # and even a forced re-run over indexed heights rewrites
+    # byte-identical rows (marker rolled back by hand)
+    db.set(LAST_INDEXED_KEY, b"\x00" * 8)
+    assert svc2.replay(bs, ss) == 5
+    assert dict(db._d) == full
+
+
+def test_marker_advances_contiguously_out_of_order():
+    """The overflow path can flush a NEWER height while older ones
+    still sit in the in-memory queue: the idx:last marker must lag
+    until the gap closes, or a crash would skip the queued heights
+    on replay (the 'every height <= marker is fully indexed'
+    contract). Replay's ascending walk is anchored and may jump."""
+    from cometbft_tpu.state.indexer import HeightBundle
+
+    db, svc = _service()
+    blks = _blocks(4)
+    bundles = [
+        HeightBundle(
+            b.height,
+            [(i, tx, _tx_result(i)) for i, tx in enumerate(b.data.txs)],
+            BLOCK_EVENTS,
+        )
+        for b in blks
+    ]
+    svc._flush(bundles[0])  # h=1
+    assert svc.tx_indexer.last_indexed_height() == 1
+    svc._flush(bundles[3])  # h=4 out of order: marker must NOT jump
+    assert svc.tx_indexer.last_indexed_height() == 1
+    svc._flush(bundles[2])  # h=3: still gapped below
+    assert svc.tx_indexer.last_indexed_height() == 1
+    svc._flush(bundles[1])  # h=2 closes the gap -> marker catches up
+    assert svc.tx_indexer.last_indexed_height() == 4
+    assert svc._done_heights == set()
+    # anchored (replay) flush over a pruned-style gap may jump
+    db2, svc2 = _service()
+    svc2._flush(bundles[3], anchored=True)
+    assert svc2.tx_indexer.last_indexed_height() == 4
+
+
+def test_joiner_far_above_marker_still_advances():
+    """A statesync-restored joiner live-indexes from snapshot+1 with
+    idx:last still 0 and the gap below pruned: the first live-sealed
+    height anchors the contiguity floor, so the marker advances
+    (heights below it can only ever arrive via replay()'s anchored
+    walk) instead of parking every height in _done_heights forever."""
+    from cometbft_tpu.state.indexer import HeightBundle
+
+    db, svc = _service()
+    for h in (50, 51, 52):
+        svc._seal(
+            HeightBundle(h, [(0, b"j%d=v" % h, _tx_result(0))], BLOCK_EVENTS)
+        )
+    assert svc.tx_indexer.last_indexed_height() == 52
+    assert svc._done_heights == set()
+    # the floor never claims a height another LIVE seal could still
+    # deliver: once 50 sealed first, nothing below 50 can seal
+    assert svc._first_sealed == 50
+
+
+def test_overflow_never_drops(monkeypatch):
+    """A full drain queue flushes off-loop instead of shedding: index
+    rows are never lost to backpressure (counted as overflow)."""
+
+    async def main():
+        db, svc = _service()
+        monkeypatch.setattr(IndexerService, "QUEUE_SIZE", 2)
+        svc._queue = type(svc._queue)(2, name="state.index")
+        svc.start()
+        svc.bus.set_loop(asyncio.get_running_loop())
+        svc._loop = asyncio.get_running_loop()  # drain NOT running:
+        # bundles pile into the tiny queue, overflow path kicks in
+        blks = _blocks(6)
+        for blk in blks:
+            _publish_height(svc.bus, blk, BLOCK_EVENTS)
+        # let the overflow to_thread flushes land, then start the
+        # drain for the queued remainder
+        await asyncio.sleep(0.3)
+        await svc.start_async()
+        await svc.barrier()
+        deadline = asyncio.get_running_loop().time() + 5
+        while svc.tx_indexer.last_indexed_height() < 6:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        for h in range(1, 7):
+            assert len(
+                svc.tx_indexer.search(parse_query(f"tx.height={h}"))
+            ) == 2
+        assert svc._queue.dropped >= 1  # overflow was exercised
+        await svc.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 30))
+
+
+def test_overflow_flush_failure_counted(monkeypatch):
+    """A failed OVERFLOW-path flush lands in the sealed-vs-flushed
+    ledger exactly like a failed drain flush — otherwise barrier()
+    burns its full timeout on every index query for the rest of the
+    process (the height can only land via restart replay)."""
+
+    async def main():
+        db, svc = _service()
+        svc._queue = type(svc._queue)(1, name="state.index")
+        svc.start()
+        svc.bus.set_loop(asyncio.get_running_loop())
+        svc._loop = asyncio.get_running_loop()  # drain NOT running
+
+        def boom(bundle, anchored=False):
+            raise RuntimeError("disk hiccup (injected)")
+
+        monkeypatch.setattr(svc, "_flush", boom)
+        for blk in _blocks(3):
+            _publish_height(svc.bus, blk, BLOCK_EVENTS)
+        # 1 bundle queued, 2 overflowed into failing off-loop flushes
+        deadline = asyncio.get_running_loop().time() + 5
+        while svc.flush_failures < 2:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        # drain the queued one (also fails) — ledger fully balanced,
+        # so barrier() returns promptly instead of timing out
+        await svc.start_async()
+        t0 = asyncio.get_running_loop().time()
+        await svc.barrier(timeout_s=5.0)
+        assert asyncio.get_running_loop().time() - t0 < 4.0
+        assert svc.flush_failures == 3
+        await svc.stop()
+
+    asyncio.run(main())
+
+
+def test_reindex_event_marker_stays_contiguous(tmp_path):
+    """cmd reindex-event with --start-height above idx:last+1 writes
+    the rows but must NOT advance the crash marker over the gap —
+    IndexerService.replay() walks from marker+1 and would skip the
+    never-indexed heights forever. A pruned gap (below the store
+    base) may still be jumped, mirroring replay's anchored walk."""
+    from types import SimpleNamespace
+
+    from cometbft_tpu.cmd.main import cmd_reindex_event
+
+    data = tmp_path / "data"
+    data.mkdir(parents=True)
+    bdb = kv.open_kv("sqlite", str(data / "blockstore.db"))
+    sdb = kv.open_kv("sqlite", str(data / "state.db"))
+    bs, ss = BlockStore(bdb), StateStore(sdb)
+    for blk in _blocks(6):
+        pset = T.PartSet.from_data(codec.encode_block(blk))
+        bs.save_block(
+            blk,
+            pset,
+            T.Commit(
+                blk.height, 0, T.BlockID(blk.hash(), pset.header), []
+            ),
+        )
+        resp = abci.ResponseFinalizeBlock(
+            events=BLOCK_EVENTS,
+            tx_results=[_tx_result(i) for i in range(len(blk.data.txs))],
+            app_hash=b"\x01" * 32,
+        )
+        ss.save_finalize_block_response(
+            blk.height, encode_finalize_response(resp)
+        )
+    bdb.close()
+    sdb.close()
+
+    # partial reindex above the (zero) marker: rows land, marker
+    # must stay put — heights 1..4 were never indexed
+    args = SimpleNamespace(
+        home=str(tmp_path), start_height=5, end_height=6
+    )
+    assert cmd_reindex_event(args) == 0
+    idb = kv.open_kv("sqlite", str(data / "tx_index.db"))
+    txi = TxIndexer(idb)
+    assert txi.last_indexed_height() == 0
+    assert len(txi.search(parse_query("tx.height=5"))) == 2
+    idb.close()
+
+    # a full run from the store base closes the gap and the marker
+    # advances to the end
+    args = SimpleNamespace(
+        home=str(tmp_path), start_height=None, end_height=None
+    )
+    assert cmd_reindex_event(args) == 0
+    idb = kv.open_kv("sqlite", str(data / "tx_index.db"))
+    txi = TxIndexer(idb)
+    assert txi.last_indexed_height() == 6
+    for h in range(1, 7):
+        assert len(txi.search(parse_query(f"tx.height={h}"))) == 2
+    idb.close()

@@ -1,0 +1,351 @@
+"""Outbound fan-out plane (rpc/fanout.py, ISSUE 15): one-pass
+delivery, per-subscriber shed isolation, height-keyed commit waiters,
+and the live websocket paths over a single-node chain."""
+
+import asyncio
+import hashlib
+import json
+
+import pytest
+
+from cometbft_tpu.config.config import test_config as make_test_cfg
+from cometbft_tpu.node.inprocess import make_genesis
+from cometbft_tpu.node.node import Node
+from cometbft_tpu.rpc.client import HTTPClient
+from cometbft_tpu.rpc.fanout import CommitWaiterMap, FanoutHub
+from cometbft_tpu.types import events as ev
+from cometbft_tpu.utils.pubsub_query import parse as parse_query
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+class StubWS:
+    """Socket stand-in: infinite-speed sink recording frames."""
+
+    def __init__(self):
+        self.frames = []
+
+    async def send_str(self, s):
+        self.frames.append(s)
+
+
+class StuckWS(StubWS):
+    """A subscriber whose socket never completes a send."""
+
+    async def send_str(self, s):
+        self.frames.append(s)
+        await asyncio.Event().wait()
+
+
+def _bus():
+    bus = ev.EventBus()
+    bus.set_loop(asyncio.get_running_loop())
+    return bus
+
+
+def _attach(hub, ws, qs, sub_id):
+    return hub.attach(ws, qs, parse_query(qs), sub_id)
+
+
+# --- one-serialization-pass delivery ----------------------------------
+
+
+def test_one_encode_per_group():
+    """N subscribers over G query shapes: each event pays exactly one
+    JSON serialization per MATCHING group, never per subscriber."""
+
+    async def main():
+        bus = _bus()
+        hub = FanoutHub(bus)
+        q_round = "tm.event='NewRound'"
+        q_step = "tm.event='NewRoundStep'"
+        subs_round = [
+            _attach(hub, StubWS(), q_round, i) for i in range(40)
+        ]
+        subs_step = [
+            _attach(hub, StubWS(), q_step, 100 + i) for i in range(10)
+        ]
+        for h in range(3):
+            bus.publish_type(ev.EVENT_NEW_ROUND, h, height=h)
+        bus.publish_type(ev.EVENT_NEW_ROUND_STEP, 9, height=9)
+        await asyncio.sleep(0.2)
+        # 3 NewRound events x 1 matching group + 1 step event x 1
+        assert hub.encodes == 4, hub.encodes
+        for s in subs_round:
+            assert len(s.ws.frames) == 3
+        for s in subs_step:
+            assert len(s.ws.frames) == 1
+        # frames carry the right envelope per subscriber, shared body
+        b0 = json.loads(subs_round[0].ws.frames[0])
+        b7 = json.loads(subs_round[7].ws.frames[0])
+        assert b0["id"] == 0 and b7["id"] == 7
+        assert b0["result"] == b7["result"]
+        assert b0["result"]["query"] == q_round
+        assert b0["result"]["events"]["tm.event"] == ["NewRound"]
+        assert hub.queue_stats()["dropped"] == 0
+        await hub.close()
+
+    run(main())
+
+
+def test_slow_subscriber_shed_isolation():
+    """A stalled socket sheds ITS frames (counted) while every other
+    subscriber keeps receiving everything."""
+
+    async def main():
+        bus = _bus()
+        hub = FanoutHub(bus)
+        qs = "tm.event='NewRound'"
+        healthy = _attach(hub, StubWS(), qs, 1)
+        stuck = _attach(hub, StuckWS(), qs, 2)
+        # shrink the stalled subscriber's bound so the overflow is
+        # cheap to provoke
+        stuck.queue._maxsize = 4
+        n_events = 12
+        for h in range(n_events):
+            bus.publish_type(ev.EVENT_NEW_ROUND, h, height=h)
+        await asyncio.sleep(0.3)
+        assert len(healthy.ws.frames) == n_events
+        # stuck: exactly one frame in-flight forever, a full queue
+        # behind it, and every further frame shed AND counted —
+        # conservation: delivered + queued + dropped == published
+        assert len(stuck.ws.frames) == 1
+        assert stuck.queue.dropped >= 1
+        assert (
+            len(stuck.ws.frames)
+            + stuck.queue.qsize()
+            + stuck.queue.dropped
+            == n_events
+        )
+        stats = hub.queue_stats()
+        assert stats["dropped"] == stuck.queue.dropped
+        assert hub.encodes == n_events  # one per event, not per sub
+        await hub.close()
+
+    run(main())
+
+
+def test_detach_awaits_writer_task():
+    """detach() must reap the writer: no mid-send task survives the
+    subscription (the old fire-and-forget cancel leaked them into
+    loop teardown)."""
+
+    async def main():
+        bus = _bus()
+        hub = FanoutHub(bus)
+        sub = _attach(hub, StuckWS(), "tm.event='NewRound'", 1)
+        bus.publish_type(ev.EVENT_NEW_ROUND, 1, height=1)
+        await asyncio.sleep(0.1)
+        task = sub.task
+        assert not task.done()  # parked in the stuck send
+        await hub.detach(sub)
+        assert task.done()
+        assert hub.queue_stats()["subscribers"] == 0
+        # empty hub tore its bus subscription down too
+        assert hub._drain_task is None and hub._sub is None
+
+    run(main())
+
+
+# --- height-keyed commit waiters --------------------------------------
+
+
+def test_commit_waiters_resolve_and_one_subscription():
+    async def main():
+        bus = _bus()
+        cw = CommitWaiterMap(bus)
+        keys = [hashlib.sha256(bytes([i])).hexdigest() for i in range(8)]
+        futs = [cw.register(k) for k in keys]
+        # publish cost stays O(1): ZERO subscriptions regardless of
+        # in-flight waiter count (the old shape added one per RPC) —
+        # the map rides one lossless sync listener instead
+        assert len(bus._subs) == 0
+        assert len(bus._sync_listeners) == 1
+        for i, k in enumerate(keys):
+            bus.publish_type(
+                ev.EVENT_TX,
+                {"height": 5, "index": i, "tx": bytes([i]), "result": None},
+                hash=k,
+            )
+        got = await asyncio.wait_for(asyncio.gather(*futs), 5)
+        assert [e.data["index"] for e in got] == list(range(8))
+        assert cw.size() == 0 and cw.resolved == 8
+        await cw.close()
+
+    run(main())
+
+
+def test_commit_waiter_survives_publish_burst():
+    """A Tx publish burst larger than any bounded subscription queue
+    must not lose the event a waiter needs: the sync-listener shape
+    is lossless (a bounded-subscription drain shed NEW events at
+    SUBSCRIPTION_QUEUE_SIZE, turning a committed tx into a false
+    broadcast_tx_commit timeout)."""
+
+    async def main():
+        bus = _bus()
+        cw = CommitWaiterMap(bus)
+        key = hashlib.sha256(b"the-one").hexdigest()
+        fut = cw.register(key)
+        # burst past any bounded queue, then the waiter's event LAST
+        # (the position a subscription queue would have shed)
+        for i in range(ev.SUBSCRIPTION_QUEUE_SIZE + 8):
+            bus.publish_type(
+                ev.EVENT_TX,
+                {"height": 1, "index": i, "tx": b"x", "result": None},
+                hash=f"{i:064x}",
+            )
+        bus.publish_type(
+            ev.EVENT_TX,
+            {"height": 1, "index": 9999, "tx": b"the-one", "result": None},
+            hash=key,
+        )
+        e = await asyncio.wait_for(fut, 5)
+        assert e.data["index"] == 9999 and cw.resolved == 1
+        await cw.close()
+        assert len(bus._sync_listeners) == 0  # close detached it
+
+    run(main())
+
+
+def test_commit_waiter_timeout_unsubscribe_race():
+    """A waiter that timed out and unregistered must not leak an
+    entry, and a late event for its hash must not error; two waiters
+    on the SAME hash both resolve."""
+
+    async def main():
+        bus = _bus()
+        cw = CommitWaiterMap(bus)
+        key = "ab" * 32
+        fut = cw.register(key)
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(fut, 0.05)
+        cw.unregister(key, fut)
+        assert cw.size() == 0
+        # the late event finds no waiter: dropped silently
+        bus.publish_type(
+            ev.EVENT_TX,
+            {"height": 1, "index": 0, "tx": b"x", "result": None},
+            hash=key,
+        )
+        await asyncio.sleep(0.05)
+        assert cw.resolved == 0
+        # duplicate tx hash: BOTH RPCs resolve from one event
+        f1, f2 = cw.register(key), cw.register(key)
+        bus.publish_type(
+            ev.EVENT_TX,
+            {"height": 2, "index": 0, "tx": b"x", "result": None},
+            hash=key,
+        )
+        e1, e2 = await asyncio.wait_for(asyncio.gather(f1, f2), 5)
+        assert e1.data["height"] == e2.data["height"] == 2
+        await cw.close()
+
+    run(main())
+
+
+# --- live single-node paths -------------------------------------------
+
+
+async def _single_node():
+    gen, pvs = make_genesis(1, chain_id="fanout-chain")
+    cfg = make_test_cfg(".")
+    node = Node(cfg, gen, privval=pvs[0])
+    await node.start()
+    while node.height < 2:
+        await asyncio.sleep(0.05)
+    return node, HTTPClient(node.rpc_server.listen_addr)
+
+
+def test_ws_subscription_through_hub_and_unsubscribe_all():
+    """End-to-end over a real websocket: events flow through the hub
+    (one encode per group), unsubscribe_all leaves no member and no
+    writer task, and the registry entry reports the plane."""
+
+    async def main():
+        node, cli = await _single_node()
+        sess = await cli._sess()
+        ws = await sess.ws_connect(cli.base_url + "/websocket")
+        q = "tm.event='NewBlock'"
+        await ws.send_json(
+            {"jsonrpc": "2.0", "id": 7, "method": "subscribe",
+             "params": {"query": q}}
+        )
+        first = json.loads((await ws.receive()).data)
+        assert "error" not in first
+        hub = node.rpc_server.fanout
+        assert hub.queue_stats()["subscribers"] == 1
+        heights = []
+        while len(heights) < 2:
+            body = json.loads((await ws.receive()).data)
+            res = body.get("result") or {}
+            if res.get("query") == q:
+                assert body["id"] == 7
+                heights.append(
+                    int(
+                        res["data"]["value"]["block"]["header"]["height"]
+                    )
+                )
+        assert heights[1] == heights[0] + 1
+        # health surfaces the plane through the queue registry
+        stats = node.queues.get("rpc.fanout")
+        assert stats is not None and stats["enqueued"] >= 2
+        assert stats["dropped"] == 0
+        await ws.send_json(
+            {"jsonrpc": "2.0", "id": 8, "method": "unsubscribe_all",
+             "params": {}}
+        )
+        deadline = asyncio.get_running_loop().time() + 5
+        while hub.queue_stats()["subscribers"]:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        await ws.close()
+        await cli.close()
+        await node.stop()
+
+    run(main())
+
+
+def test_concurrent_broadcast_tx_commit_single_subscription():
+    """K concurrent commit RPCs ride ONE waiter subscription (plus
+    the hub's), and all commit."""
+
+    async def main():
+        node, cli = await _single_node()
+        bus = node.parts.event_bus
+        before = len(bus._subs)
+        txs = [b"fk%d=fv%d" % (i, i) for i in range(5)]
+        results = await asyncio.gather(
+            *[cli.broadcast_tx_commit(t) for t in txs]
+        )
+        for r in results:
+            assert r["tx_result"]["code"] == 0
+            assert int(r["height"]) >= 1
+        # the waiter map added AT MOST one subscription, total —
+        # independent of the 5 concurrent RPCs
+        assert len(bus._subs) <= before + 1
+        assert node.rpc_env.commit_waiters().size() == 0
+        await cli.close()
+        await node.stop()
+
+    run(main())
+
+    # second run(): the asyncio.run teardown above is the regression
+    # surface for leaked fanout/waiter tasks — a leaked task warns on
+    # a closed loop; reaching here clean is the assertion
+
+
+def test_indexer_queue_registered():
+    async def main():
+        node, cli = await _single_node()
+        # commit one tx so a height flushed through the drain
+        await cli.broadcast_tx_commit(b"iq=1")
+        stats = node.queues.get("state.index")
+        assert stats is not None
+        assert stats["flushed_heights"] >= 1
+        await cli.close()
+        await node.stop()
+
+    run(main())

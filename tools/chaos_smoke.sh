@@ -119,6 +119,17 @@ JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" --light-storm 200 
     --trace-dump "$TRACE_DIR/light_storm" --budget
 python -m cometbft_tpu.trace timeline "$TRACE_DIR/light_storm" --strict
 
+echo "== chaos smoke: 150-subscriber websocket storm against a live node's fan-out plane =="
+# the outbound fan-out plane (ISSUE 15, docs/PERF.md): after the fault
+# schedule settles, 150 real websocket subscribers storm the most
+# advanced node — every subscriber must receive consecutive NewBlock
+# events store-verified against the node, ZERO frames shed, and the
+# hub must pay ~one JSON serialization per event (not per subscriber);
+# fanout.deliver + fanout.index.flush spans budget-gated (exit 2)
+JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" \
+    --subscriber-storm 150 --trace-dump "$TRACE_DIR/sub_storm" --budget
+python -m cometbft_tpu.trace timeline "$TRACE_DIR/sub_storm" --strict
+
 echo "== chaos smoke: un-pinned partition x statesync_join x churn + reconnect span budget =="
 # the compound the matrix previously pinned out (ISSUE 12): a
 # partitioned net churns its valset, heals, and a fresh node joins by
