@@ -70,6 +70,7 @@ _DEFAULT_BUDGETS_S = {
     "live": 1500.0,
     "serve": 1200.0,
     "rpcfanout": 1200.0,
+    "scaling": 300.0,
 }
 
 
@@ -2040,6 +2041,41 @@ def bench_rpcfanout() -> dict:
     }
 
 
+def bench_scaling() -> dict:
+    """Committee-scaling probe (docs/LINT.md "Complexity rules"): the
+    runtime half of the static complexity pass. Drives the hot-path
+    sites ASY117/118 flagged (and this tree fixed) — vote_add,
+    commit_assembly, gossip_pick, fanout_publish — at committee sizes
+    {4, 16, 64, 128} in-process, fits the log-log wall exponent per
+    site, and gates each against tools/scaling_budgets.toml
+    (fixed-site target: slope <= 1.2 at 4->128). Host-only and
+    seconds-cheap; exponents (not absolute walls) so the gate
+    survives box changes."""
+    from cometbft_tpu.analysis import scaling
+
+    budgets = scaling.load_exponent_budgets()
+    results = scaling.run_probe(
+        budgets=budgets,
+        min_wall_s=float(os.environ.get("BENCH_SCALING_WALL_S", "0.02")),
+        repeats=int(os.environ.get("BENCH_SCALING_REPEATS", "5")),
+    )
+    print(scaling.format_results(results))
+    breaches = [r.site for r in results if not r.ok and not r.injected]
+    return {
+        "sizes": list(scaling.SIZES),
+        "sites": {r.site: r.as_dict() for r in results},
+        "exponents": {r.site: round(r.exponent, 3) for r in results},
+        "breaches": breaches,
+        "ok": not breaches,
+        "note": (
+            "log-log wall slope per flagged hot-path site; budget "
+            "per tools/scaling_budgets.toml (default "
+            f"{scaling.DEFAULT_EXPONENT_BUDGET}); a breach means a "
+            "fixed super-linear site regressed"
+        ),
+    }
+
+
 def bench_commit150(gen, parts) -> dict:
     import cometbft_tpu.types as T
 
@@ -2523,6 +2559,7 @@ def main() -> None:
             "live",
             "serve",
             "rpcfanout",
+            "scaling",
         }
         if which == "all"
         else set(which.split(","))
@@ -2662,6 +2699,11 @@ def main() -> None:
         # subscribers, one-encode-per-group vs per-subscriber
         # serialization, >=5x gate + delivery p99 budget-gated
         run_config("rpcfanout", bench_rpcfanout)
+    if "scaling" in todo:
+        # host-only committee-scaling exponent gate (complexity
+        # plane): seconds-cheap, always runs — a fixed super-linear
+        # hot path regressing must not hide behind a budget skip
+        run_config("scaling", bench_scaling)
     budget_skip = {
         "skipped": f"host budget ({host_budget_s:.0f}s) "
         "exhausted before this config"

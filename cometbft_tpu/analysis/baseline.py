@@ -92,6 +92,8 @@ def apply(
                 Finding(
                     f.path, f.line, f.col, f.rule_id, f.rule_name,
                     f.message + note,
+                    chain=f.chain,
+                    domain_trace=f.domain_trace,
                 )
             )
     stale: List[StaleEntry] = []

@@ -51,6 +51,20 @@ def build_parser() -> argparse.ArgumentParser:
         "--format", choices=("text", "json"), default="text",
     )
     p.add_argument(
+        "--json", action="store_true",
+        help="shorthand for --format json: machine-readable findings "
+        "carrying rule, file, line, the interprocedural call chain "
+        "and the domain-inference trace",
+    )
+    p.add_argument(
+        "--changed-only", action="store_true",
+        help="report findings only in files listed by `git diff "
+        "--name-only HEAD` (staged + unstaged). The WHOLE project "
+        "graph is still built — an interprocedural finding in a "
+        "changed file can ride a chain through unchanged ones — "
+        "only the report is scoped",
+    )
+    p.add_argument(
         "--list-rules", action="store_true",
         help="print the rule table and exit",
     )
@@ -62,8 +76,35 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _git_changed_files() -> set:
+    """Repo-root-relative posix paths from ``git diff --name-only
+    HEAD`` (staged + unstaged in one list) plus untracked .py files —
+    the dev-loop scope for --changed-only."""
+    import subprocess
+
+    changed: set = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            res = subprocess.run(
+                cmd, cwd=str(REPO_ROOT), capture_output=True,
+                text=True, timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if res.returncode == 0:
+            changed.update(
+                ln.strip() for ln in res.stdout.splitlines() if ln.strip()
+            )
+    return changed
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.json:
+        args.format = "json"
     out = sys.stdout
 
     if args.list_rules:
@@ -116,6 +157,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"bftlint: bad baseline: {e}", file=sys.stderr)
             return 2
         findings, stale = baseline_mod.apply(findings, bl)
+
+    if args.changed_only:
+        # scope the REPORT, not the analysis: the project graph above
+        # covered everything, so chains through unchanged files still
+        # resolved — this only drops findings outside the diff
+        changed = _git_changed_files()
+        findings = [f for f in findings if f.path in changed]
+        stale = [s for s in stale if s.path in changed]
 
     if args.format == "json":
         json.dump(

@@ -12,6 +12,13 @@ class Finding:
     rule_id: str
     rule_name: str
     message: str
+    # structured detail for machine consumers (--json): the call
+    # chain an interprocedural finding rode in on, and the
+    # domain-inference steps behind a complexity classification.
+    # Defaults keep the positional 6-arg constructor (every existing
+    # rule) and the frozen/order contract intact.
+    chain: tuple = ()
+    domain_trace: tuple = ()
 
     def render(self) -> str:
         return (

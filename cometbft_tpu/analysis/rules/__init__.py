@@ -1,6 +1,7 @@
 """Built-in bftlint rules; importing this package registers them."""
 from . import (  # noqa: F401
     async_rules,
+    complexity_rules,
     interproc_rules,
     jax_rules,
     trace_rules,

@@ -71,6 +71,14 @@ def main(argv=None) -> int:
         "schedule carrying a lock_inversion event)",
     )
     ap.add_argument(
+        "--expect-scaling-violation",
+        action="store_true",
+        help="scaling-probe check: exit 0 iff the committee-scaling "
+        "probe flagged the planted quadratic site over its exponent "
+        "budget (pair with a schedule carrying a scaling_probe "
+        "event with inject_quadratic)",
+    )
+    ap.add_argument(
         "--trace-dump",
         metavar="DIR",
         help="export every node's trace ring here (JSONL per node + "
@@ -209,6 +217,24 @@ def main(argv=None) -> int:
             else f"MISSED (got {sorted(kinds)})",
         )
         if not caught:
+            return 1
+    if args.expect_scaling_violation:
+        # only the INJECTED site counts as detection (same filter as
+        # the sanitizer check: a real breach elsewhere must not mask
+        # a probe that missed its own plant)
+        hits = [
+            r
+            for r in report.scaling_results
+            if r.get("injected") and not r.get("ok")
+        ]
+        print(
+            "scaling-probe quadratic plant:",
+            f"DETECTED (exponent {hits[0].get('exponent')} over "
+            f"budget {hits[0].get('budget')})"
+            if hits
+            else "MISSED",
+        )
+        if not hits:
             return 1
     if args.byzantine is not None:
         detected = any("agreement" in v for v in report.violations)

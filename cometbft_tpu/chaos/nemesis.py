@@ -198,6 +198,18 @@ class Nemesis:
             from ..analysis.runtime import inject_lock_inversion
 
             return inject_lock_inversion()
+        if ev.action == "scaling_probe":
+            # committee-scaling exponent probe (analysis/scaling.py):
+            # pure-CPU timing loops, so it runs in a worker thread —
+            # blocking the loop here would trip the stall detector
+            # the matrix itself polices. Results accumulate in the
+            # module drain; net.py folds them into the report after
+            # the run (sanitizer-findings discipline).
+            from ..analysis.scaling import probe_for_chaos
+
+            return await asyncio.to_thread(
+                probe_for_chaos, ev.inject_quadratic
+            )
         if ev.action == "statesync_join":
             name = await net.statesync_join(via=ev.via)
             return {"joined": name}

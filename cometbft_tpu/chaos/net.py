@@ -122,6 +122,12 @@ class ChaosReport:
     # hunts races for free); findings from a scheduled
     # lock_inversion are EXPECTED and stay here only.
     sanitizer_findings: List[dict] = field(default_factory=list)
+    # committee-scaling probe (analysis/scaling.py): every site the
+    # scheduled scaling_probe fault measured, with fitted exponent
+    # vs budget. Un-injected breaches also land in ``violations``;
+    # a planted (``chaos.``-prefixed) quadratic site breaching is
+    # EXPECTED and stays here only.
+    scaling_results: List[dict] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -154,6 +160,13 @@ class ChaosReport:
         for f in self.sanitizer_findings:
             lines.append(
                 f"sanitizer[{f.get('kind')}]: {f.get('message')}"
+            )
+        for r in self.scaling_results:
+            lines.append(
+                f"scaling[{r.get('site')}]: exponent "
+                f"{r.get('exponent')} vs budget {r.get('budget')} "
+                + ("OK" if r.get("ok") else "OVER")
+                + (" (injected)" if r.get("injected") else "")
             )
         if self.workload:
             lines.append(f"workload: {self.workload}")
@@ -1083,6 +1096,10 @@ async def run_schedule(
     inversion_scheduled = any(
         e.action == "lock_inversion" for e in schedule.events
     )
+    quadratic_scheduled = any(
+        e.action == "scaling_probe" and e.inject_quadratic
+        for e in schedule.events
+    )
     driver = None
     if workload is not None and workload.pattern != "none":
         from .workload import WorkloadDriver
@@ -1264,6 +1281,26 @@ async def run_schedule(
                         "lock_inversion injected but the sanitizer "
                         f"reported no {want} finding"
                     )
+        # scaling-probe results ride the same contract: an un-injected
+        # exponent breach fails the run, and a scheduled quadratic
+        # plant the probe did NOT flag also fails it
+        from ..analysis.scaling import drain_chaos_results
+
+        scaling_results = drain_chaos_results()
+        report.scaling_results = [r.as_dict() for r in scaling_results]
+        for r in scaling_results:
+            if not r.ok and not r.injected:
+                report.violations.append(
+                    f"scaling[{r.site}]: exponent {r.exponent:.2f} "
+                    f"over budget {r.budget:.2f}"
+                )
+        if quadratic_scheduled and not any(
+            r.injected and not r.ok for r in scaling_results
+        ):
+            report.violations.append(
+                "scaling_probe injected a quadratic site but the "
+                "probe reported no breach for it"
+            )
         if budget_file:
             # evaluated over the in-memory rings so a breach can force
             # the dump below even when no invariant tripped

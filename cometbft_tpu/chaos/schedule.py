@@ -100,6 +100,18 @@ deterministic and fast):
                       healed minority. The self-healing plane must
                       re-converge after every heal (gated by the
                       ``p2p.reconnect`` span budget).
+``scaling_probe``     run the committee-scaling exponent probe
+                      (analysis/scaling.py, docs/LINT.md "Complexity
+                      rules") mid-schedule in a worker thread: the
+                      flagged hot-path sites are driven at small
+                      committee sizes and their log-log exponents
+                      judged against tools/scaling_budgets.toml. An
+                      un-injected budget breach is a VIOLATION;
+                      with ``inject_quadratic=True`` a deliberate
+                      O(n^2) site (``chaos.``-prefixed, like
+                      lock_inversion's probe locks) is planted and
+                      the run asserts the probe FLAGS it — the same
+                      checker-validation discipline.
 ====================  =================================================
 
 Schedules round-trip through JSON so failing runs can be archived and
@@ -117,6 +129,7 @@ ACTIONS = (
     "partition", "heal", "set_link", "crash", "restart", "byzantine",
     "stall", "crash_wave", "statesync_join", "valset_churn",
     "wal_torn_tail", "conn_kill", "reconnect_storm", "lock_inversion",
+    "scaling_probe",
 )
 
 
@@ -145,6 +158,7 @@ class FaultEvent:
     cycles: int = 2  # reconnect_storm: partition/heal repetitions
     hold_s: float = 1.2  # reconnect_storm: partition hold per cycle
     gap_s: float = 0.8  # reconnect_storm: healed gap between cycles
+    inject_quadratic: bool = False  # scaling_probe: plant an O(n^2) site
 
     def __post_init__(self):
         if self.action not in ACTIONS:

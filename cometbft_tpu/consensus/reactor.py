@@ -90,6 +90,89 @@ class PeerRoundState:
     proposal_seen: bool = False
 
 
+class PeerVoteCursor:
+    """Incremental per-peer vote picker over VoteSet.vote_log.
+
+    The old shape rescanned every vote set the peer could need on
+    EVERY gossip tick — O(validators) per peer per tick, O(V^2)
+    across the committee even at steady state (flagged by ASY117,
+    slope measured by bench.py's scaling leg). The cursor reads each
+    source log once (``vote_log[read:]``), stages what the peer has
+    not acked into ``pending``, and retransmits only from there:
+    a tick costs O(new votes + unacked), which is O(0) at steady
+    state.
+
+    Sources are the same sets the reference PickSendVote consults:
+    prevotes/precommits for {peer round, our round, our round - 1}
+    plus last-height precommits. ``pending`` is bounded by the
+    per-height vote count and the whole cursor resets on height
+    advance (mirroring the peer's own ``has_votes.clear()``).
+    """
+
+    __slots__ = ("height", "_read", "pending")
+
+    def __init__(self):
+        self.height = 0
+        self._read: Dict[tuple, int] = {}
+        # vote key -> [vote, last_sent_monotonic]
+        self.pending: Dict[tuple, list] = {}
+
+    def reset(self, height: int) -> None:
+        self.height = height
+        self._read.clear()
+        self.pending.clear()
+
+    def _ingest_log(self, skey: tuple, log, has) -> None:
+        start = self._read.get(skey, 0)
+        if start >= len(log):
+            return
+        for v in log[start:]:
+            k = _vote_key(v)
+            if k not in has and k not in self.pending:
+                self.pending[k] = [v, 0.0]
+        self._read[skey] = len(log)
+
+    def ingest(self, rs, prs: "PeerRoundState") -> None:
+        """Advance every source cursor; stage new unacked votes."""
+        has = prs.has_votes
+        if rs.votes is not None:
+            rounds = {prs.round, rs.round, rs.round - 1}
+            for r in sorted(x for x in rounds if x >= 0):
+                pv = rs.votes.prevotes(r)
+                if pv is not None:
+                    self._ingest_log(("pv", r), pv.vote_log, has)
+                pc = rs.votes.precommits(r)
+                if pc is not None:
+                    self._ingest_log(("pc", r), pc.vote_log, has)
+        if rs.last_commit is not None:
+            self._ingest_log(("lc",), rs.last_commit.vote_log, has)
+
+    def due_votes(
+        self,
+        prs: "PeerRoundState",
+        now: float,
+        budget: int,
+        after: float = RETRANSMIT_AFTER_S,
+    ):
+        """Drop acked entries, return up to ``budget`` votes due for
+        (re)transmission, stamping their send time."""
+        out = []
+        has = prs.has_votes
+        drop = []
+        for k, entry in self.pending.items():
+            if k in has:
+                drop.append(k)
+                continue
+            if now - entry[1] > after:
+                entry[1] = now
+                out.append(entry[0])
+                if len(out) >= budget:
+                    break
+        for k in drop:
+            del self.pending[k]
+        return out
+
+
 # --- wire codecs --------------------------------------------------------
 
 
@@ -307,6 +390,7 @@ class ConsensusReactor(Reactor):
 
     async def _gossip_routine(self, peer) -> None:
         sent_at: Dict[tuple, float] = {}
+        cursor = PeerVoteCursor()
         sleep_s = getattr(self.cs.config, "peer_gossip_sleep_s", 0.1)
         try:
             while True:
@@ -410,14 +494,15 @@ class ConsensusReactor(Reactor):
                         if sent_parts >= MAX_GOSSIP_PARTS_PER_TICK:
                             break
 
-                # votes: everything we have for rounds the peer is in
-                sent_votes = 0
-                for vote in self._votes_for_peer(rs, prs):
-                    vkey = _vote_key(vote)
-                    if vkey in prs.has_votes:
-                        continue
-                    if not due(("vote",) + vkey):
-                        continue
+                # votes: incremental cursor over each source's
+                # append-ordered vote_log — O(new + unacked) per
+                # tick, not a full O(validators) rescan
+                if cursor.height != rs.height:
+                    cursor.reset(rs.height)
+                cursor.ingest(rs, prs)
+                for vote in cursor.due_votes(
+                    prs, now, MAX_GOSSIP_VOTES_PER_TICK
+                ):
                     peer.try_send(
                         VOTE_CHANNEL,
                         self.switch.stamp_msg(
@@ -426,30 +511,12 @@ class ConsensusReactor(Reactor):
                             peer=peer.peer_id,
                         ),
                     )
-                    sent_at[("vote",) + vkey] = now
-                    sent_votes += 1
-                    if sent_votes >= MAX_GOSSIP_VOTES_PER_TICK:
-                        break
                 if len(sent_at) > 50_000:
                     sent_at.clear()
         except asyncio.CancelledError:
             raise
         except Exception:
             traceback.print_exc()
-
-    def _votes_for_peer(self, rs, prs: PeerRoundState):
-        """All signed votes we hold that the peer's round state could
-        still need (reference PickSendVote's source sets)."""
-        if rs.votes is None:
-            return
-        rounds = {prs.round, rs.round, rs.round - 1}
-        for r in sorted(x for x in rounds if x >= 0):
-            for vs in (rs.votes.prevotes(r), rs.votes.precommits(r)):
-                if vs is not None:
-                    yield from (v for v in vs.votes if v is not None)
-        # last-height precommits help peers still committing
-        if rs.last_commit is not None:
-            yield from (v for v in rs.last_commit.votes if v is not None)
 
     # --- inbound ------------------------------------------------------
 

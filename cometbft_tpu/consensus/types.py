@@ -38,8 +38,8 @@ class HeightVoteSet:
         self.val_set = val_set
         self.sig_cache = sig_cache
         self.round = 0
-        self._prevotes: Dict[int, T.VoteSet] = {}
-        self._precommits: Dict[int, T.VoteSet] = {}
+        self._prevotes: Dict[int, T.VoteSet] = {}  # bftlint: disable=ASY119 — keyed by round within ONE height; the whole HeightVoteSet is replaced on height advance (update_to_state)
+        self._precommits: Dict[int, T.VoteSet] = {}  # bftlint: disable=ASY119 — keyed by round within ONE height; replaced on height advance together with _prevotes
         self._lock = sanitized_lock(
             threading.RLock(), "consensus.votes"
         )

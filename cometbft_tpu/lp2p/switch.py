@@ -183,6 +183,12 @@ class Lp2pPeer:
         if cid is None or cid not in self._max_msg_size:
             st.abort()
             return
+        # drop finished readers first: streams come and go for the
+        # peer's whole lifetime, and a done task kept in the list is
+        # a leak the complexity pass (ASY119) flags
+        self._reader_tasks = [
+            t for t in self._reader_tasks if not t.done()
+        ]
         self._reader_tasks.append(
             asyncio.create_task(self._read_stream(cid, st))
         )

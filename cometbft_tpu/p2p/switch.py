@@ -579,7 +579,7 @@ class Switch:
             msg = tracewire.encode_plain(
                 msg, self._chan_caps.get(chan_id, 0)
             )
-        for p in list(self.peers.values()):
+        for p in list(self.peers.values()):  # bftlint: disable=ASY117 — flood fanout IS the protocol floor: one encode per broadcast, O(peers) enqueues of one shared bytes object; vote-aggregation relay (ROADMAP item 1) is the committee-scale answer
             p.try_send(chan_id, msg)
 
     def num_peers(self) -> int:

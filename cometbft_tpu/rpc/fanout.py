@@ -238,7 +238,7 @@ class FanoutHub:
             # concurrent reaps: each _reap_task is wait_for-bounded at
             # DETACH_WAIT_S internally, so the gather bounds the WHOLE
             # close at DETACH_WAIT_S (not per wedged writer)
-            await asyncio.gather(  # bftlint: disable=ASY110
+            await asyncio.gather(  # bftlint: disable=ASY110 — each reap is wait_for-bounded, so the gather bounds the whole close
                 *(_reap_task(task) for task in tasks)
             )
 

@@ -421,7 +421,7 @@ class IndexerService:
         the no-running-loop inline degrade in _seal (CLI tools / sync
         embedders — no loop exists to stall in that mode); with a
         loop, sealing hands the bundle to the bounded async drain."""
-        self.bus.add_sync_listener(self._on_event)  # bftlint: disable=ASY116
+        self.bus.add_sync_listener(self._on_event)  # bftlint: disable=ASY116 — listener only degrades inline when NO loop is running (CLI embedders)
 
     async def start_async(self, block_store=None, state_store=None) -> None:
         """Upgrade to the async drain (Node.start): replay any

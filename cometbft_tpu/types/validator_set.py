@@ -78,9 +78,17 @@ class ValidatorSet:
         return len(self.validators)
 
     def total_voting_power(self) -> int:
-        tp = sum(v.voting_power for v in self.validators)
-        if tp > MAX_TOTAL_VOTING_POWER:
-            raise ValueError("total voting power overflow")
+        # memoized like hash(): every add_vote compares accumulated
+        # power against the total, so an unmemoized sum here is O(V)
+        # per vote = O(V^2) per height (the bench.py scaling leg
+        # measures the slope). Powers only change through
+        # update_with_change_set, which drops the memo.
+        tp = getattr(self, "_total_power", None)
+        if tp is None:
+            tp = sum(v.voting_power for v in self.validators)  # bftlint: disable=ASY117 — memoized: this sum reruns once per membership/power change, not per message
+            if tp > MAX_TOTAL_VOTING_POWER:
+                raise ValueError("total voting power overflow")
+            self._total_power = tp
         return tp
 
     def has_address(self, addr: bytes) -> bool:
@@ -115,6 +123,7 @@ class ValidatorSet:
         vs.validators = [v.copy() for v in self.validators]
         vs._by_address = dict(self._by_address)
         vs._hash = getattr(self, "_hash", None)
+        vs._total_power = getattr(self, "_total_power", None)
         vs.proposer = (
             None
             if self.proposer is None
@@ -197,15 +206,19 @@ class ValidatorSet:
             if addr not in self._by_address:
                 raise ValueError("removing unknown validator")
 
+        # index once: the per-validator `next(...)` scans here were
+        # O(V x changes) — the exact nested-committee-loop shape
+        # ASY118 exists to catch (a 128-validator set churning a
+        # quarter of its members paid ~8k scans per update)
+        upd_by_addr = {c.address: c for c in updates}
+
         # compute priority for new validators: -1.125 * new total power
         new_total = sum(
             c.voting_power for c in updates if c.address not in self._by_address
         )
         for v in self.validators:
             if v.address not in removals:
-                upd = next(
-                    (c for c in updates if c.address == v.address), None
-                )
+                upd = upd_by_addr.get(v.address)
                 if upd is None:
                     new_total += v.voting_power
                 else:
@@ -217,7 +230,7 @@ class ValidatorSet:
         for v in self.validators:
             if v.address in removals:
                 continue
-            upd = next((c for c in updates if c.address == v.address), None)
+            upd = upd_by_addr.get(v.address)
             if upd is not None:
                 v = v.copy()
                 v.voting_power = upd.voting_power
@@ -236,7 +249,9 @@ class ValidatorSet:
         new_vals.sort(key=lambda v: (-v.voting_power, v.address))
         self.validators = new_vals
         self._by_address = {v.address: i for i, v in enumerate(new_vals)}
-        self._hash = None  # membership/power changed: drop the memo
+        # membership/power changed: drop both memos
+        self._hash = None
+        self._total_power = None
         self._shift_by_avg_proposer_priority()
         self.proposer = self._compute_max_priority_validator()
 

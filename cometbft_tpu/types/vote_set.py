@@ -60,6 +60,12 @@ class VoteSet:
         # here, keeping the single-writer add_vote path off the crypto
         self.sig_cache = sig_cache
         self.votes: List[Optional[Vote]] = [None] * val_set.size()
+        # append-ordered log of accepted votes: the consensus
+        # reactor's per-peer gossip cursors read `vote_log[i:]` so a
+        # gossip tick costs O(new votes), not O(validators) — the
+        # ASY117 fix. Append-only BY DESIGN; the whole VoteSet is
+        # per-(height, round, type) and dropped on height advance.
+        self.vote_log: List[Vote] = []  # bftlint: disable=ASY119 — append-only gossip cursor log, bounded by the validator count and dropped with the per-height VoteSet
         self.sum = 0
         self.maj23: Optional[BlockID] = None
         self.votes_by_block: Dict[bytes, _BlockVotes] = {}
@@ -103,6 +109,7 @@ class VoteSet:
             raise ValueError("invalid vote signature")
 
         self.votes[idx] = vote
+        self.vote_log.append(vote)
         self.sum += val.voting_power
         bk = vote.block_id.key()
         bv = self.votes_by_block.setdefault(bk, _BlockVotes())

@@ -85,7 +85,7 @@ def module():
                 # one-time lazy native build; loop callers never park
                 # here (non-blocking acquire above + build_node
                 # prewarm thread) — sanctioned blocking sink
-                subprocess.run(  # bftlint: disable=ASY114
+                subprocess.run(  # bftlint: disable=ASY114 — one-time lazy native build; loop callers never park here (non-blocking acquire + prewarm)
                     [
                         "g++",
                         "-O2",

@@ -46,6 +46,9 @@ FIXTURE_PATHS = {
     "ASY113": "cometbft_tpu/light/x.py",
     "ASY114": "cometbft_tpu/consensus/x.py",
     "ASY115": "cometbft_tpu/consensus/x.py",
+    "ASY117": "cometbft_tpu/consensus/x.py",
+    "ASY118": "cometbft_tpu/consensus/x.py",
+    "ASY119": "cometbft_tpu/consensus/x.py",
 }
 
 
@@ -538,6 +541,90 @@ FIXTURES = [
                 # the flush is OFFLOADED — a function reference is an
                 # argument, not a call: no edge, no finding
                 await asyncio.to_thread(self.idx.flush, self.pending)
+        """,
+    ),
+    (
+        "ASY117",  # superlinear-msg-handler (interprocedural): the
+        # per-message receive path reaches a validators-domain loop
+        # two hops down — O(V) per message, O(V^2) per height
+        """
+        class Reactor:
+            def __init__(self, validators):
+                self.validators = validators
+            def receive(self, msg, peer):
+                self._tally(msg)
+            def _tally(self, msg):
+                total = 0
+                for v in self.validators:
+                    total += v.voting_power
+        """,
+        """
+        class Reactor:
+            def __init__(self, validators):
+                self.by_addr = {v.address: v for v in validators}
+                self.total = 0
+            def receive(self, msg, peer):
+                # incremental: one dict lookup + a running sum, no
+                # committee loop on the per-message path
+                val = self.by_addr.get(msg.address)
+                if val is not None:
+                    self.total += val.voting_power
+            def rebuild(self, validators):
+                # membership change, not per-message: loop is fine
+                self.by_addr = {v.address: v for v in validators}
+        """,
+    ),
+    (
+        "ASY118",  # nested-committee-loop: validator x validator is
+        # the direct quadratic (the update_with_change_set shape
+        # this PR fixed with a one-pass address index)
+        """
+        from typing import Sequence
+        def update(validators, changes: Sequence[Validator]):
+            out = []
+            updates = [c for c in changes if c.power > 0]
+            for v in validators:
+                for c in updates:
+                    if c.address == v.address:
+                        out.append(c)
+            return out
+        """,
+        """
+        from typing import Sequence
+        def update(validators, changes: Sequence[Validator]):
+            by_addr = {c.address: c for c in changes}  # index once
+            out = []
+            for v in validators:
+                c = by_addr.get(v.address)
+                if c is not None:
+                    out.append(c)
+            return out
+        def retries(validators):
+            # committee x constant: bounded inner loop, not nesting
+            for v in validators:
+                for attempt in range(3):
+                    pass
+        """,
+    ),
+    (
+        "ASY119",  # unbounded-growth-in-hot-plane: a container attr
+        # fed by the per-message path with no prune anywhere is the
+        # months-horizon soak leak
+        """
+        class Reactor:
+            def __init__(self):
+                self.seen = set()
+            def receive(self, msg, peer):
+                self.seen.add(msg.key())
+        """,
+        """
+        class Reactor:
+            def __init__(self):
+                self.seen = set()
+            def receive(self, msg, peer):
+                self.seen.add(msg.key())
+            def advance_height(self):
+                self.seen.clear()  # pruned on height advance
         """,
     ),
     (

@@ -1,0 +1,608 @@
+"""Committee-scale complexity plane: loop-domain classification
+(ASY117/118/119 behaviors beyond the basic fixtures in
+test_bftlint.py), the empirical scaling probe (analysis/scaling.py),
+its chaos drain, the CLI satellites (--json / --changed-only),
+suppression hygiene, and the hot-path fixes the pass drove
+(total_voting_power memo, update indexing, PeerVoteCursor)."""
+
+import io
+import json
+import re
+import textwrap
+import tokenize
+from pathlib import Path
+
+import pytest
+
+from cometbft_tpu.analysis import analyze_source
+from cometbft_tpu.analysis import scaling
+from cometbft_tpu.analysis.cli import main as cli_main
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+CONS = "cometbft_tpu/consensus/x.py"
+
+
+def findings_of(src: str, path: str = CONS):
+    return analyze_source(textwrap.dedent(src), path)
+
+
+def ids_of(src: str, path: str = CONS):
+    return sorted({f.rule_id for f in findings_of(src, path)})
+
+
+# --- loop-domain classification: the callgraph gaps this PR closed ----
+# (comprehension/generator loops and zip()/enumerate() destructuring
+# used to be invisible to the pass)
+
+
+def test_comprehension_loop_carries_domain():
+    src = """
+    class R:
+        def __init__(self, validators):
+            self.validators = validators
+        def receive(self, msg, peer):
+            return [v.address for v in self.validators]
+    """
+    assert "ASY117" in ids_of(src)
+
+
+def test_generator_expression_loop_carries_domain():
+    src = """
+    class R:
+        def __init__(self, validators):
+            self.validators = validators
+        def receive(self, msg, peer):
+            return sum(v.power for v in self.validators)
+    """
+    assert "ASY117" in ids_of(src)
+
+
+def test_zip_destructured_target_carries_domain():
+    src = """
+    class R:
+        def __init__(self, validators, sigs):
+            self.validators = validators
+            self.sigs = sigs
+        def receive(self, msg, peer):
+            for v, s in zip(self.validators, self.sigs):
+                print(v, s)
+    """
+    assert "ASY117" in ids_of(src)
+
+
+def test_enumerate_destructured_target_carries_domain():
+    src = """
+    class R:
+        def __init__(self, validators):
+            self.validators = validators
+        def receive(self, msg, peer):
+            for i, v in enumerate(self.validators):
+                print(i, v)
+    """
+    assert "ASY117" in ids_of(src)
+
+
+def test_bounded_and_foreign_loops_stay_clean():
+    src = """
+    class R:
+        def receive(self, msg, peer):
+            for i in range(3):
+                print(i)
+            for ch in zip("abc", "def"):
+                print(ch)
+            for part in msg.parts:
+                print(part)
+    """
+    assert "ASY117" not in ids_of(src)
+
+
+# --- ASY117: chain payload + suppression sanctioning ------------------
+
+
+def test_asy117_finding_carries_chain_and_domain_trace():
+    src = """
+    class R:
+        def __init__(self, validators):
+            self.validators = validators
+        def receive(self, msg, peer):
+            self._tally()
+        def _tally(self):
+            for v in self.validators:
+                print(v)
+    """
+    hits = [f for f in findings_of(src) if f.rule_id == "ASY117"]
+    assert hits, "expected an ASY117 finding"
+    f = hits[0]
+    assert f.chain[0] == "receive" and len(f.chain) >= 2, f.chain
+    assert f.domain_trace and "validators" in f.domain_trace[0]
+    # --json consumers get the same payload
+    doc = f.to_json()
+    assert doc["chain"] and doc["domain_trace"]
+
+
+def test_asy117_suppressed_loop_line_sanctions_the_chain():
+    """One justified comment on the LOOP line kills the whole fan of
+    chain findings (the ASY114 sanctioned-sink contract)."""
+    src = """
+    class R:
+        def __init__(self, validators):
+            self.validators = validators
+        def receive(self, msg, peer):
+            self._tally()
+        def _tally(self):
+            for v in self.validators:  # bftlint: disable=ASY117 — once per height, memoized upstream
+                print(v)
+    """
+    assert "ASY117" not in ids_of(src)
+
+
+# --- ASY118: interprocedural nesting + suppression --------------------
+
+
+def test_asy118_call_inside_committee_loop_reaching_committee_loop():
+    src = """
+    from typing import Sequence
+    def scan(changes: Sequence[Validator], addr):
+        for c in changes:
+            if c.address == addr:
+                return c
+    def update(validators, changes: Sequence[Validator]):
+        for v in validators:
+            scan(changes, v.address)
+    """
+    hits = [f for f in findings_of(src) if f.rule_id == "ASY118"]
+    assert hits, "expected interprocedural ASY118"
+
+
+def test_asy118_inner_line_suppression():
+    src = """
+    from typing import Sequence
+    def update(validators, changes: Sequence[Validator]):
+        for v in validators:
+            for c in changes:  # bftlint: disable=ASY118 — churn sets are tiny in practice, measured by the scaling leg
+                print(v, c)
+    """
+    assert "ASY118" not in ids_of(src)
+
+
+# --- ASY119: prune detection subtleties -------------------------------
+
+
+def test_asy119_alias_prune_is_seen():
+    """Draining through a local alias (fifo = self._q; fifo.pop(0))
+    must count as a prune — the ConsensusState durable-FIFO shape."""
+    src = """
+    class R:
+        def __init__(self):
+            self._q = []
+        def receive(self, msg, peer):
+            self._q.append(msg)
+        def drain(self):
+            fifo = self._q
+            while fifo:
+                fifo.pop(0)
+    """
+    assert "ASY119" not in ids_of(src)
+
+
+def test_asy119_registration_growth_is_not_hot():
+    """Appends only reachable from startup/registration (not from a
+    per-message handler) scale with config, not traffic."""
+    src = """
+    class R:
+        def __init__(self):
+            self.reactors = []
+        def add_reactor(self, r):
+            self.reactors.append(r)
+    """
+    assert "ASY119" not in ids_of(src)
+
+
+def test_asy119_suppressed_init_line():
+    src = """
+    class R:
+        def __init__(self):
+            self.log = []  # bftlint: disable=ASY119 — bounded by validator count, dropped per height
+        def receive(self, msg, peer):
+            self.log.append(msg)
+    """
+    assert "ASY119" not in ids_of(src)
+
+
+# --- suppression hygiene (tier-1) -------------------------------------
+
+_DIRECTIVE = re.compile(
+    r"#\s*bftlint:\s*disable(?:-next|-file)?\s*=\s*"
+    r"[A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*"
+)
+
+
+def _repo_py_files():
+    for sub in ("cometbft_tpu",):
+        yield from (REPO_ROOT / sub).rglob("*.py")
+
+
+def test_every_suppression_carries_a_justification():
+    """A bare ``# bftlint: disable=X`` is a mute button; the pass
+    requires the WHY on the same comment (>= 15 chars of prose after
+    the rule list) so every sanctioned sink is auditable."""
+    offenders = []
+    for path in _repo_py_files():
+        src = path.read_text(encoding="utf-8")
+        # real COMMENT tokens only: directive syntax quoted in
+        # docstrings/strings (suppress.py's own docs) is not a suppression
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _DIRECTIVE.search(tok.string)
+            if m is None:
+                continue
+            tail = tok.string[m.end():].strip().strip("—-: ").strip()
+            if len(tail) < 15:
+                offenders.append(
+                    f"{path.relative_to(REPO_ROOT)}:{tok.start[0]}: "
+                    f"{tok.string.strip()}"
+                )
+    assert not offenders, (
+        "suppressions without justification:\n" + "\n".join(offenders)
+    )
+
+
+def test_baseline_entries_match_live_findings():
+    """Every baseline allowance must still match a live finding — a
+    stale entry means the violation was fixed and the ratchet must
+    tighten (lint.sh enforces this with --fail-on-stale; this is the
+    same check as a plain tier-1 assert)."""
+    from cometbft_tpu.analysis import baseline as baseline_mod
+    from cometbft_tpu.analysis.engine import run
+
+    bl_path = REPO_ROOT / "tools" / "bftlint_baseline.json"
+    bl = baseline_mod.load(str(bl_path))
+    findings = run([str(REPO_ROOT / "cometbft_tpu")])
+    _, stale = baseline_mod.apply(findings, bl)
+    assert not stale, "\n".join(s.render() for s in stale)
+
+
+# --- CLI satellites ---------------------------------------------------
+
+
+def test_cli_json_emits_chain_and_domain_trace(tmp_path, capsys):
+    bad = tmp_path / "consensus_probe.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            class R:
+                def __init__(self, validators):
+                    self.validators = validators
+                def receive(self, msg, peer):
+                    self._tally()
+                def _tally(self):
+                    for v in self.validators:
+                        print(v)
+            """
+        )
+    )
+    # path-scoped rules need an in-scope path: analyze the file via a
+    # project rooted at it but report under its real (tmp) path —
+    # ASY117 needs the hot-plane prefix, so copy into a shadow tree
+    shadow = tmp_path / "cometbft_tpu" / "consensus"
+    shadow.mkdir(parents=True)
+    (shadow / "x.py").write_text(bad.read_text())
+    rc = cli_main(
+        [str(tmp_path / "cometbft_tpu"), "--json", "--no-baseline"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1
+    doc = json.loads(out)
+    hits = [
+        f for f in doc["findings"] if f["rule_id"] == "ASY117"
+    ]
+    assert hits
+    assert hits[0]["chain"] and hits[0]["domain_trace"]
+
+
+def test_cli_changed_only_scopes_the_report(tmp_path, capsys, monkeypatch):
+    """--changed-only filters the REPORT to the git diff, without
+    skipping the graph build (the finding below still resolves its
+    chain through the whole scanned tree)."""
+    from cometbft_tpu.analysis import cli as cli_mod
+
+    shadow = tmp_path / "cometbft_tpu" / "consensus"
+    shadow.mkdir(parents=True)
+    target = shadow / "x.py"
+    target.write_text(
+        textwrap.dedent(
+            """
+            class R:
+                def __init__(self, validators):
+                    self.validators = validators
+                def receive(self, msg, peer):
+                    for v in self.validators:
+                        print(v)
+            """
+        )
+    )
+    args = [str(tmp_path / "cometbft_tpu"), "--no-baseline", "--changed-only"]
+    # the scanned file IS in the diff: finding reported
+    monkeypatch.setattr(
+        cli_mod, "_git_changed_files",
+        lambda: {str(target.as_posix())},
+    )
+    assert cli_main(args) == 1
+    capsys.readouterr()
+    # the scanned file is NOT in the diff: report is empty
+    monkeypatch.setattr(cli_mod, "_git_changed_files", lambda: set())
+    assert cli_main(args) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+# --- scaling probe: exponent fitting ----------------------------------
+
+
+def test_fit_exponent_exact_powers():
+    sizes = (4, 16, 64, 128)
+    assert scaling.fit_exponent(sizes, [7.0] * 4) == pytest.approx(0.0)
+    assert scaling.fit_exponent(
+        sizes, [3.0 * n for n in sizes]
+    ) == pytest.approx(1.0)
+    assert scaling.fit_exponent(
+        sizes, [0.5 * n * n for n in sizes]
+    ) == pytest.approx(2.0)
+
+
+def test_synthetic_sites_bracket_their_exponents():
+    """Generous brackets: timing noise must never fail tier-1, only a
+    wrong complexity CLASS should."""
+    sites = {
+        "o1": scaling.synthetic_site(0.0, unit=400),
+        "on": scaling.synthetic_site(1.0, unit=60),
+        "on2": scaling.synthetic_site(2.0, unit=8),
+    }
+    res = {
+        r.site: r
+        for r in scaling.run_probe(
+            sites=sites, sizes=(4, 16, 48), min_wall_s=0.004
+        )
+    }
+    assert res["o1"].exponent < 0.5, res["o1"]
+    assert 0.5 < res["on"].exponent < 1.5, res["on"]
+    assert res["on2"].exponent > 1.6, res["on2"]
+
+
+def test_real_sites_fit_finite_sublinearish_exponents():
+    """The four fixed hot-path sites must stay in the linear class at
+    small sizes (the bench leg gates the tight 1.2 budget at full
+    sizes; tier-1 uses a generous 1.6 class boundary so box noise
+    cannot flake the suite)."""
+    res = scaling.run_probe(sizes=(4, 16, 48), min_wall_s=0.004)
+    assert {r.site for r in res} == {
+        "vote_add", "commit_assembly", "gossip_pick", "fanout_publish",
+    }
+    for r in res:
+        assert r.exponent < 1.6, scaling.format_results(res)
+
+
+def test_injected_quadratic_site_is_flagged_and_drained():
+    out = scaling.probe_for_chaos(inject_quadratic=True)
+    assert out["injected"] == "chaos.injected_quadratic"
+    assert "chaos.injected_quadratic" in out["breaches"]
+    drained = scaling.drain_chaos_results()
+    planted = [r for r in drained if r.injected]
+    assert planted and not planted[0].ok
+    assert scaling.injected_result(planted[0])
+    # drain empties (net.py folds each run's results exactly once)
+    assert scaling.drain_chaos_results() == []
+
+
+def test_budget_file_loads_and_covers_every_real_site():
+    budgets = scaling.load_exponent_budgets()
+    for site in scaling.site_names():
+        assert site in budgets, f"{site} missing a scaling budget"
+        assert 1.0 <= budgets[site] <= scaling.DEFAULT_EXPONENT_BUDGET
+
+
+def test_minimal_toml_fallback_parses_the_shipped_budgets():
+    text = (REPO_ROOT / "tools" / "scaling_budgets.toml").read_text()
+    parsed = scaling._parse_budget_toml_minimal(text)
+    assert parsed == {
+        s: {"max_exponent": b}
+        for s, b in scaling.load_exponent_budgets().items()
+    }
+
+
+@pytest.mark.slow
+def test_synthetic_exponents_stable_across_repeats():
+    """Slow leg: the brackets hold across repeated fits (catching a
+    calibration bug that only shows under sustained timing jitter)."""
+    for _ in range(3):
+        test_synthetic_sites_bracket_their_exponents()
+
+
+@pytest.mark.slow
+def test_chaos_scaling_probe_e2e_flags_injected_quadratic(tmp_path):
+    """Chaos e2e: a scheduled scaling_probe with inject_quadratic runs
+    mid-schedule under a live 4-node net; the report must carry the
+    planted site OVER budget without turning it into a violation."""
+    import asyncio
+
+    from cometbft_tpu.chaos.net import run_schedule
+    from cometbft_tpu.chaos.schedule import FaultEvent, FaultSchedule
+
+    async def main():
+        schedule = FaultSchedule(
+            [
+                FaultEvent(
+                    "scaling_probe", at_height=2, inject_quadratic=True
+                ),
+                FaultEvent("crash", at_height=3, node=1),
+                FaultEvent("restart", after_s=0.5, node=1),
+            ]
+        )
+        report = await run_schedule(
+            schedule, seed=1337, base_dir=str(tmp_path)
+        )
+        planted = [
+            r
+            for r in report.scaling_results
+            if r["injected"] and not r["ok"]
+        ]
+        assert planted, report.scaling_results
+        assert not any(
+            "scaling_probe injected" in v for v in report.violations
+        ), report.violations
+
+    asyncio.run(asyncio.wait_for(main(), 300))
+
+
+# --- hot-path fixes the pass drove ------------------------------------
+
+
+def test_total_voting_power_memo_invalidates_on_churn():
+    from cometbft_tpu.analysis.scaling import _committee
+    from cometbft_tpu.types.validator_set import Validator
+
+    vs, _, _, _ = _committee(4)
+    assert vs.total_voting_power() == 40
+    assert vs.total_voting_power() == 40  # memo hit
+    # power update drops the memo
+    v0 = vs.validators[0]
+    vs.update_with_change_set([Validator(v0.pub_key, 25, v0.address)])
+    assert vs.total_voting_power() == 55
+    # removal drops it too
+    vs.update_with_change_set([Validator(v0.pub_key, 0, v0.address)])
+    assert vs.total_voting_power() == 30
+    # copies carry the memo without sharing future invalidations
+    cp = vs.copy()
+    assert cp.total_voting_power() == 30
+
+
+def test_update_with_change_set_indexing_parity():
+    """The dict-indexed update (the ASY118 fix) must keep the exact
+    reference semantics the next()-scan shape had: updates apply,
+    adds land with the -1.125x priority, removals drop."""
+    from cometbft_tpu.analysis.scaling import _committee
+    from cometbft_tpu.crypto.keys import PubKey
+    from cometbft_tpu.types.validator_set import Validator
+
+    vs, _, _, _ = _committee(6)
+    before = {v.address: v.voting_power for v in vs.validators}
+    a_upd = vs.validators[1]
+    a_del = vs.validators[4]
+    new_pk = PubKey(bytes([9]) + (77).to_bytes(31, "big"))
+    vs.update_with_change_set(
+        [
+            Validator(a_upd.pub_key, 42, a_upd.address),
+            Validator(a_del.pub_key, 0, a_del.address),
+            Validator(new_pk, 7),
+        ]
+    )
+    after = {v.address: v.voting_power for v in vs.validators}
+    assert after[a_upd.address] == 42
+    assert a_del.address not in after
+    assert after[new_pk.address()] == 7
+    # untouched members keep their power
+    for addr, power in before.items():
+        if addr not in (a_upd.address, a_del.address):
+            assert after[addr] == power
+    assert vs.total_voting_power() == sum(after.values())
+    # the new member entered with the reference catch-up priority:
+    # strictly the lowest in the set (-1.125x total, then avg-shifted)
+    new_val = vs.validators[
+        [v.address for v in vs.validators].index(new_pk.address())
+    ]
+    assert all(
+        new_val.proposer_priority < v.proposer_priority
+        for v in vs.validators
+        if v.address != new_val.address
+    )
+
+
+def _cursor_world(n=4):
+    from cometbft_tpu.analysis.scaling import _committee
+    from cometbft_tpu.consensus.reactor import (
+        PeerRoundState,
+        PeerVoteCursor,
+        _vote_key,
+    )
+    from cometbft_tpu.types.vote import PRECOMMIT
+    from cometbft_tpu.types.vote_set import VoteSet
+
+    valset, votes, chain_id, height = _committee(n)
+    precommits = VoteSet(
+        chain_id, height, 0, PRECOMMIT, valset, verify_signatures=False
+    )
+
+    class _HVS:
+        def prevotes(self, r):
+            return None
+
+        def precommits(self, r):
+            return precommits if r == 0 else None
+
+    class _RS:
+        pass
+
+    rs = _RS()
+    rs.height, rs.round = height, 0
+    rs.votes, rs.last_commit = _HVS(), None
+    prs = PeerRoundState(height=height, round=0)
+    cur = PeerVoteCursor()
+    cur.reset(height)
+    return cur, rs, prs, precommits, votes, _vote_key
+
+
+def test_peer_vote_cursor_delivers_then_retransmits_then_acks():
+    cur, rs, prs, precommits, votes, _vote_key = _cursor_world()
+    for v in votes[:2]:
+        precommits.add_vote(v)
+    cur.ingest(rs, prs)
+    due = cur.due_votes(prs, now=10.0, budget=16)
+    assert {_vote_key(v) for v in due} == {
+        _vote_key(v) for v in votes[:2]
+    }
+    # immediately after sending: nothing due (retransmit window)
+    assert cur.due_votes(prs, now=10.1, budget=16) == []
+    # window elapsed, still unacked: retransmit
+    again = cur.due_votes(prs, now=10.4, budget=16)
+    assert len(again) == 2
+    # peer acks one: it drops from pending and never resends
+    prs.has_votes.add(_vote_key(votes[0]))
+    later = cur.due_votes(prs, now=11.0, budget=16)
+    assert [_vote_key(v) for v in later] == [_vote_key(votes[1])]
+    assert _vote_key(votes[0]) not in cur.pending
+
+
+def test_peer_vote_cursor_is_incremental_not_rescanning():
+    """A tick after steady state reads ZERO log entries — the O(new)
+    contract that replaced the O(validators) rescan."""
+    cur, rs, prs, precommits, votes, _vote_key = _cursor_world()
+    for v in votes:
+        precommits.add_vote(v)
+        prs.has_votes.add(_vote_key(v))  # peer already has everything
+    cur.ingest(rs, prs)
+    cur.due_votes(prs, now=1.0, budget=16)
+    assert cur.pending == {}  # acked: staged nothing
+    read_before = dict(cur._read)
+    cur.ingest(rs, prs)  # steady-state tick
+    assert cur._read == read_before
+    assert cur.due_votes(prs, now=2.0, budget=16) == []
+
+
+def test_peer_vote_cursor_resets_on_height_advance():
+    cur, rs, prs, precommits, votes, _vote_key = _cursor_world()
+    precommits.add_vote(votes[0])
+    cur.ingest(rs, prs)
+    assert cur.pending
+    cur.reset(rs.height + 1)
+    assert cur.pending == {} and cur._read == {}
+    assert cur.height == rs.height + 1
+
+
+def test_vote_set_log_appends_in_accept_order():
+    cur, rs, prs, precommits, votes, _vote_key = _cursor_world()
+    precommits.add_vote(votes[2])
+    precommits.add_vote(votes[0])
+    assert [v.validator_index for v in precommits.vote_log] == [2, 0]
+    # duplicates never re-append
+    precommits.add_vote(votes[2])
+    assert [v.validator_index for v in precommits.vote_log] == [2, 0]

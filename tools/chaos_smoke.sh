@@ -72,6 +72,23 @@ JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" \
     --schedule "$TRACE_DIR/lockinv_schedule.json" --expect-lock-inversion \
     --trace-dump "$TRACE_DIR/lockinv"
 
+echo "== chaos smoke: planted quadratic site must be FLAGGED by the scaling probe =="
+# complexity-plane checker validation (docs/LINT.md "Complexity
+# rules"): the nemesis runs the committee-scaling probe mid-schedule
+# with a deliberate O(n^2) plant; the probe must fit its exponent
+# over budget (exit 1 on a miss), while the real fixed sites must
+# stay under theirs (an un-injected breach is a violation)
+cat > "$TRACE_DIR/scaling_schedule.json" <<'EOF'
+[
+  {"action": "scaling_probe", "at_height": 2, "inject_quadratic": true},
+  {"action": "crash", "at_height": 3, "node": 1},
+  {"action": "restart", "after_s": 0.5, "node": 1}
+]
+EOF
+JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" \
+    --schedule "$TRACE_DIR/scaling_schedule.json" --expect-scaling-violation \
+    --trace-dump "$TRACE_DIR/scaling"
+
 echo "== chaos smoke: byzantine corruption must be DETECTED =="
 # --trace-dump keeps the EXPECTED violation's auto-dump inside the
 # trap-cleaned dir instead of leaking a /tmp/chaos_trace_* per run
