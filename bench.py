@@ -1175,6 +1175,216 @@ def bench_live() -> dict:
     }
 
 
+def bench_lifecycle() -> dict:
+    """Storage lifecycle plane overhead gate (ISSUE 17,
+    docs/STORAGE.md): the SAME 4-validator LocalNet workload with the
+    retention plane OFF (immortal storage, reference semantics) vs ON
+    (retention-windowed pruning + node-side snapshots on a live
+    background cadence). Two gates:
+
+    - throughput — lifecycle ON must cost < 5% blocks/s vs OFF
+      (pass-interleaved medians, the bench_live defense against this
+      box's throttling spikes);
+    - placement — every ``storage.prune`` / ``storage.snapshot`` span
+      must have run OFF the consensus event loop: span tid is the
+      plane's own ``retention`` timeline and the plane's recorded
+      reconcile thread ident differs from the loop thread's.
+
+    The ON leg must actually do lifecycle work to be an honest
+    ablation: the run asserts blocks were pruned, the base advanced,
+    and a snapshot was persisted."""
+    import asyncio
+    import shutil
+    import statistics
+    import tempfile
+    import threading
+
+    from cometbft_tpu.config.config import test_config
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.node.inprocess import (
+        LocalNet,
+        build_node,
+        make_genesis,
+    )
+
+    n_nodes = int(os.environ.get("BENCH_LIFECYCLE_NODES", "4"))
+    heights = int(os.environ.get("BENCH_LIFECYCLE_HEIGHTS", "24"))
+    txs_per_height = int(os.environ.get("BENCH_LIFECYCLE_TXS", "10"))
+    repeats = int(os.environ.get("BENCH_LIFECYCLE_REPEATS", "3"))
+    max_overhead = float(
+        os.environ.get("BENCH_LIFECYCLE_MAX_OVERHEAD", "0.05")
+    )
+
+    def run_once(lifecycle: bool) -> dict:
+        base = tempfile.mkdtemp(prefix="bench_lifecycle_")
+        old_backend = crypto_batch._default_backend
+        crypto_batch.set_default_backend("cpu")
+        try:
+            gen, pvs = make_genesis(n_nodes, chain_id="bench-lifecycle")
+            nodes = []
+            for i, pv in enumerate(pvs):
+                home = os.path.join(base, f"n{i}")
+                os.makedirs(home, exist_ok=True)
+                cfg = test_config(home)
+                cfg.base.moniker = f"n{i}"
+                cfg.base.db_backend = "sqlite"  # real persist leg
+                cfg.consensus.skip_timeout_commit = True
+                cfg.consensus.timeout_commit_s = 0.0
+                cfg.tx_index.indexer = "null"
+                if lifecycle:
+                    cfg.storage.retain_blocks = 8
+                    cfg.storage.retain_states = 8
+                    cfg.storage.prune_batch = 4
+                    cfg.storage.prune_interval_s = 0.2
+                    cfg.storage.snapshot_interval = 10
+                    cfg.storage.snapshot_keep_recent = 2
+                nodes.append(
+                    build_node(gen, pv, config=cfg, home=home, wal=True)
+                )
+            net = LocalNet(nodes)
+
+            async def main():
+                loop_tid = threading.get_ident()
+                await net.start()
+                for n in nodes:
+                    await n.retention.start()
+
+                async def feed():
+                    i = 0
+                    while True:
+                        for _ in range(txs_per_height):
+                            try:
+                                nodes[i % n_nodes].mempool.check_tx(
+                                    b"life-%08d=%04d" % (i, i % 7919)
+                                )
+                            except Exception:
+                                pass
+                            i += 1
+                        await asyncio.sleep(0.05)
+
+                feeder = asyncio.ensure_future(feed())
+                t0 = time.perf_counter()
+                await net.wait_for_height(heights, timeout=600)
+                wall = time.perf_counter() - t0
+                feeder.cancel()
+                for n in nodes:
+                    await n.retention.stop()
+                await net.stop()
+                return wall, loop_tid
+
+            wall, loop_tid = asyncio.run(main())
+            # agreement over the surviving window: pruned nodes no
+            # longer hold blocks below their base, so compare from the
+            # highest base across the net
+            lo = max(n.block_store.base() for n in nodes)
+            for h in range(lo, heights + 1):
+                hs = {
+                    n.block_store.load_block_meta(h).block_id.hash
+                    for n in nodes
+                }
+                assert len(hs) == 1, f"disagreement at height {h}"
+            storage_spans = []
+            for n in nodes:
+                storage_spans.extend(
+                    e
+                    for e in n.tracer.snapshot()
+                    if e["name"].startswith("storage.")
+                )
+            out = {
+                "wall_s": wall,
+                "blocks_per_s": heights / wall,
+                "base": lo,
+                "storage_spans": len(storage_spans),
+            }
+            if lifecycle:
+                # the ablation is honest only if lifecycle work
+                # actually ran: blocks pruned, base advanced, a
+                # snapshot held
+                pruned = sum(
+                    n.retention.pruned_blocks_total for n in nodes
+                )
+                assert pruned > 0, "lifecycle leg never pruned a block"
+                assert lo > 1, "lifecycle leg never advanced the base"
+                snaps = sum(
+                    len(n.snapshot_store.heights()) for n in nodes
+                )
+                assert snaps > 0, (
+                    "lifecycle leg never persisted a snapshot"
+                )
+                # placement gate: prune work must never run on the
+                # consensus event loop. Two independent witnesses —
+                # every storage span sits on the plane's own trace
+                # timeline, and the reconcile worker's OS thread
+                # differs from the loop thread.
+                off_tid = [
+                    e for e in storage_spans if e["tid"] != "retention"
+                ]
+                assert not off_tid, (
+                    f"storage spans off the retention timeline: "
+                    f"{sorted({e['name'] for e in off_tid})}"
+                )
+                for n in nodes:
+                    ti = n.retention.last_thread_ident
+                    assert ti is not None, "retention never reconciled"
+                    assert ti != loop_tid, (
+                        "a reconcile pass ran ON the event loop thread"
+                    )
+                out["pruned_blocks"] = pruned
+                out["snapshots"] = snaps
+            else:
+                assert not storage_spans, (
+                    "lifecycle OFF leg emitted storage spans"
+                )
+            for n in nodes:
+                n.close_stores()
+            return out
+        finally:
+            crypto_batch.set_default_backend(old_backend)
+            shutil.rmtree(base, ignore_errors=True)
+
+    run_once(lifecycle=False)  # warm pass (sqlite, allocator, pools)
+    runs = {"off": [], "on": []}
+    for _ in range(repeats):
+        runs["off"].append(run_once(lifecycle=False))
+        runs["on"].append(run_once(lifecycle=True))
+    med = {
+        mode: round(
+            statistics.median(r["blocks_per_s"] for r in rs), 2
+        )
+        for mode, rs in runs.items()
+    }
+    overhead = round(1.0 - med["on"] / med["off"], 4)
+    if overhead > max_overhead:
+        raise AssertionError(
+            f"lifecycle overhead {overhead:.1%} exceeds the "
+            f"{max_overhead:.0%} gate (on={med['on']} "
+            f"off={med['off']} blocks/s)"
+        )
+    last = runs["on"][-1]
+    return {
+        "rate": med["on"],
+        "nodes": n_nodes,
+        "heights": heights,
+        "repeats_per_mode": repeats,
+        "blocks_per_s_off": med["off"],
+        "blocks_per_s_on": med["on"],
+        "overhead": overhead,
+        "overhead_gate": max_overhead,
+        "pruned_blocks": last["pruned_blocks"],
+        "snapshots": last["snapshots"],
+        "base": last["base"],
+        "storage_spans": last["storage_spans"],
+        "note": (
+            "4-node LocalNet, retention plane OFF vs ON (retain 8, "
+            "snapshot every 10, 0.2s cadence); pass-interleaved "
+            "medians; agreement asserted over the surviving window; "
+            "every storage.prune/storage.snapshot span proven off "
+            "the consensus loop (retention timeline + worker-thread "
+            "ident)"
+        ),
+    }
+
+
 def bench_serve() -> dict:
     """Light-client serving plane storm (ISSUE 13, docs/PERF.md
     "Light-client serving plane"): 1k+ concurrent light sessions
@@ -2557,6 +2767,7 @@ def main() -> None:
             "pipeline",
             "ingest",
             "live",
+            "lifecycle",
             "serve",
             "rpcfanout",
             "scaling",
@@ -2689,6 +2900,11 @@ def main() -> None:
         # batched — the first optimization leg behind the PR 7 quorum
         # waterfall
         run_config("live", bench_live)
+    if "lifecycle" in todo:
+        # host-only storage lifecycle ablation (ISSUE 17): 4-node
+        # LocalNet, retention plane OFF vs ON — <5% overhead gate +
+        # proof every prune/snapshot span ran off the consensus loop
+        run_config("lifecycle", bench_lifecycle)
     if "serve" in todo:
         # host-only light-client serving storm (ISSUE 13): 1k-session
         # baseline vs shared-cache vs coalesced ablation + a live

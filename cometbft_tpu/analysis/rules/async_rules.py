@@ -768,3 +768,74 @@ def uncoalesced_verify_in_light(ctx: FileContext) -> List[Finding]:
             )
         )
     return out
+
+
+# Storage-plane packages where a scan-driven delete loop is the
+# crash-consistency + latency hazard ASY120 targets (the hot planes
+# plus the stores the retention plane prunes).
+_ASY120_PREFIXES = _HOT_PLANE_PREFIXES + (
+    "cometbft_tpu/store/",
+    "cometbft_tpu/state/",
+    "cometbft_tpu/evidence/",
+    "cometbft_tpu/light/",
+)
+
+# iterator spellings that walk a DB keyspace: a loop over one of
+# these has data-dependent (unbounded) trip count by construction
+_DB_SCAN_NAMES = {"iter_prefix", "iter_range", "iter_all"}
+
+
+def _scan_driven(iter_expr: ast.expr) -> str | None:
+    """The scan spelling when ``for ... in <iter_expr>`` walks a DB
+    keyspace (directly, or through list()/sorted()/enumerate())."""
+    for node in ast.walk(iter_expr):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func) or ""
+            last = name.rsplit(".", 1)[-1]
+            if last in _DB_SCAN_NAMES:
+                return name
+    return None
+
+
+@rule(
+    "ASY120",
+    "unbounded-delete-in-hot-plane",
+    "a DB-scan loop issuing one-at-a-time .delete() calls in a "
+    "storage/hot-plane module: unbounded trip count, and a crash "
+    "mid-loop leaves partial deletes with no base marker — "
+    "accumulate and commit ONE atomic write_batch (deletes + marker "
+    "advance together), sliced in bounded steps (store/retention.py)",
+)
+def unbounded_delete_in_hot_plane(ctx: FileContext) -> List[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if not any(p in path for p in _ASY120_PREFIXES):
+        return []
+    out: List[Finding] = []
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, ast.For):
+            continue
+        scan = _scan_driven(loop.iter)
+        if scan is None:
+            continue
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func) or ""
+            if not name.endswith(".delete"):
+                continue
+            out.append(
+                Finding(
+                    ctx.path, node.lineno, node.col_offset,
+                    "ASY120", "unbounded-delete-in-hot-plane",
+                    f"`{name}(...)` inside a loop over `{scan}`: the "
+                    "scan's trip count is data-dependent (every row "
+                    "under the prefix) and each delete is an "
+                    "independent write — a crash mid-loop strands "
+                    "partial deletes with no marker recording how far "
+                    "it got, and the store lock is held for the whole "
+                    "scan. Collect doomed keys, then commit deletes + "
+                    "base-marker advance in ONE bounded write_batch "
+                    "(the store/retention.py slicing discipline)",
+                )
+            )
+    return out

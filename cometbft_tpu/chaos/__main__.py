@@ -3,6 +3,7 @@
     python -m cometbft_tpu.chaos --seed 1337 [--nodes 4]
         [--schedule sched.json] [--byzantine N] [--json out.json]
     python -m cometbft_tpu.chaos matrix --seed 1337 --count 5
+    python -m cometbft_tpu.chaos soak --heights 10000 --step 50
 
 Exit code 0 when every invariant holds, 1 on any violation (the
 report — seed, fault trace, per-link decisions — prints either way),
@@ -31,6 +32,10 @@ def main(argv=None) -> int:
         from .matrix import matrix_main
 
         return matrix_main(argv[1:])
+    if argv and argv[0] == "soak":
+        from .soak import soak_main
+
+        return soak_main(argv[1:])
     ap = argparse.ArgumentParser(prog="python -m cometbft_tpu.chaos")
     ap.add_argument("--seed", type=int, default=1337)
     ap.add_argument("--nodes", type=int, default=4)

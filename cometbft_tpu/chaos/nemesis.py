@@ -232,6 +232,17 @@ class Nemesis:
             rec = await net.wal_torn_tail(ev.node, garbage)
             rec["garbage_sha8"] = hashlib.sha256(garbage).hexdigest()[:8]
             return rec
+        if ev.action == "crash_mid_prune":
+            # the abort batch index comes from the MASTER rng unless
+            # pinned: schedule execution is sequential, so the crash
+            # lands at a deterministic batch boundary per (seed,
+            # schedule) — the byte-identical-replay contract
+            abort_after = ev.abort_after
+            if abort_after is None:
+                abort_after = net.table.rng.randint(1, 3)
+            return await net.crash_mid_prune(ev.node, abort_after)
+        if ev.action == "snapshot_during_prune":
+            return await net.snapshot_during_prune(ev.node)
         if ev.action == "byzantine":
             # tamper bytes come from the MASTER rng: schedule execution
             # is sequential, so the draw is deterministic per run

@@ -449,11 +449,17 @@ class ChaosNet:
                 "no running RPC sources (build ChaosNet with "
                 "enable_rpc=True and keep a source alive)",
             )
-        trust = sources[0].node.parts.block_store.load_block(1)
+        # trust root anchored at the source's BASE, not block 1: a
+        # retention-pruned source (store/retention.py) no longer
+        # holds the early heights, and a joiner bootstrapping from it
+        # must trust from a height the source can actually serve
+        src_store = sources[0].node.parts.block_store
+        trust_h = max(1, src_store.base())
+        trust = src_store.load_block(trust_h)
         if trust is None:
             raise InvariantViolation(
-                "statesync-join", "source has no block 1 for the "
-                "trust root"
+                "statesync-join",
+                f"source has no block {trust_h} for the trust root",
             )
         idx = len(self.nodes)
         name = f"j{idx}"
@@ -465,7 +471,7 @@ class ChaosNet:
             "statesync.rpc_servers": [
                 s.node.rpc_server.listen_addr for s in sources[:2]
             ],
-            "statesync.trust_height": 1,
+            "statesync.trust_height": trust_h,
             "statesync.trust_hash": bytes(trust.hash()).hex(),
             # discovery exits as soon as ONE snapshot lands, so this
             # only bounds the FAILURE case — and on a contended box
@@ -554,6 +560,202 @@ class ChaosNet:
             "torn_bytes": appended,
             "was_running": was_running,
         }
+
+    async def crash_mid_prune(self, idx: int, abort_after: int) -> dict:
+        """Abort a retention reconcile pass after ``abort_after``
+        bounded batches (the in-process stand-in for the
+        ``retention-prune-batch`` fail_point power cut), then crash +
+        restart the node and run ONE resume pass. The crash-safety
+        contract under test (store/retention.py): every committed
+        batch carried its own base-marker advance, so the partial
+        pass is a consistent (just less-pruned) store, the WAL-replay
+        checker holds the restart to the no-amnesia bar, and the
+        resume pass idempotently re-computes the same targets and
+        finishes the job — no gap, no double-delete, no wedge."""
+        cn = self.nodes[idx]
+        if cn.node is None:
+            raise InvariantViolation(
+                "crash-mid-prune", f"{cn.name} is not running"
+            )
+        ret = cn.node.parts.retention
+        if ret is None or not ret.enabled:
+            raise InvariantViolation(
+                "crash-mid-prune",
+                f"{cn.name} has no retention plane (schedule a "
+                "lifecycle run: [storage] knobs are auto-set when "
+                "this action is present)",
+            )
+
+        class _PruneAborted(RuntimeError):
+            pass
+
+        calls = 0
+
+        def hook():
+            nonlocal calls
+            calls += 1
+            if calls > abort_after:
+                raise _PruneAborted()
+
+        ret.batch_hook = hook
+        aborted = False
+        try:
+            try:
+                await asyncio.to_thread(ret.reconcile_once)
+            except _PruneAborted:
+                aborted = True
+        finally:
+            ret.batch_hook = None
+        bs = cn.node.parts.block_store
+        mid_base = bs.base()
+        mid_height = bs.height()
+        await self.crash(idx)
+        await self.restart(idx)
+        node = cn.node
+        ret2 = node.parts.retention
+        resumed = await asyncio.to_thread(ret2.reconcile_once)
+        bs2 = node.parts.block_store
+        base2 = bs2.base()
+        if base2 < mid_base:
+            raise InvariantViolation(
+                "crash-mid-prune",
+                f"{cn.name} base regressed across crash/resume: "
+                f"{base2} < {mid_base}",
+            )
+        # the retained range must be fully readable and the pruned
+        # range fully gone — a half-applied delete batch would break
+        # one side or the other
+        probe = max(1, base2)
+        if bs2.height() >= probe and bs2.load_block(probe) is None:
+            raise InvariantViolation(
+                "crash-mid-prune",
+                f"{cn.name} block {probe} (the base) unreadable "
+                "after resume",
+            )
+        if base2 > 1 and bs2.load_block(base2 - 1) is not None:
+            raise InvariantViolation(
+                "crash-mid-prune",
+                f"{cn.name} block {base2 - 1} still present below "
+                f"base {base2} after resume",
+            )
+        ti = node.parts.tx_indexer
+        idx_base = ti.base_height() if ti is not None else 0
+        # trace determinism (the conn_kill rule): the record carries
+        # the CONFIGURED/seeded parameters only — the bases and prune
+        # counts depend on how far the live network committed during
+        # the crash/restart window (wall-clock), so they go to the log
+        _log.info(
+            "crash_mid_prune detail",
+            node=cn.name,
+            aborted=aborted,
+            mid_base=mid_base,
+            mid_height=mid_height,
+            resumed_base=base2,
+            index_base=idx_base,
+            resumed=resumed,
+        )
+        return {"node": cn.name, "abort_after": abort_after}
+
+    async def snapshot_during_prune(self, idx: int) -> dict:
+        """Park a retention reconcile pass mid-batch, then serve the
+        node's newest on-disk snapshot chunk-by-chunk — under the
+        in-flight-serve pin — while the prune pass is live, and
+        verify the reassembled blob hashes to the advertised hash.
+        The floor contract under test (store/retention.py): a joiner
+        mid-download must never see a snapshot rot out from under it,
+        prune pass or not."""
+        import hashlib as _hashlib
+        import threading as _threading
+
+        cn = self.nodes[idx]
+        if cn.node is None:
+            raise InvariantViolation(
+                "snapshot-during-prune", f"{cn.name} is not running"
+            )
+        node = cn.node
+        ret = node.parts.retention
+        snaps_store = node.parts.snapshot_store
+        if (
+            ret is None
+            or not ret.enabled
+            or snaps_store is None
+        ):
+            raise InvariantViolation(
+                "snapshot-during-prune",
+                f"{cn.name} has no retention plane + snapshot store",
+            )
+        # one plain pass first so a snapshot is guaranteed held
+        # (mirrors the app's newest advertised snapshot to disk)
+        await asyncio.to_thread(ret.reconcile_once)
+        snaps = snaps_store.list_snapshots()
+        if not snaps:
+            raise InvariantViolation(
+                "snapshot-during-prune",
+                f"{cn.name} holds no snapshot (trigger this action "
+                "at a height past the app's snapshot cadence)",
+            )
+        newest = snaps[-1]
+        parked = _threading.Event()
+        release = _threading.Event()
+        first = [True]
+
+        def hook():
+            if first[0]:
+                first[0] = False
+                parked.set()
+                release.wait(timeout=10.0)
+
+        ret.batch_hook = hook
+        try:
+            pass_task = asyncio.ensure_future(
+                asyncio.to_thread(ret.reconcile_once)
+            )
+            # wait (bounded) for the pass to park mid-batch; a pass
+            # with nothing left to prune never parks — the serve
+            # check below still runs, just not concurrently
+            parked_hit = await asyncio.to_thread(parked.wait, 5.0)
+
+            def serve() -> bytes:
+                with ret.serving(newest.height):
+                    parts = []
+                    for i in range(newest.chunks):
+                        parts.append(
+                            node.parts.proxy.snapshot
+                            .load_snapshot_chunk(
+                                newest.height, newest.format, i
+                            )
+                            or b""
+                        )
+                    return b"".join(parts)
+
+            blob = await asyncio.to_thread(serve)
+        finally:
+            release.set()
+            await pass_task
+            ret.batch_hook = None
+        if _hashlib.sha256(blob).digest() != newest.hash:
+            raise InvariantViolation(
+                "snapshot-during-prune",
+                f"{cn.name} snapshot {newest.height} served during "
+                "an active prune pass did not hash-verify",
+            )
+        if snaps_store.latest_height() < newest.height:
+            raise InvariantViolation(
+                "snapshot-during-prune",
+                f"{cn.name} snapshot {newest.height} rotated away "
+                "while pinned by an in-flight serve",
+            )
+        # trace determinism: snapshot height/chunk count and whether
+        # the pass actually parked depend on the momentary chain
+        # height (wall-clock) — log them, record only the verdict
+        _log.info(
+            "snapshot_during_prune detail",
+            node=cn.name,
+            snapshot_height=newest.height,
+            chunks=newest.chunks,
+            concurrent=bool(parked_hit),
+        )
+        return {"node": cn.name, "verified": True}
 
     def kill_conns(
         self,
@@ -1069,6 +1271,29 @@ async def run_schedule(
     at end of run; a breach dumps traces exactly like an invariant
     violation (report.budget_ok goes False, the CLI exits nonzero)."""
     table = LinkTable(seed, fuzz_config=fuzz_config)
+    # lifecycle actions need the retention plane live on every node:
+    # small windows, tiny batches (so an abort lands mid-pass), the
+    # kvstore snapshot cadence mirrored to disk, and a background
+    # interval long enough that only the nemesis drives reconciles —
+    # deterministic counters per (seed, schedule)
+    if any(
+        e.action in ("crash_mid_prune", "snapshot_during_prune")
+        for e in schedule.events
+    ):
+        _inner_hook = config_hook
+
+        def config_hook(cfg, _inner=_inner_hook):  # noqa: F811
+            if _inner is not None:
+                _inner(cfg)
+            s = cfg.storage
+            s.retain_blocks = 4
+            s.retain_states = 6
+            s.retain_index = 4
+            s.prune_batch = 2
+            s.prune_interval_s = 3600.0
+            s.snapshot_interval = 10
+            s.snapshot_keep_recent = 2
+
     if enable_rpc is None:
         # the statesync joiner bootstraps over the sources' RPC, and
         # the subscriber storm needs a websocket endpoint — switch

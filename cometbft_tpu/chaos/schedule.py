@@ -112,6 +112,29 @@ deterministic and fast):
                       lock_inversion's probe locks) is planted and
                       the run asserts the probe FLAGS it — the same
                       checker-validation discipline.
+``crash_mid_prune``   ``node=i``: abort a retention reconcile pass
+                      after ``abort_after`` bounded batches (drawn
+                      from the MASTER rng when unset — the crash
+                      lands at a seeded batch boundary), power-cut
+                      the node, restart it and run one resume pass.
+                      Every batch commits its deletes + base-marker
+                      advance atomically (store/retention.py), so
+                      the partial pass must read as a consistent
+                      less-pruned store, the restart must pass the
+                      WAL-replay checker, and the resume must finish
+                      the same targets idempotently. Requires the
+                      lifecycle storage knobs — run_schedule auto-
+                      sets them when this action is scheduled.
+``snapshot_during_prune`` ``node=i``: park a reconcile pass
+                      mid-batch, then serve the node's newest
+                      on-disk snapshot chunk-by-chunk under the
+                      in-flight-serve pin while the pass is live;
+                      the reassembled blob must hash-verify and the
+                      snapshot must not rotate away while pinned
+                      (the serve-floor contract). Trigger past the
+                      app's snapshot cadence (kvstore: height >=
+                      11). Auto-sets the storage knobs like
+                      ``crash_mid_prune``.
 ====================  =================================================
 
 Schedules round-trip through JSON so failing runs can be archived and
@@ -129,7 +152,7 @@ ACTIONS = (
     "partition", "heal", "set_link", "crash", "restart", "byzantine",
     "stall", "crash_wave", "statesync_join", "valset_churn",
     "wal_torn_tail", "conn_kill", "reconnect_storm", "lock_inversion",
-    "scaling_probe",
+    "scaling_probe", "crash_mid_prune", "snapshot_during_prune",
 )
 
 
@@ -159,6 +182,8 @@ class FaultEvent:
     hold_s: float = 1.2  # reconnect_storm: partition hold per cycle
     gap_s: float = 0.8  # reconnect_storm: healed gap between cycles
     inject_quadratic: bool = False  # scaling_probe: plant an O(n^2) site
+    abort_after: Optional[int] = None  # crash_mid_prune: batches before
+    # the abort (None = seeded draw from the MASTER rng)
 
     def __post_init__(self):
         if self.action not in ACTIONS:
@@ -172,6 +197,7 @@ class FaultEvent:
         if self.action in (
             "crash", "restart", "byzantine", "valset_churn",
             "wal_torn_tail", "conn_kill", "reconnect_storm",
+            "crash_mid_prune", "snapshot_during_prune",
         ) and self.node is None:
             raise ValueError(f"{self.action}: node required")
         if self.action == "reconnect_storm" and self.cycles < 1:

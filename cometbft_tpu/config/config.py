@@ -193,6 +193,25 @@ class ConsensusConfig:
 @dataclass
 class StorageConfig:
     discard_abci_responses: bool = False
+    # --- retention plane (store/retention.py, docs/STORAGE.md) -----
+    # blocks/states/index rows kept behind the committed head; 0 =
+    # retain everything (reference semantics — pruning entirely off).
+    # The effective prune target is min-reconciled with the app's
+    # retain_height from ABCI Commit; node-side windows only ever
+    # TIGHTEN what the app allows, never override it upward.
+    retain_blocks: int = 0
+    retain_states: int = 0
+    retain_index: int = 0
+    # background reconcile cadence + per-batch height budget: each
+    # batch is ONE atomic write_batch (deletes + base-marker advance)
+    # so a crash mid-prune resumes idempotently
+    prune_interval_s: float = 10.0
+    prune_batch: int = 100
+    # node-side snapshot generation (statesync/snapshots.py): take an
+    # on-disk chunked app snapshot every `snapshot_interval` heights
+    # (0 = off), rotating to the newest `snapshot_keep_recent`
+    snapshot_interval: int = 0
+    snapshot_keep_recent: int = 2
 
 
 @dataclass

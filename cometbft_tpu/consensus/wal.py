@@ -51,7 +51,7 @@ import threading
 import time
 import zlib
 from dataclasses import dataclass
-from typing import Callable, Iterator, List, Optional
+from typing import Callable, Iterator, List, Optional, Tuple
 
 from ..analysis.runtime import sanitized_lock
 from ..trace import NOOP as TRACE_NOOP
@@ -200,6 +200,38 @@ def _group_files(path: str) -> List[str]:
     if os.path.exists(path):
         out.append(path)
     return out
+
+
+def prune_group_below(path: str, height: int) -> Tuple[int, int]:
+    """Delete sealed (rotated) WAL files whose every record is below
+    ``height``; returns (files_deleted, bytes_freed).
+
+    The retention plane's WAL leg (store/retention.py): replay after
+    a restart never needs records below the retained end-height, so a
+    rotated file whose max recorded height is < height is dead
+    weight. The HEAD file is never deleted (it is open for append),
+    and an unreadable/empty rotated file is left alone — pruning must
+    never turn a corrupt-but-diagnosable group into a gap. Deletion
+    goes oldest-first and stops at the first file that must stay, so
+    the group never ends up with a hole in its rotation order."""
+    freed_files = freed_bytes = 0
+    for p in _group_files(path):
+        if p == path:
+            break  # never the head
+        max_h = None
+        for msg in WAL._iter_file(p):
+            if msg.height > (max_h or 0):
+                max_h = msg.height
+        if max_h is None or max_h >= height:
+            break  # unreadable or still-needed: stop, keep the rest
+        try:
+            sz = os.path.getsize(p)
+            os.remove(p)
+        except OSError:
+            break
+        freed_files += 1
+        freed_bytes += sz
+    return freed_files, freed_bytes
 
 
 class WAL:

@@ -193,7 +193,12 @@ class EvidencePool:
             for ev in block_evidence:
                 key = ev.hash()
                 self._committed.add(key)
-                self.db.set(b"EV:comm:" + key, b"\x01")
+                # value = the committing height: prune_below() can
+                # age out markers without decoding evidence bodies
+                self.db.set(
+                    b"EV:comm:" + key,
+                    state.last_block_height.to_bytes(8, "big"),
+                )
                 if key in self._pending:
                     del self._pending[key]
                     self.db.delete(b"EV:pend:" + key)
@@ -203,6 +208,32 @@ class EvidencePool:
                 if state.last_block_height - ev.height() > params.max_age_num_blocks:
                     del self._pending[key]
                     self.db.delete(b"EV:pend:" + key)
+
+    def prune_below(self, height: int) -> int:
+        """Retention-plane leg (store/retention.py): drop committed-
+        evidence markers below ``height``, clamped so nothing inside
+        the evidence max-age window ever goes — a marker still inside
+        the window is what stops a committed duplicate from being
+        re-proposed (check_evidence), so only markers that verify()
+        would reject as expired anyway are prunable. One bounded
+        batch; legacy b"\\x01" markers (no height) are kept."""
+        state = self.state_store.load()
+        if state is not None:
+            max_age = state.consensus_params.evidence.max_age_num_blocks
+            height = min(height, state.last_block_height - max_age)
+        if height <= 0:
+            return 0
+        with self._lock:
+            deletes = []
+            for k, v in self.db.iter_prefix(b"EV:comm:"):
+                h = int.from_bytes(v, "big") if len(v) == 8 else 0
+                if h and h < height:
+                    deletes.append(k)
+            if deletes:
+                self.db.write_batch([], deletes)
+                for k in deletes:
+                    self._committed.discard(k[len(b"EV:comm:"):])
+        return len(deletes)
 
     def size(self) -> int:
         with self._lock:

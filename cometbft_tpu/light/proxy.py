@@ -24,7 +24,7 @@ from aiohttp import web
 
 from ..crypto import merkle
 from ..rpc import encoding as enc
-from ..rpc.client import HTTPClient
+from ..rpc.client import HTTPClient, RPCClientError
 from ..rpc.core import _bytes_param
 from ..utils import codec
 from .client import Client
@@ -408,6 +408,23 @@ class LightProxy:
             )
         except asyncio.CancelledError:
             raise  # server stop cancels in-flight handlers
+        except RPCClientError as e:
+            # forward the primary's structured error VERBATIM —
+            # above all the retention plane's "height pruned
+            # (base=N)" verdict (rpc/core.py _pruned_error), whose
+            # machine-readable data field a light client uses to
+            # redirect the query to an archive node
+            return web.json_response(
+                {
+                    "jsonrpc": "2.0",
+                    "id": id_,
+                    "error": {
+                        "code": e.code,
+                        "message": e.message,
+                        "data": e.data,
+                    },
+                }
+            )
         except Exception as e:
             return web.json_response(
                 {

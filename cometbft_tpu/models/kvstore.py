@@ -20,9 +20,26 @@ VALIDATOR_TX_PREFIX = b"val:"
 
 
 class KVStoreApplication(abci.Application):
-    def __init__(self, persist_path: str = None, prove: bool = False):
+    def __init__(
+        self,
+        persist_path: str = None,
+        prove: bool = False,
+        retain_height: int = 0,
+        snapshot_store=None,
+    ):
         self.state: Dict[bytes, bytes] = {}
         self.height = 0
+        # app-driven pruning knob (ISSUE 17): Commit advertises
+        # retain_height = height - retain_height so the node's
+        # retention plane (store/retention.py) can exercise the
+        # min-wins reconciliation. 0 = reference semantics (the app
+        # allows no pruning).
+        self.retain_height = retain_height
+        # on-disk snapshot seam (statesync/snapshots.py): when set,
+        # snapshots persist through the SnapshotStore instead of the
+        # RAM-only dict — they survive restarts and a restarted node
+        # can still seed joiners. None = reference RAM semantics.
+        self.snapshot_store = snapshot_store
         # prove=True: the app hash becomes SHA-256(height || merkle
         # root over the sorted KV leaves) and Query(prove=True) returns
         # proof ops a light client can check against a verified AppHash
@@ -362,7 +379,11 @@ class KVStoreApplication(abci.Application):
         if self.height % 10 == 0:
             self._take_snapshot()
         self._persist()
-        return abci.ResponseCommit(retain_height=0)
+        return abci.ResponseCommit(
+            retain_height=max(0, self.height - self.retain_height)
+            if self.retain_height > 0
+            else 0
+        )
 
     # --- snapshots ----------------------------------------------------
 
@@ -377,11 +398,21 @@ class KVStoreApplication(abci.Application):
                 },
             }
         ).encode()
+        if self.snapshot_store is not None:
+            # disk-backed seam: chunk size matches the wire chunking
+            # so served chunks stay byte-identical to the RAM era
+            self.snapshot_store.save(
+                self.height, blob, format_=1,
+                chunk_size=self.SNAPSHOT_CHUNK,
+            )
+            return
         self.snapshots[self.height] = blob
         while len(self.snapshots) > 4:
             del self.snapshots[min(self.snapshots)]
 
     def list_snapshots(self):
+        if self.snapshot_store is not None:
+            return self.snapshot_store.list_snapshots()
         out = []
         for h, blob in sorted(self.snapshots.items()):
             nchunks = (len(blob) + self.SNAPSHOT_CHUNK - 1) // self.SNAPSHOT_CHUNK
@@ -396,6 +427,8 @@ class KVStoreApplication(abci.Application):
         return out
 
     def load_snapshot_chunk(self, height, format_, chunk):
+        if self.snapshot_store is not None:
+            return self.snapshot_store.load_chunk(height, format_, chunk)
         blob = self.snapshots.get(height, b"")
         off = chunk * self.SNAPSHOT_CHUNK
         return blob[off : off + self.SNAPSHOT_CHUNK]

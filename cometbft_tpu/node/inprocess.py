@@ -87,6 +87,13 @@ class NodeParts:
     # per-node tracing plane (trace/, docs/TRACE.md); NOOP when
     # [instrumentation] trace_enabled = false
     tracer: object = TRACE_NOOP
+    # storage lifecycle plane (store/retention.py, ISSUE 17): always
+    # constructed, a no-op until any [storage] retention/snapshot
+    # knob is set; Node.start spawns its reconcile loop
+    retention: object = None
+    # on-disk chunked snapshots (statesync/snapshots.py); None when
+    # snapshot generation is off
+    snapshot_store: object = None
 
     def close_stores(self) -> None:
         """Release every store handle (the native logdb backend holds
@@ -147,6 +154,20 @@ def build_node(
         from ..crypto import batch as crypto_batch
 
         crypto_batch.set_default_backend(config.crypto.batch_backend)
+    # node-side snapshot persistence (statesync/snapshots.py): built
+    # whenever snapshot generation is on so a locally-constructed
+    # kvstore can write straight through the disk seam (an injected
+    # app is covered by the retention plane's ABCI mirror instead)
+    snapshot_store = None
+    if config.storage.snapshot_interval > 0:
+        from ..statesync.snapshots import SnapshotStore
+
+        snapshot_store = SnapshotStore(
+            os.path.join(home, "snapshots")
+            if home
+            else tempfile.mkdtemp(prefix="snapshots_"),
+            keep_recent=config.storage.snapshot_keep_recent,
+        )
     proxy_addr = getattr(config.base, "proxy_app", "")
     if app is None and proxy_addr:
         # out-of-process app (reference proxy_app + abci transport
@@ -159,7 +180,33 @@ def build_node(
         proxy = connect_app_conns(proxy_addr, transport)
         app = None
     else:
-        app = app or KVStoreApplication()
+        if app is None:
+            # a pruned node cannot handshake-replay from block 1 —
+            # replay_blocks walks app_height+1..store_height and blocks
+            # below the retention base are GONE. With the lifecycle
+            # knobs on, the default app must persist its committed
+            # height so a restart replays only the retained tail
+            # (reference PersistentKVStoreApplication).
+            s = config.storage
+            lifecycle_on = bool(
+                s.retain_blocks
+                or s.retain_states
+                or s.retain_index
+                or s.snapshot_interval
+            )
+            app = KVStoreApplication(
+                persist_path=os.path.join(home, "app_state.json")
+                if home and lifecycle_on
+                else None,
+                snapshot_store=snapshot_store,
+            )
+        elif (
+            snapshot_store is not None
+            and getattr(app, "snapshot_store", False) is None
+        ):
+            # an injected kvstore-style app with the seam unset gets
+            # the node's store (tests pass retain_height-knobbed apps)
+            app.snapshot_store = snapshot_store
         proxy = AppConns.local(app)
     block_db = kv.open_kv(
         config.base.db_backend,
@@ -266,6 +313,27 @@ def build_node(
     )
     cs.tracer = tracer
     mempool.tracer = tracer
+    # storage lifecycle plane (store/retention.py): reconciles the
+    # [storage] retention window with the app's retain_height and
+    # owns ALL pruning once enabled — the executor's legacy inline
+    # prune hands off through the hook (state/execution.py _prune)
+    from ..store.retention import RetentionPlane
+
+    retention = RetentionPlane(
+        config.storage,
+        block_store,
+        state_store,
+        tx_indexer=tx_indexer,
+        block_indexer=block_indexer,
+        evpool=evpool,
+        snapshot_store=snapshot_store,
+        proxy=proxy,
+        wal_path=wal_path,
+        home=home,
+        tracer=tracer,
+    )
+    if retention.enabled:
+        block_exec.retention_hook = retention.notify_retain_height
     return NodeParts(
         config=config,
         genesis=genesis,
@@ -287,6 +355,8 @@ def build_node(
         index_db=index_db,
         indexer_service=indexer_service,
         tracer=tracer,
+        retention=retention,
+        snapshot_store=snapshot_store,
     )
 
 

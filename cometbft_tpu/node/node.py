@@ -153,6 +153,9 @@ class Node:
         self.statesync_reactor = StateSyncReactor(
             self.parts.proxy, enabled=config.statesync.enable
         )
+        # serve-floor handle (store/retention.py): chunks being
+        # streamed to a joiner pin their height against pruning
+        self.statesync_reactor.retention = self.parts.retention
         self.addr_book = AddrBook(
             os.path.join(home, "addrbook.json") if home else None,
             our_id=self.node_key.node_id,
@@ -264,6 +267,15 @@ class Node:
             "state.index",
             lambda: self.parts.indexer_service.queue_stats()
             if self.parts.indexer_service is not None
+            else None,
+        )
+        # storage lifecycle plane (store/retention.py): base heights,
+        # pruned totals, snapshot + disk-bytes stats
+        q.register(
+            "store.retention",
+            lambda: self.parts.retention.stats()
+            if self.parts.retention is not None
+            and self.parts.retention.enabled
             else None,
         )
 
@@ -441,6 +453,10 @@ class Node:
             await self.parts.indexer_service.start_async(
                 self.parts.block_store, self.parts.state_store
             )
+        if self.parts.retention is not None:
+            # storage lifecycle plane (store/retention.py): no-op
+            # unless a [storage] retention/snapshot knob is set
+            await self.parts.retention.start()
         rpc_env = None
         if self.config.rpc.laddr:
             from ..rpc import Environment, RPCServer
@@ -590,6 +606,13 @@ class Node:
             # stop() can flush the remaining sealed heights bounded
             await guard.stage(
                 "indexer", self.parts.indexer_service.stop()
+            )
+        if self.parts.retention is not None:
+            # before the stores close: a reconcile pass mid-flight in
+            # its worker thread must finish (or be abandoned bounded)
+            # while its dbs are still open
+            await guard.stage(
+                "retention", self.parts.retention.stop()
             )
         # release store handles (psql sink flush+close; logdb flocks;
         # sqlite fds) — a restart in the same process must be able to

@@ -23,6 +23,8 @@ class RPCClientError(Exception):
     def __init__(self, code: int, message: str, data: str = ""):
         super().__init__(f"[{code}] {message} {data}".strip())
         self.code = code
+        self.message = message
+        self.data = data
 
 
 class HTTPClient:

@@ -64,6 +64,24 @@ def _latest_height(env) -> int:
     return env.block_store.height()
 
 
+def _pruned_error(h: int, base: int) -> "RPCError":
+    """The structured below-base error every height-taking route
+    raises once retention pruning (store/retention.py) has moved the
+    store base past the request — a clean, machine-readable verdict
+    instead of the not-found/None-load a pruned height used to hit."""
+    return RPCError(
+        -32603,
+        f"height {h} is pruned (base={base})",
+        data=f'{{"pruned": true, "base": "{base}"}}',
+    )
+
+
+def _check_pruned(env, h: int) -> None:
+    base = env.block_store.base()
+    if h < base:
+        raise _pruned_error(h, base)
+
+
 def _norm_height(env, height) -> int:
     h = _h(height)
     if h is None:
@@ -75,6 +93,7 @@ def _norm_height(env, height) -> int:
             -32603,
             f"height {h} is ahead of the latest height {_latest_height(env)}",
         )
+    _check_pruned(env, h)
     return h
 
 
@@ -184,6 +203,26 @@ def health(env) -> Dict[str, Any]:
         # plane injected one — hit/miss/flight counters for "is the
         # serving side sharing verification work"
         out["light_header_cache"] = hc.stats()
+    ret = getattr(env, "retention", None)
+    if ret is not None and getattr(ret, "enabled", False):
+        # storage lifecycle verdict (store/retention.py): the plane's
+        # base/pruned/snapshot counters, degraded when the reconciler
+        # has stopped keeping the window (pruning far behind target)
+        st = ret.stats()
+        out["storage"] = st
+        cfg = getattr(ret, "cfg", None)
+        if cfg is not None and cfg.retain_blocks > 0:
+            lag = latest - st["base_height"]
+            # 3 windows behind = the reconciler is not keeping up
+            # (wedged worker, dead loop) — disk is growing unbounded
+            if st["reconciles"] > 0 and lag > 3 * max(
+                cfg.retain_blocks, cfg.prune_batch
+            ):
+                reasons.append(
+                    f"storage: prune base {st['base_height']} lags "
+                    f"head {latest} by {lag} "
+                    f"(> 3x retain_blocks={cfg.retain_blocks})"
+                )
     bd = getattr(env.consensus_state, "last_commit_breakdown", None)
     if bd is not None:
         # per-phase attribution of the last committed height (ISSUE 7
@@ -679,6 +718,20 @@ async def tx(env, hash=None, prove=False) -> Dict[str, Any]:
     key = _bytes_param(hash)
     res = env.tx_indexer.get(key)
     if res is None:
+        ibase = (
+            env.tx_indexer.base_height()
+            if hasattr(env.tx_indexer, "base_height")
+            else 0
+        )
+        if ibase:
+            # the row may have been retention-pruned (idx:base):
+            # say so instead of a bare not-found
+            raise RPCError(
+                -32603,
+                f"tx {key.hex()} not found "
+                f"(tx index pruned below height {ibase})",
+                data=f'{{"index_base": "{ibase}"}}',
+            )
         raise RPCError(-32603, f"tx {key.hex()} not found")
     height, index, tx_bytes, tx_result = res
     out = {
@@ -706,10 +759,10 @@ def _height_tx_proofs(env, height: int, cache: dict):
     if got is None:
         blk = env.block_store.load_block(height)
         if blk is None:
+            _check_pruned(env, height)
             raise RPCError(
                 -32603,
-                f"cannot prove tx: block {height} not in store "
-                "(pruned?)",
+                f"cannot prove tx: block {height} not in store",
             )
         from ..crypto import merkle
         from ..types.block import tx_hash
@@ -780,6 +833,10 @@ async def block_search(env, query="", page=1, per_page=30, order_by="asc"):
     start = (page - 1) * per_page
     blocks = []
     for h in heights[start : start + per_page]:
+        # an index hit whose block has been retention-pruned must say
+        # so, not silently shrink the page (retain_index can be wider
+        # than retain_blocks — the row legitimately outlives the body)
+        _check_pruned(env, h)
         blk = env.block_store.load_block(h)
         if blk:
             blocks.append(

@@ -37,6 +37,9 @@ class Environment:
     # outbound fan-out plane (rpc/fanout.py, ISSUE 15)
     tracer: object = None  # node trace ring (fanout.* spans)
     indexer_service: object = None  # batched per-height index drain
+    # storage lifecycle plane (store/retention.py): health verdict +
+    # status surfacing; may be None (inspect mode)
+    retention: object = None
     # height-keyed commit waiters, shared by broadcast_tx_commit AND
     # the gRPC broadcast API: lazily built so inspect-mode envs never
     # subscribe (field, not ctor arg — see commit_waiters())
@@ -139,4 +142,5 @@ class Environment:
             ),
             tracer=p.tracer,
             indexer_service=getattr(p, "indexer_service", None),
+            retention=getattr(p, "retention", None),
         )

@@ -455,6 +455,19 @@ class BlockExecutor:
 
     def _prune(self, state: State) -> None:
         rh = getattr(self, "_retain_height", 0)
+        hook = getattr(self, "retention_hook", None)
+        if hook is not None:
+            # the retention plane owns pruning (store/retention.py):
+            # record the app's retain_height and return — deletes run
+            # on the plane's cadence, in bounded batches, OFF this
+            # consensus path (the legacy inline path below was an
+            # unbounded scan on the commit critical path)
+            if rh:
+                try:
+                    hook(rh)
+                except Exception:
+                    pass
+            return
         if rh and self.block_store is not None:
             try:
                 self.block_store.prune_blocks(rh)

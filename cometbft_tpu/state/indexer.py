@@ -41,6 +41,13 @@ from ..utils.tasks import spawn
 # rows (crash consistency: the marker can never run ahead of rows,
 # and rows without the marker are re-written identically on replay)
 LAST_INDEXED_KEY = b"idx:last"
+# first retained indexed height (exclusive floor: every row BELOW it
+# is pruned), mirroring idx:last's contiguity discipline from the
+# other end — the marker advances ATOMICALLY with the delete batch
+# that clears everything below it (store/retention.py), so a crash
+# mid-prune leaves base <= the true first retained row and a re-prune
+# resumes idempotently: no gap, no orphan rows above the marker
+INDEX_BASE_KEY = b"idx:base"
 
 
 def _enc_height(h: int) -> bytes:
@@ -166,6 +173,44 @@ class TxIndexer:
         batch per height)."""
         return _dec_height(self.db.get(LAST_INDEXED_KEY))
 
+    def base_height(self) -> int:
+        """The prune floor (``idx:base``): every row below this
+        height is pruned; 0 = nothing ever pruned."""
+        return _dec_height(self.db.get(INDEX_BASE_KEY))
+
+    def prune_deletes(self, retain_height: int) -> List[bytes]:
+        """Keys of every tx row below ``retain_height`` — pure scan,
+        no writes. The ``tx:h:<hash>`` rows are reached through the
+        implicit ``tx.height`` attribute rows (every indexed tx has
+        one — tx_sets appends it unconditionally), so this never
+        parses record values."""
+        deletes: List[bytes] = []
+        hash_prefix = b"tx:a:tx.height="
+        for k, v in self.db.iter_prefix(b"tx:a:"):
+            # key tail = <value> ':' height(8) index(4)
+            h = struct.unpack(">Q", k[-12:-4])[0]
+            if h >= retain_height:
+                continue
+            deletes.append(k)
+            if k.startswith(hash_prefix):
+                deletes.append(b"tx:h:" + bytes(v))
+        return deletes
+
+    def prune(self, retain_height: int) -> int:
+        """Delete tx rows below ``retain_height`` and advance
+        ``idx:base`` in the SAME atomic batch; returns keys deleted.
+        Prefer ``prune_index`` (module level) when a BlockIndexer
+        shares this db — it covers both row families under one
+        marker advance."""
+        if retain_height <= self.base_height():
+            return 0
+        deletes = self.prune_deletes(retain_height)
+        with self._lock:
+            self.db.write_batch(
+                [(INDEX_BASE_KEY, _enc_height(retain_height))], deletes
+            )
+        return len(deletes)
+
     def get(self, tx_hash: bytes):
         raw = self.db.get(b"tx:h:" + tx_hash)
         if raw is None:
@@ -289,6 +334,28 @@ class BlockIndexer:
     def index_block(self, height: int, events: List[abci.Event]) -> None:
         self.db.write_batch(self.block_sets(height, events))
 
+    def prune_deletes(self, retain_height: int) -> List[bytes]:
+        """Keys of every block-event row below ``retain_height`` —
+        pure scan, no writes (height is the key's last 8 bytes)."""
+        return [
+            k
+            for k, _ in self.db.iter_prefix(b"blk:e:")
+            if struct.unpack(">Q", k[-8:])[0] < retain_height
+        ]
+
+    def prune(self, retain_height: int) -> int:
+        """Delete block-event rows below ``retain_height`` and
+        advance ``idx:base`` atomically with them; returns keys
+        deleted. Prefer ``prune_index`` when a TxIndexer shares this
+        db (one marker advance covering both row families)."""
+        if retain_height <= _dec_height(self.db.get(INDEX_BASE_KEY)):
+            return 0
+        deletes = self.prune_deletes(retain_height)
+        self.db.write_batch(
+            [(INDEX_BASE_KEY, _enc_height(retain_height))], deletes
+        )
+        return len(deletes)
+
     def search(self, q: Query) -> List[int]:
         heights: Optional[set] = None
         for c in q.conditions:
@@ -325,6 +392,33 @@ class BlockIndexer:
             if not heights:
                 return []
         return sorted(heights or ())
+
+
+def prune_index(
+    tx_indexer: TxIndexer,
+    block_indexer: BlockIndexer,
+    retain_height: int,
+) -> int:
+    """Prune BOTH indexers' rows below ``retain_height`` in ONE
+    atomic batch carrying the ``idx:base`` advance — the retention
+    plane's path (store/retention.py). Crash-safe by construction:
+    the marker lands with (never before) the deletes it covers, so a
+    crash mid-prune leaves either the old base (deletes retried
+    idempotently) or the new base with every covered row gone — no
+    gap, no orphan rows. Requires both indexers on the same kv db
+    (the node wiring guarantees it; IndexerService checks the same).
+    Returns keys deleted."""
+    db = tx_indexer.db
+    assert getattr(block_indexer, "db", None) is db
+    if retain_height <= tx_indexer.base_height():
+        return 0
+    deletes = tx_indexer.prune_deletes(retain_height)
+    deletes += block_indexer.prune_deletes(retain_height)
+    with tx_indexer._lock:
+        db.write_batch(
+            [(INDEX_BASE_KEY, _enc_height(retain_height))], deletes
+        )
+    return len(deletes)
 
 
 class HeightBundle:

@@ -58,6 +58,10 @@ class StateSyncReactor(Reactor):
         self.syncer: Optional[Syncer] = None
         # pending chunk requests: (peer, height, format, index) -> fut
         self._pending: Dict[tuple, asyncio.Future] = {}
+        # retention plane handle (store/retention.py): while a chunk
+        # for height H streams to a joiner, H is pinned against
+        # pruning (the in-flight-serve floor); None = no plane
+        self.retention = None
 
     def get_channels(self):
         return [
@@ -178,9 +182,21 @@ class StateSyncReactor(Reactor):
     async def _serve_chunk(
         self, peer, height: int, format_: int, index: int
     ) -> None:
-        chunk = await asyncio.to_thread(
-            self.proxy.snapshot.load_snapshot_chunk, height, format_, index
-        )
+        def _load() -> Optional[bytes]:
+            ret = self.retention
+            if ret is not None:
+                # pin the height for the duration of the load: the
+                # retention plane must not prune a snapshot a joiner
+                # is mid-download on (store/retention.py serve floor)
+                with ret.serving(height):
+                    return self.proxy.snapshot.load_snapshot_chunk(
+                        height, format_, index
+                    )
+            return self.proxy.snapshot.load_snapshot_chunk(
+                height, format_, index
+            )
+
+        chunk = await asyncio.to_thread(_load)
         peer.try_send(
             CHUNK_CHANNEL,
             bytes([MSG_CHUNK_RESPONSE])

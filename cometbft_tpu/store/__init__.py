@@ -1,1 +1,2 @@
 from .block_store import BlockStore  # noqa: F401
+from .retention import RetentionPlane  # noqa: F401

@@ -49,6 +49,7 @@ FIXTURE_PATHS = {
     "ASY117": "cometbft_tpu/consensus/x.py",
     "ASY118": "cometbft_tpu/consensus/x.py",
     "ASY119": "cometbft_tpu/consensus/x.py",
+    "ASY120": "cometbft_tpu/store/x.py",
 }
 
 
@@ -625,6 +626,28 @@ FIXTURES = [
                 self.seen.add(msg.key())
             def advance_height(self):
                 self.seen.clear()  # pruned on height advance
+        """,
+    ),
+    (
+        "ASY120",  # unbounded-delete-in-hot-plane: a DB-scan loop
+        # deleting one row per iteration — unbounded trip count and
+        # no crash-consistency marker (the shape the retention
+        # plane's sliced write_batch discipline replaces)
+        """
+        def prune(db, prefix):
+            for k, v in db.iter_prefix(prefix):
+                db.delete(k)
+        """,
+        """
+        def prune(db, prefix, marker, enc):
+            # sanctioned: collect doomed keys, ONE atomic batch with
+            # the base-marker advance riding along
+            doomed = [k for k, _ in db.iter_prefix(prefix)]
+            db.write_batch([(marker, enc)], doomed)
+        def drop_bounded(db, doomed):
+            # bounded plain-list loop: not scan-driven, fine
+            for k in doomed:
+                db.delete(k)
         """,
     ),
     (

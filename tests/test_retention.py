@@ -1,0 +1,505 @@
+"""Storage lifecycle plane (ISSUE 17, store/retention.py): min-wins
+target reconciliation with its two floors, marker-atomic pruning
+across blocks/index/states/WAL, crash-mid-prune resume idempotency
+(in-process abort AND a true FAIL_TEST_INDEX power cut), anchored
+index replay over a pruned store, snapshot store rotation + restart
+survival, structured RPC below-base errors on every height route, and
+the compressed-time soak slice (full 10k soak behind ``slow``)."""
+
+import asyncio
+import hashlib
+import json
+import os
+import subprocess
+import sys
+from types import SimpleNamespace
+
+import pytest
+
+from cometbft_tpu.config.config import test_config as make_test_config
+from cometbft_tpu.node.inprocess import build_node, make_genesis
+from cometbft_tpu.statesync.snapshots import SnapshotStore
+from cometbft_tpu.store.retention import RetentionPlane
+from cometbft_tpu.utils.chaingen import make_chain
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(home, **storage):
+    cfg = make_test_config(str(home))
+    cfg.base.db_backend = "sqlite"
+    cfg.tx_index.indexer = "kv"
+    s = cfg.storage
+    s.prune_interval_s = 3600.0  # reconciles are test-driven only
+    for k, v in storage.items():
+        setattr(s, k, v)
+    return cfg
+
+
+def _grow(home, heights, **storage):
+    genesis, pvs = make_genesis(1)
+    privs = [pv.priv_key for pv in pvs]
+    node = build_node(
+        genesis, None, config=_cfg(home, **storage), home=str(home)
+    )
+    make_chain(genesis, privs, heights, node=node)
+    return genesis, privs, node
+
+
+# --- target reconciliation (unit) ---------------------------------------
+
+
+def _plane(retain=10, snap_interval=0, snap_store=None, app_retain=0):
+    cfg = SimpleNamespace(
+        retain_blocks=retain,
+        retain_states=0,
+        retain_index=0,
+        prune_batch=4,
+        prune_interval_s=3600.0,
+        snapshot_interval=snap_interval,
+        snapshot_keep_recent=2,
+    )
+    p = RetentionPlane(cfg, None, None, snapshot_store=snap_store)
+    p._app_retain = app_retain
+    return p
+
+
+def test_target_min_wins_app_retain():
+    # node window alone
+    assert _plane(retain=10)._target(100, 10) == 90
+    # app is MORE conservative: app wins
+    assert _plane(retain=10, app_retain=40)._target(100, 10) == 40
+    # app is LESS conservative: node window wins
+    assert _plane(retain=10, app_retain=95)._target(100, 10) == 90
+    # no node window, app only
+    assert _plane(retain=0, app_retain=40)._target(100, 0) == 40
+    # neither: nothing prunable
+    assert _plane(retain=0)._target(100, 0) == 0
+
+
+def test_target_snapshot_floor(tmp_path):
+    ss = SnapshotStore(str(tmp_path), keep_recent=2)
+    # snapshotting on, nothing held yet: NO pruning (the only
+    # bootstrap anchor must exist before anything is discarded)
+    p = _plane(retain=10, snap_interval=20, snap_store=ss)
+    assert p._target(100, 10) == 0
+    ss.save(60, b"blob")
+    assert p._target(100, 10) == 60  # capped under the held snapshot
+
+
+def test_target_serve_floor():
+    p = _plane(retain=10)
+    with p.serving(50):
+        assert p._target(100, 10) == 50
+        with p.serving(30):
+            assert p._target(100, 10) == 30
+        assert p._target(100, 10) == 50
+    assert p._target(100, 10) == 90
+
+
+# --- full-node pruning + markers ----------------------------------------
+
+
+def test_reconcile_prunes_all_legs_and_markers(tmp_path):
+    _, _, node = _grow(
+        tmp_path, 120,
+        retain_blocks=30, retain_states=40, retain_index=30,
+        prune_batch=8, snapshot_interval=10, snapshot_keep_recent=2,
+    )
+    out = node.retention.reconcile_once()
+    bs = node.block_store
+    assert bs.base() == 90 and bs.height() == 120
+    assert out["blocks"] == 89
+    assert bs.load_block(90) is not None
+    assert bs.load_block(89) is None
+    assert node.tx_indexer.base_height() == 90
+    assert node.tx_indexer.last_indexed_height() == 120
+    # retained rows still queryable, pruned rows gone
+    assert out["index"] > 0
+    # snapshot rotation: newest two, rooted under <home>/snapshots
+    hs = node.snapshot_store.heights()
+    assert hs == [110, 120]
+    # second pass is a no-op (idempotent targets)
+    out2 = node.retention.reconcile_once()
+    assert out2["blocks"] == 0 and out2["index"] == 0
+    node.close_stores()
+
+
+def test_app_retain_height_caps_node_window(tmp_path):
+    """kvstore's retain_height knob flows through ABCI Commit ->
+    BlockExecutor hook -> plane: min wins, the app's wider window
+    overrides the node's aggressive one."""
+    from cometbft_tpu.models.kvstore import KVStoreApplication
+
+    genesis, pvs = make_genesis(1)
+    privs = [pv.priv_key for pv in pvs]
+    app = KVStoreApplication(retain_height=50)
+    node = build_node(
+        genesis, None, app=app,
+        config=_cfg(tmp_path, retain_blocks=4, prune_batch=16),
+        home=str(tmp_path),
+    )
+    make_chain(genesis, privs, 100, node=node)
+    assert node.retention._app_retain == 50  # 100 - 50
+    node.retention.reconcile_once()
+    # node window alone would put base at 96; the app caps it at 50
+    assert node.block_store.base() == 50
+    node.close_stores()
+
+
+# --- crash mid-prune -----------------------------------------------------
+
+
+def test_crash_mid_prune_inprocess_resume(tmp_path):
+    """Abort a pass between bounded batches via the chaos seam: every
+    committed batch carried its own base advance, so the partial pass
+    reads consistent and the resume finishes the same targets."""
+    _, _, node = _grow(
+        tmp_path, 60, retain_blocks=10, retain_index=10, prune_batch=5
+    )
+
+    class Boom(RuntimeError):
+        pass
+
+    calls = [0]
+
+    def hook():
+        calls[0] += 1
+        if calls[0] > 2:
+            raise Boom()
+
+    node.retention.batch_hook = hook
+    with pytest.raises(Boom):
+        node.retention.reconcile_once()
+    node.retention.batch_hook = None
+    bs = node.block_store
+    mid = bs.base()
+    assert 1 < mid < 50  # partial progress, committed batches only
+    assert bs.load_block(mid) is not None
+    if mid > 1:
+        assert bs.load_block(mid - 1) is None
+    # resume: same targets, completes, idempotent
+    node.retention.reconcile_once()
+    assert bs.base() == 50
+    assert node.tx_indexer.base_height() == 50
+    out = node.retention.reconcile_once()
+    assert out["blocks"] == 0 and out["index"] == 0
+    node.close_stores()
+
+
+@pytest.mark.parametrize("fail_index", [0, 2])
+def test_crash_mid_prune_powercut_then_resume(tmp_path, fail_index):
+    """The real thing: os._exit at the retention-prune-batch fail
+    point (before the first / third bounded batch), then a rebuild
+    from the same home must handshake cleanly and a resume pass must
+    finish pruning with consistent markers."""
+    home = str(tmp_path / "home")
+    os.makedirs(home)
+    script = f"""
+import os
+from cometbft_tpu.node.inprocess import build_node, make_genesis
+from cometbft_tpu.utils.chaingen import make_chain
+from cometbft_tpu.utils import fail
+from cometbft_tpu.config.config import test_config as make_test_config
+genesis, pvs = make_genesis(1)
+# persist the genesis so the parent can rebuild the same node
+with open({home!r} + "/genesis.json", "w") as f:
+    f.write(genesis.to_json())
+cfg = make_test_config({home!r})
+cfg.base.db_backend = "sqlite"
+cfg.tx_index.indexer = "kv"
+cfg.storage.retain_blocks = 10
+cfg.storage.retain_index = 10
+cfg.storage.prune_batch = 5
+cfg.storage.prune_interval_s = 3600.0
+node = build_node(genesis, None, config=cfg, home={home!r})
+privs = [pv.priv_key for pv in pvs]
+make_chain(genesis, privs, 60, node=node)
+os.environ["FAIL_TEST_INDEX"] = "{fail_index}"
+fail.reset()
+node.retention.reconcile_once()
+raise SystemExit("fail point never hit")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 99, proc.stderr
+
+    from cometbft_tpu.types.genesis import GenesisDoc
+
+    with open(os.path.join(home, "genesis.json")) as f:
+        genesis = GenesisDoc.from_json(f.read())
+    cfg = _cfg(home, retain_blocks=10, retain_index=10, prune_batch=5)
+    node = build_node(genesis, None, config=cfg, home=home)
+    bs = node.block_store
+    # whatever batches committed are consistent: base readable,
+    # below-base gone, markers never past the true first row
+    mid = bs.base()
+    assert bs.height() == 60
+    assert bs.load_block(max(1, mid)) is not None
+    if mid > 1:
+        assert bs.load_block(mid - 1) is None
+    assert node.tx_indexer.base_height() <= 50
+    node.retention.reconcile_once()
+    assert bs.base() == 50
+    assert node.tx_indexer.base_height() == 50
+    node.close_stores()
+
+
+# --- anchored index replay over a pruned store ---------------------------
+
+
+def test_indexer_replay_anchors_at_pruned_base(tmp_path):
+    """A lost idx:last marker forces a full replay — which must
+    anchor at the store base, not height 1 (the pruned prefix has no
+    blocks to read)."""
+    from cometbft_tpu.state.indexer import LAST_INDEXED_KEY
+
+    _, _, node = _grow(
+        tmp_path, 60, retain_blocks=20, retain_index=20, prune_batch=16
+    )
+    node.retention.reconcile_once()
+    assert node.block_store.base() == 40
+    # simulate marker loss (fresh index db / crash before any flush)
+    node.tx_indexer.db.delete(LAST_INDEXED_KEY)
+    assert node.tx_indexer.last_indexed_height() == 0
+    n = node.indexer_service.replay(node.block_store, node.state_store)
+    assert n == 21  # heights 40..60, NOT 1..60
+    assert node.tx_indexer.last_indexed_height() == 60
+    node.close_stores()
+
+
+# --- snapshot store ------------------------------------------------------
+
+
+def test_snapshot_store_rotation_and_restart(tmp_path):
+    root = str(tmp_path / "snaps")
+    ss = SnapshotStore(root, keep_recent=2)
+    for h, blob in ((10, b"a" * 3000), (20, b"b" * 3000), (30, b"c" * 100)):
+        ss.save(h, blob)
+    assert ss.heights() == [20, 30]  # keep_recent rotation
+    assert ss.latest_height() == 30
+    # chunked read side round-trips and hash-verifies
+    snaps = ss.list_snapshots()
+    assert [s.height for s in snaps] == [20, 30]
+    assert snaps[0].chunks == 3  # 3000 / 1024
+    blob = ss.load_blob(20)
+    assert blob == b"b" * 3000
+    assert hashlib.sha256(blob).digest() == snaps[0].hash
+    assert ss.load_chunk(20, format_=9, index=0) == b""  # format miss
+    # restart survival: a fresh store over the same root serves the
+    # same snapshots (the whole point of node-side persistence)
+    ss2 = SnapshotStore(root, keep_recent=2)
+    assert ss2.heights() == [20, 30]
+    assert ss2.load_blob(30) == b"c" * 100
+
+
+def test_snapshot_store_sweeps_incomplete_on_open(tmp_path):
+    root = str(tmp_path / "snaps")
+    ss = SnapshotStore(root, keep_recent=2)
+    ss.save(10, b"complete")
+    # a crash mid-save leaves chunks without meta.json
+    d = os.path.join(root, f"{20:015d}")
+    os.makedirs(d)
+    with open(os.path.join(d, "chunk.0000"), "wb") as f:
+        f.write(b"torn")
+    ss2 = SnapshotStore(root, keep_recent=2)
+    assert ss2.heights() == [10]
+    assert not os.path.exists(d)
+
+
+# --- RPC below-base hardening --------------------------------------------
+
+
+def _env_for(node, genesis):
+    from cometbft_tpu.rpc.env import Environment
+
+    return Environment(
+        chain_id=genesis.chain_id,
+        block_store=node.block_store,
+        state_store=node.state_store,
+        tx_indexer=node.tx_indexer,
+        block_indexer=node.block_indexer,
+        genesis=genesis,
+        proxy=node.proxy,
+        config=node.config,
+        retention=node.retention,
+    )
+
+
+def test_rpc_pruned_height_routes(tmp_path):
+    from cometbft_tpu.rpc import core
+
+    # retain_index WIDER than retain_blocks: index rows legitimately
+    # outlive block bodies, so a block_search hit can land on a
+    # pruned body (the case the structured error exists for)
+    genesis, _, node = _grow(
+        tmp_path, 40,
+        retain_blocks=10, retain_states=10, retain_index=20,
+        prune_batch=16,
+    )
+    node.retention.reconcile_once()
+    env = _env_for(node, genesis)
+    base = node.block_store.base()
+    ibase = node.tx_indexer.base_height()
+    assert base == 30 and ibase == 20
+
+    for route in (core.block, core.block_results, core.commit):
+        with pytest.raises(core.RPCError) as ei:
+            route(env, height=base - 1)
+        assert "pruned" in str(ei.value)
+        assert json.loads(ei.value.data)["pruned"] is True
+        assert json.loads(ei.value.data)["base"] == str(base)
+    # retained heights still serve
+    assert core.block(env, height=base)["block"] is not None
+
+    # block_search: an index hit whose block body is pruned says so
+    with pytest.raises(core.RPCError) as ei:
+        asyncio.run(
+            core.block_search(env, query=f"block.height={base - 1}")
+        )
+    assert "pruned" in str(ei.value)
+
+    # tx: pruned index rows answer with the idx:base verdict
+    with pytest.raises(core.RPCError) as ei:
+        asyncio.run(core.tx(env, hash="00" * 32))
+    assert "pruned below" in str(ei.value)
+    assert json.loads(ei.value.data)["index_base"] == str(ibase)
+
+    # status: the advertised earliest height IS the base, and the
+    # health verdict carries the lifecycle stats
+    st = core.status(env)
+    assert st["sync_info"]["earliest_block_height"] == str(base)
+    node.close_stores()
+
+
+def test_light_proxy_forwards_pruned_error(tmp_path):
+    """The light proxy must forward the structured below-base verdict
+    verbatim, not re-wrap it as a generic upstream failure."""
+    from cometbft_tpu.rpc.client import RPCClientError
+    from cometbft_tpu.rpc.core import RPCError
+
+    err = RPCError(-32603, "height 3 is pruned (base=9)",
+                   data='{"pruned": true, "base": "9"}')
+    # the client error carries code/message/data; _respond forwards
+    ce = RPCClientError(err.code, str(err), data=err.data)
+    assert ce.code == -32603
+    assert "pruned" in ce.message
+    assert json.loads(ce.data)["base"] == "9"
+
+
+# --- restart survival (handshake over a pruned store) --------------------
+
+
+def test_pruned_node_restart_replays_retained_tail_only(tmp_path):
+    """Restarting a pruned node must NOT try to replay from block 1:
+    build_node persists the default app's height, so the handshake
+    replays app_height+1..store_height — all retained."""
+    genesis, privs, node = _grow(
+        tmp_path, 50, retain_blocks=10, prune_batch=16
+    )
+    node.retention.reconcile_once()
+    assert node.block_store.base() == 40
+    assert os.path.exists(os.path.join(str(tmp_path), "app_state.json"))
+    node.close_stores()
+    node2 = build_node(
+        genesis, None,
+        config=_cfg(tmp_path, retain_blocks=10, prune_batch=16),
+        home=str(tmp_path),
+    )
+    assert node2.block_store.base() == 40
+    assert node2.block_store.height() == 50
+    # and the chain extends cleanly from the rebuilt node
+    make_chain(genesis, privs, 5, node=node2)
+    assert node2.block_store.height() == 55
+    node2.close_stores()
+
+
+# --- chaos nemesis e2e ---------------------------------------------------
+
+
+def _run_chaos(schedule_events, seed, tmp_path, **kw):
+    from cometbft_tpu.chaos import FaultSchedule, run_schedule
+    from cometbft_tpu.chaos.schedule import FaultEvent
+
+    schedule = FaultSchedule(
+        [FaultEvent(**e) for e in schedule_events]
+    )
+    return asyncio.run(
+        asyncio.wait_for(
+            run_schedule(
+                schedule, seed=seed, base_dir=str(tmp_path), **kw
+            ),
+            300,
+        )
+    )
+
+
+def test_chaos_crash_mid_prune_and_snapshot_during_prune(tmp_path):
+    """The two lifecycle nemesis actions run invariant-clean on a live
+    4-node net (knobs auto-set by run_schedule) and their trace
+    records carry only seeded parameters (byte-identical replay)."""
+    events = [
+        {"action": "crash_mid_prune", "at_height": 12, "node": 1},
+        {"action": "snapshot_during_prune", "at_height": 14, "node": 0},
+    ]
+    r1 = _run_chaos(events, 1337, tmp_path / "a")
+    assert r1.ok, r1.violations
+    acts = [(t["action"], t.get("node")) for t in r1.trace]
+    assert ("crash_mid_prune", "n1") in acts
+    assert ("snapshot_during_prune", "n0") in acts
+    r2 = _run_chaos(events, 1337, tmp_path / "b")
+    assert r1.trace == r2.trace, "same seed must replay identically"
+
+
+@pytest.mark.slow
+def test_chaos_statesync_join_from_pruned_source(tmp_path):
+    """A fresh joiner statesyncs from a node whose history below the
+    snapshot is PRUNED (trust root anchored at the source's base),
+    then blocksync-follows the tail."""
+    events = [
+        {"action": "crash_mid_prune", "at_height": 12, "node": 1},
+        {"action": "statesync_join", "at_height": 15, "via": [1, 2]},
+    ]
+    report = _run_chaos(events, 7, tmp_path)
+    assert report.ok, report.violations
+    joined = [t for t in report.trace if t["action"] == "statesync_join"]
+    assert joined and joined[0]["joined"] == "j4"
+    assert report.final_heights[joined[0]["joined"]] >= 15
+
+
+# --- compressed-time soak ------------------------------------------------
+
+
+def test_soak_slice_bounded_disk_and_markers():
+    """Tier-1 slice of the lifecycle soak: a few hundred heights with
+    reconciles interleaved — disk plateaus once the window saturates,
+    markers stay consistent, WAL rotation survives pruning, RPC
+    answers below-base with the structured error."""
+    from cometbft_tpu.chaos.soak import run_soak
+
+    report = run_soak(
+        seed=11, heights=300, step=50, warmup_frac=0.5,
+        disk_factor=1.6, rss_factor=2.0,
+    )
+    assert report["ok"], report["violations"]
+    assert report["retention"]["pruned_blocks_total"] > 0
+    assert report["retention"]["pruned_wal_files"] > 0
+    last = report["checkpoints"][-1]
+    assert last["base"] == 300 - 64  # height - retain window
+
+
+@pytest.mark.slow
+def test_soak_10k_heights():
+    """The full compressed-time 10k-height soak (ISSUE 17
+    acceptance): bounded disk AND RSS over ~200 reconciles."""
+    from cometbft_tpu.chaos.soak import run_soak
+
+    report = run_soak(seed=1337, heights=10_000, step=50)
+    assert report["ok"], report["violations"]
+    assert report["checkpoints"][-1]["base"] == 10_000 - 64

@@ -147,6 +147,37 @@ JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" \
     --subscriber-storm 150 --trace-dump "$TRACE_DIR/sub_storm" --budget
 python -m cometbft_tpu.trace timeline "$TRACE_DIR/sub_storm" --strict
 
+echo "== chaos smoke: storage lifecycle plane under faults (crash mid-prune + snapshot during prune) =="
+# the storage lifecycle plane (ISSUE 17, docs/STORAGE.md): the
+# schedule crashes a node between bounded prune batches and restarts
+# it (resume must be idempotent: base monotone, retained window fully
+# readable, below-base gone), then races a statesync snapshot serve
+# against a live prune pass (the serve floor must pin the served
+# height). run_schedule turns the lifecycle knobs on for every node
+# when these actions are scheduled; budget-gated like every leg
+# (storage.prune / storage.snapshot budgets in tools/span_budgets.toml)
+cat > "$TRACE_DIR/lifecycle_schedule.json" <<'EOF'
+[
+  {"action": "crash_mid_prune", "at_height": 3, "node": 1},
+  {"action": "snapshot_during_prune", "at_height": 5, "node": 2}
+]
+EOF
+JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" \
+    --schedule "$TRACE_DIR/lifecycle_schedule.json" \
+    --trace-dump "$TRACE_DIR/lifecycle" --budget
+python -m cometbft_tpu.trace timeline "$TRACE_DIR/lifecycle" --strict
+
+echo "== chaos smoke: compressed-time storage soak slice (bounded disk + marker consistency) =="
+# the 10k-height soak's CI-sized slice (docs/STORAGE.md "Soak"): one
+# node, synthetic commit schedule, retention reconciled every 50
+# heights — disk/RSS must plateau after warmup, prune markers
+# (blocks base, idx:base, WAL group files) must stay consistent,
+# below-base RPC must answer the structured pruned error, and a
+# restart must replay only the retained tail (exit 1 on any
+# violation; the full 10k soak is the slow-marked tier-2 run)
+JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos soak --seed "$SEED" \
+    --heights 600 --step 50
+
 echo "== chaos smoke: un-pinned partition x statesync_join x churn + reconnect span budget =="
 # the compound the matrix previously pinned out (ISSUE 12): a
 # partitioned net churns its valset, heals, and a fresh node joins by
