@@ -71,6 +71,8 @@ _DEFAULT_BUDGETS_S = {
     "serve": 1200.0,
     "rpcfanout": 1200.0,
     "scaling": 300.0,
+    "verifysched": 600.0,
+    "meshdryrun": 900.0,
 }
 
 
@@ -247,17 +249,22 @@ def _probe_timeout_s() -> float:
     return float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "180"))
 
 
-def _probe_device(timeout_s: "float | None" = None) -> bool:
+def _probe_device(timeout_s: "float | None" = None) -> dict:
     """One tiny jit with a hard deadline. The tunneled device can wedge
     platform-wide (observed round 3: even `lambda a: a+1` hung >5 min);
     a hung bench records NOTHING for the round, so on a dead device the
-    device configs are skipped and the JSON line says why instead."""
+    device configs are skipped and the JSON line says why instead.
+
+    Returns a STRUCTURED verdict — ``{ok, reason, wall_s}`` — so the
+    checkpointed ``device`` entry records WHAT failed (wedged jit vs
+    init error vs clean) instead of a bare bool the JSON reader can't
+    attribute; the caller degrades to the host path on any not-ok."""
     import threading
 
     if timeout_s is None:
         timeout_s = _probe_timeout_s()
 
-    ok = [False]
+    box = {"ok": False, "err": None}
 
     def run():
         try:
@@ -265,14 +272,31 @@ def _probe_device(timeout_s: "float | None" = None) -> bool:
             import jax.numpy as jnp
 
             np.asarray(jax.jit(lambda a: a + 1)(jnp.arange(4)))
-            ok[0] = True
-        except Exception:
-            pass
+            box["ok"] = True
+        except Exception as e:
+            box["err"] = repr(e)[:200]
 
+    t0 = time.time()
     t = threading.Thread(target=run, daemon=True)
     t.start()
     t.join(timeout_s)
-    return ok[0]
+    wall = round(time.time() - t0, 2)
+    if box["ok"]:
+        return {"ok": True, "reason": "ok", "wall_s": wall}
+    if t.is_alive():
+        # the jit never returned: the probe thread is abandoned (it
+        # cannot be cancelled) and the verdict says wedged, not failed
+        return {
+            "ok": False,
+            "reason": f"wedged: tiny jit still running after "
+            f"{timeout_s:.0f}s",
+            "wall_s": wall,
+        }
+    return {
+        "ok": False,
+        "reason": f"error: {box['err'] or 'unknown'}",
+        "wall_s": wall,
+    }
 
 
 # --- 1. kernel throughput (headline) -----------------------------------
@@ -2737,6 +2761,225 @@ def bench_mixed() -> dict:
     }
 
 
+# the leg's live-class gate: the chunk-preemption bound (~workers x
+# chunk-wall, single-digit ms) with generous box-noise headroom. The
+# chaos/span-budget envelope (tools/span_budgets.toml
+# crypto.sched.dispatch, 2500ms) covers fault schedules; this leg runs
+# fault-free, so a live p95 past 250ms means priorities are not
+# holding, not that the box is slow.
+_VERIFY_SCHED_LIVE_P95_MS = 250.0
+
+
+def bench_verify_sched() -> dict:
+    """Unified verify scheduler leg (docs/PERF.md "Unified verify
+    scheduler"): live-round verify p95 while a sustained catch-up
+    storm shares the engine. Two scenarios over the identical
+    workload, host plane both (queueing policy is the measurement,
+    not the backend):
+
+    - ``priority``: live waves submitted PRIORITY_LIVE — chunk
+      preemption must bound their wall to ~workers x chunk-wall;
+    - ``fifo`` baseline: the same live waves submitted in the storm's
+      own class (no priority) — each wave queues behind the storm
+      tickets ahead of it, the contention the classes exist to bound.
+
+    Gates: priority live p95 <= the leg budget AND the FIFO baseline
+    VISIBLY worse (breaches the same budget or >= 3x the priority
+    p95); verdicts parity-asserted on every wave and storm ticket."""
+    import statistics
+
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.crypto import scheduler as sched_mod
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+
+    rng = np.random.default_rng(23)
+    keys = [Ed25519PrivKey.from_seed(rng.bytes(32)) for _ in range(8)]
+
+    def mk(n, bad=()):
+        items, want = [], []
+        for i in range(n):
+            sk = keys[i % len(keys)]
+            m = bytes(rng.bytes(96))
+            s = sk.sign(m) if i not in bad else b"\x00" * 64
+            items.append((sk.pub_key(), m, s))
+            want.append(i not in bad)
+        return items, want
+
+    live_items, live_want = mk(8)
+    storm_items, storm_want = mk(8192, bad={17, 4001})
+    storm_s = float(os.environ.get("BENCH_VERIFY_SCHED_STORM_S", "8"))
+
+    def scenario(live_priority: int) -> dict:
+        s = sched_mod.VerifyScheduler()
+        deadline = time.perf_counter() + storm_s
+        parity = {"ok": True}
+        catchup_done = [0]
+
+        def storm():
+            while time.perf_counter() < deadline:
+                t = s.submit(
+                    storm_items,
+                    priority=sched_mod.PRIORITY_CATCHUP,
+                    label="bench-storm",
+                )
+                _, oks = t.result(timeout=120)
+                if oks != storm_want:
+                    parity["ok"] = False
+                catchup_done[0] += 1
+
+        feeders = [
+            threading.Thread(target=storm, daemon=True)
+            for _ in range(3)
+        ]
+        for f in feeders:
+            f.start()
+        time.sleep(0.2)  # storm established before the first wave
+        walls = []
+        while time.perf_counter() < deadline:
+            t = s.submit(
+                live_items, priority=live_priority, label="bench-live"
+            )
+            _, oks = t.result(timeout=120)
+            if oks != live_want:
+                parity["ok"] = False
+            walls.append(t.wall() or 0.0)
+            time.sleep(0.015)
+        for f in feeders:
+            f.join(timeout=180)
+        s.drain(timeout=180)
+        s.close()
+        walls.sort()
+        return {
+            "live_waves": len(walls),
+            "live_p50_ms": _ms(statistics.median(walls)) if walls else None,
+            "live_p95_ms": _ms(
+                walls[min(len(walls) - 1, int(0.95 * len(walls)))]
+            ) if walls else None,
+            "catchup_tickets": catchup_done[0],
+            "catchup_lanes_per_s": round(
+                catchup_done[0] * len(storm_items) / storm_s, 1
+            ),
+            "parity_ok": parity["ok"],
+        }
+
+    old_backend = crypto_batch.default_backend()
+    crypto_batch.set_default_backend("cpu-parallel")
+    try:
+        pri = scenario(sched_mod.PRIORITY_LIVE)
+        fifo = scenario(sched_mod.PRIORITY_CATCHUP)
+    finally:
+        crypto_batch.set_default_backend(old_backend)
+    budget = _VERIFY_SCHED_LIVE_P95_MS
+    p95_pri = pri["live_p95_ms"]
+    p95_fifo = fifo["live_p95_ms"]
+    priority_holds = p95_pri is not None and p95_pri <= budget
+    baseline_visibly_worse = (
+        p95_pri is not None
+        and p95_fifo is not None
+        and (p95_fifo > budget or p95_fifo >= 3.0 * p95_pri)
+    )
+    return {
+        "priority": pri,
+        "fifo_baseline": fifo,
+        "live_p95_budget_ms": budget,
+        "priority_holds_budget": priority_holds,
+        "baseline_visibly_worse": baseline_visibly_worse,
+        "parity_ok": pri["parity_ok"] and fifo["parity_ok"],
+        "gate_ok": (
+            priority_holds
+            and baseline_visibly_worse
+            and pri["parity_ok"]
+            and fifo["parity_ok"]
+        ),
+        "note": "live 8-lane waves vs 3x8192-lane catch-up storm "
+        "through ONE scheduler, host plane; fifo = same waves "
+        "submitted classless (the pre-scheduler contention)",
+    }
+
+
+def bench_mesh_dryrun() -> dict:
+    """Mesh-vs-host verify throughput on the multi-device path
+    (docs/PERF.md "Unified verify scheduler", mesh backend). With >1
+    device (real mesh, or the 8-virtual-device dryrun the parent
+    spawns this config under) the ``mesh`` backend shards the batch
+    across devices; verdict parity against the host plane is the
+    in-bench gate. On a single-device box the DEGRADE is the
+    measurement: the structured verdict records that the batch fell
+    through to the host plane without wedging — the degradable
+    contract selecting "mesh" promises."""
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.crypto.mesh_backend import (
+        LAST_MESH,
+        MeshBatchVerifier,
+        mesh_devices,
+    )
+    from cometbft_tpu.crypto.parallel_verify import engine
+
+    devices = mesh_devices(refresh=True)
+    rng = np.random.default_rng(31)
+    n = int(os.environ.get("BENCH_MESH_BATCH", "1024"))
+    keys = [Ed25519PrivKey.from_seed(rng.bytes(32)) for _ in range(8)]
+    items, want = [], []
+    bad = {7, n - 3}
+    for i in range(n):
+        sk = keys[i % len(keys)]
+        m = bytes(rng.bytes(96))
+        s = sk.sign(m) if i not in bad else b"\x00" * 64
+        items.append((sk.pub_key(), m, s))
+        want.append(i not in bad)
+
+    def host_once():
+        return engine().verify(items)
+
+    t0 = time.perf_counter()
+    host_oks = host_once()
+    host_dt = time.perf_counter() - t0
+    host_ok = list(host_oks) == want
+
+    def mesh_once():
+        v = MeshBatchVerifier()
+        for pk, m, s in items:
+            v.add(pk, m, s)
+        return v.verify()
+
+    if devices <= 1:
+        # the common path on this box: no mesh materializes — the
+        # batch must still verify (host degrade), and the verdict is
+        # STRUCTURED so the JSON reader sees a degraded mesh, not a
+        # missing leg
+        _, oks = mesh_once()
+        return {
+            "degraded": True,
+            "devices": devices,
+            "mesh_path": LAST_MESH["path"],
+            "parity_ok": oks == want and host_ok,
+            "host_rate": round(n / host_dt, 1),
+            "note": "single device: mesh backend degraded to the "
+            "host plane (bit-identical verdicts, no wedge) — the "
+            "multi-device number runs under the 8-virtual-device "
+            "dryrun child",
+        }
+
+    mesh_once()  # warmup: sharded-program compile paid outside timing
+    t0 = time.perf_counter()
+    _, mesh_oks = mesh_once()
+    mesh_dt = time.perf_counter() - t0
+    return {
+        "degraded": False,
+        "devices": devices,
+        "mesh_path": LAST_MESH["path"],
+        "batch": n,
+        "mesh_rate": round(n / mesh_dt, 1),
+        "host_rate": round(n / host_dt, 1),
+        "mesh_vs_host": _ratio(host_dt, mesh_dt),
+        "parity_ok": list(mesh_oks) == want and host_ok,
+        "note": f"{n} sigs sharded over {devices} devices "
+        "(shard_map data axis) vs the cpu-parallel host plane; "
+        "parity gated on planted-bad-signature verdicts",
+    }
+
+
 def main() -> None:
     global _PROFILER
     t_start = time.time()
@@ -2771,6 +3014,8 @@ def main() -> None:
             "serve",
             "rpcfanout",
             "scaling",
+            "verifysched",
+            "meshdryrun",
         }
         if which == "all"
         else set(which.split(","))
@@ -2792,7 +3037,8 @@ def main() -> None:
         _record(name, _run_budgeted(name, fn))
 
     global _DEVICE_OK
-    _DEVICE_OK = _probe_device()
+    probe = _probe_device()
+    _DEVICE_OK = probe["ok"]
     if not _DEVICE_OK:
         # run EVERYTHING that has a host path (through the same
         # production dispatch seam) and say so — better an honest
@@ -2806,9 +3052,11 @@ def main() -> None:
             "device",
             {
                 "available": False,
-                "note": f"device probe (tiny jit) exceeded "
-                f"{_probe_timeout_s():.0f}s — platform "
-                "wedged/unreachable; device configs skipped",
+                "degraded": True,
+                "probe": probe,
+                "note": "device probe not ok "
+                f"({probe['reason']}); device configs skipped, "
+                "host path (cpu-parallel plane) carries the round",
             },
         )
         from cometbft_tpu.crypto import batch as crypto_batch
@@ -2920,6 +3168,49 @@ def main() -> None:
         # plane): seconds-cheap, always runs — a fixed super-linear
         # hot path regressing must not hide behind a budget skip
         run_config("scaling", bench_scaling)
+    if "verifysched" in todo:
+        # unified verify scheduler (this round's tentpole): live p95
+        # under a catch-up storm, priority classes vs the classless
+        # FIFO baseline — host plane, runs regardless of the device
+        run_config("verifysched", bench_verify_sched)
+    if "meshdryrun" in todo:
+        if ambient_child:
+            run_config("meshdryrun", bench_mesh_dryrun)
+        else:
+            n_dev = 1
+            if _DEVICE_OK:
+                try:
+                    import jax
+
+                    n_dev = len(jax.devices())
+                except Exception:
+                    n_dev = 1
+            if n_dev > 1:
+                # a real mesh is attached: measure it in-process
+                run_config("meshdryrun", bench_mesh_dryrun)
+            else:
+                # the 8-virtual-device dryrun contract: a cpu-pinned
+                # child (a wedged axon platform can't hang it) with
+                # the forced host device count — same flags the test
+                # conftest validates shardings under
+                flags = os.environ.get("XLA_FLAGS", "")
+                if "xla_force_host_platform_device_count" not in flags:
+                    flags = (
+                        flags
+                        + " --xla_force_host_platform_device_count=8"
+                    ).strip()
+                entry = _subprocess_config(
+                    "meshdryrun",
+                    {"BENCH_FORCE_CPU": "1", "XLA_FLAGS": flags},
+                    int(
+                        os.environ.get(
+                            "BENCH_MESHDRYRUN_BUDGET_S", "900"
+                        )
+                    ),
+                    "mesh-vs-host verify on the 8-device virtual "
+                    "dryrun",
+                )
+                _record("meshdryrun", entry)
     budget_skip = {
         "skipped": f"host budget ({host_budget_s:.0f}s) "
         "exhausted before this config"

@@ -839,3 +839,76 @@ def unbounded_delete_in_hot_plane(ctx: FileContext) -> List[Finding]:
                 )
             )
     return out
+
+
+# Verify-consumer planes that must dispatch signature batches through
+# the unified scheduler (crypto/scheduler.py) rather than building
+# their own BatchVerifier / reaching the parallel-verify pool
+# directly: a bypass verifies OUTSIDE the priority classes, so a
+# catch-up storm it spawns can starve the live round the scheduler
+# exists to protect (ASY121). The sanctioned seams are crypto/ itself
+# and types/validation (the choke point every plane submits through).
+_ASY121_PREFIXES = (
+    "cometbft_tpu/consensus/",
+    "cometbft_tpu/blocksync/",
+    "cometbft_tpu/light/",
+    "cometbft_tpu/statesync/",
+    "cometbft_tpu/evidence/",
+)
+
+# direct-construction spellings of the batch-verifier backends plus
+# the factory; any of these in a hot plane is an unscheduled verify
+_ASY121_CTORS = {
+    "CpuBatchVerifier",
+    "CpuParallelBatchVerifier",
+    "TpuBatchVerifier",
+    "MeshBatchVerifier",
+    "create_batch_verifier",
+}
+
+
+@rule(
+    "ASY121",
+    "verify-bypass-scheduler",
+    "a hot-plane module (consensus/blocksync/light/statesync/"
+    "evidence) constructing a BatchVerifier or reaching the "
+    "parallel-verify pool directly: signature work dispatched outside "
+    "the unified scheduler's priority classes can starve the live "
+    "round — submit through crypto/scheduler.py (the types/validation "
+    "seam does this for every commit-verify entry point)",
+)
+def verify_bypass_scheduler(ctx: FileContext) -> List[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if not any(p in path for p in _ASY121_PREFIXES):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func) or ""
+        parts = name.split(".")
+        offending = None
+        if parts[-1] in _ASY121_CTORS:
+            offending = parts[-1]
+        elif "parallel_verify" in parts[:-1]:
+            # parallel_verify.engine() / .dispatch_stats_if_running()
+            # etc: stats reads are harmless but verification through
+            # the raw pool bypasses the classes — route the batch via
+            # the scheduler and read stats through obs/queues.py
+            if not parts[-1].endswith("_if_running"):
+                offending = name
+        if offending is None:
+            continue
+        out.append(
+            Finding(
+                ctx.path, node.lineno, node.col_offset,
+                "ASY121", "verify-bypass-scheduler",
+                f"`{name}(...)` verifies outside the unified "
+                "scheduler: this plane's batches must submit through "
+                "crypto/scheduler.py (priority class "
+                "live/light/catchup) or the types/validation seam — "
+                "a direct backend verify here shares no queue with "
+                "the live round and can starve it",
+            )
+        )
+    return out

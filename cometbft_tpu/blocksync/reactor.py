@@ -325,7 +325,10 @@ class BlockSyncReactor:
             if inflight is not None:
                 self.pipeline_stats["discarded"] += 1
             handle = verify_commits_coalesced_async(
-                self.state.chain_id, jobs, cache=self.sig_cache
+                self.state.chain_id,
+                jobs,
+                cache=self.sig_cache,
+                priority=T.PRIORITY_CATCHUP,
             )
             self.pipeline_stats["dispatched"] += 1
         return window, jobs, handle
@@ -359,7 +362,10 @@ class BlockSyncReactor:
             return None
         n_pre = len(pre_hs) - 1
         rest_handle = verify_commits_coalesced_async(
-            self.state.chain_id, jobs[n_pre:], cache=self.sig_cache
+            self.state.chain_id,
+            jobs[n_pre:],
+            cache=self.sig_cache,
+            priority=T.PRIORITY_CATCHUP,
         )
         self.pipeline_stats["reused"] += 1
         self.pipeline_stats["dispatched"] += 1
@@ -391,7 +397,10 @@ class BlockSyncReactor:
         return (
             pre_key,
             verify_commits_coalesced_async(
-                self.state.chain_id, pre_jobs, cache=self.sig_cache
+                self.state.chain_id,
+                pre_jobs,
+                cache=self.sig_cache,
+                priority=T.PRIORITY_CATCHUP,
             ),
         )
 
@@ -726,5 +735,6 @@ class BlockSyncReactor:
             h,
             ec,
             cache=self.sig_cache,
+            priority=T.PRIORITY_CATCHUP,
         )
         return ec_bytes

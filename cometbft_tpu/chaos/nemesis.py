@@ -210,6 +210,15 @@ class Nemesis:
             return await asyncio.to_thread(
                 probe_for_chaos, ev.inject_quadratic
             )
+        if ev.action == "verify_storm":
+            # three-class storm through the ONE process-wide verify
+            # scheduler the net's live consensus shares — worker
+            # thread for the same loop-stall reason as scaling_probe
+            from .verify_storm import storm_for_chaos
+
+            return await asyncio.to_thread(
+                storm_for_chaos, ev.storm_s, ev.live_budget_ms
+            )
         if ev.action == "statesync_join":
             name = await net.statesync_join(via=ev.via)
             return {"joined": name}

@@ -112,6 +112,19 @@ deterministic and fast):
                       lock_inversion's probe locks) is planted and
                       the run asserts the probe FLAGS it — the same
                       checker-validation discipline.
+``verify_storm``      run the unified-verify-scheduler storm
+                      (chaos/verify_storm.py) in a worker thread: a
+                      light-session storm + a blocksync-style
+                      catch-up storm + a synthetic live-wave feeder,
+                      all through the ONE process-wide scheduler the
+                      net's own consensus is verifying on. Verdict
+                      parity (bad signatures included) is asserted on
+                      every ticket, the live class's p95 wall is
+                      gated on ``live_budget_ms`` (the
+                      crypto.sched.dispatch budget), and the catch-up
+                      lane must keep completing tickets for the whole
+                      ``storm_s`` — a starved lane, a breached live
+                      budget, or a diverged verdict is a VIOLATION.
 ``crash_mid_prune``   ``node=i``: abort a retention reconcile pass
                       after ``abort_after`` bounded batches (drawn
                       from the MASTER rng when unset — the crash
@@ -153,6 +166,7 @@ ACTIONS = (
     "stall", "crash_wave", "statesync_join", "valset_churn",
     "wal_torn_tail", "conn_kill", "reconnect_storm", "lock_inversion",
     "scaling_probe", "crash_mid_prune", "snapshot_during_prune",
+    "verify_storm",
 )
 
 
@@ -184,6 +198,9 @@ class FaultEvent:
     inject_quadratic: bool = False  # scaling_probe: plant an O(n^2) site
     abort_after: Optional[int] = None  # crash_mid_prune: batches before
     # the abort (None = seeded draw from the MASTER rng)
+    storm_s: float = 1.5  # verify_storm: storm duration
+    live_budget_ms: float = 2500.0  # verify_storm: live-class p95 gate
+    # (the crypto.sched.dispatch budget, tools/span_budgets.toml)
 
     def __post_init__(self):
         if self.action not in ACTIONS:

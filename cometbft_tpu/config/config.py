@@ -290,15 +290,21 @@ class CryptoConfig:
     registry: "tpu" (device lanes, host-routed batches ride the
     parallel plane), "cpu" (serial host baseline), "cpu-parallel"
     (multi-core host plane, crypto/parallel_verify — the production
-    host policy when no device is reachable). Empty (the default)
-    inherits the process-wide default (crypto/batch.set_default_
-    backend — "tpu" unless the embedder changed it); a non-empty
-    value is applied at node build (node/inprocess.build_node). The
-    parallel plane's own knobs are env-based: GRAFT_VERIFY_WORKERS /
-    _TIER / _CHUNK_TARGET_MS / _MIN_PARALLEL (docs/PERF.md host
-    plane)."""
+    host policy when no device is reachable), "mesh" (multi-chip:
+    lanes shard over every local device via the shard_map/
+    PartitionSpec program, crypto/mesh_backend; DEGRADABLE — with
+    fewer than two devices it verifies on the cpu-parallel host
+    plane, so selecting it on a throttled no-mesh box is safe).
+    Empty (the default) inherits the process-wide default
+    (crypto/batch.set_default_backend — "tpu" unless the embedder
+    changed it); a non-empty value is applied at node build
+    (node/inprocess.build_node). The unified verify scheduler
+    (crypto/scheduler.py) routes every consumer's batches by this
+    backend. The parallel plane's own knobs are env-based:
+    GRAFT_VERIFY_WORKERS / _TIER / _CHUNK_TARGET_MS / _MIN_PARALLEL
+    (docs/PERF.md host plane)."""
 
-    batch_backend: str = ""  # "" (inherit) | tpu | cpu | cpu-parallel
+    batch_backend: str = ""  # "" (inherit) | tpu | cpu | cpu-parallel | mesh
     min_batch_for_tpu: int = 2
     coalesce_window_ms: float = 2.0
     max_lanes: int = 131072

@@ -448,6 +448,7 @@ class ConsensusState:
                 block.height,
                 commit,
                 cache=self.sig_cache,
+                priority=T.PRIORITY_LIVE,
             )
         except Exception:
             return
@@ -474,6 +475,7 @@ class ConsensusState:
                         block.height,
                         codec.decode_extended_commit(ec_bytes),
                         cache=self.sig_cache,
+                        priority=T.PRIORITY_LIVE,
                     )
                     self.block_store.save_extended_commit(
                         block.height, ec_bytes
@@ -1356,7 +1358,9 @@ class ConsensusState:
         # commit-latency waterfall, docs/TRACE.md)
         t_verify = time.monotonic_ns()
         try:
-            self.block_exec.validate_block(self.state, rs.proposal_block)
+            self.block_exec.validate_block(
+                self.state, rs.proposal_block, priority=T.PRIORITY_LIVE
+            )
             accepted = self.block_exec.process_proposal(
                 rs.proposal_block, self.state
             )
@@ -1426,7 +1430,11 @@ class ConsensusState:
             return
         if rs.proposal_block is not None and rs.proposal_block.hash() == bid.hash:
             try:
-                self.block_exec.validate_block(self.state, rs.proposal_block)
+                self.block_exec.validate_block(
+                    self.state,
+                    rs.proposal_block,
+                    priority=T.PRIORITY_LIVE,
+                )
                 rs.locked_round = round_
                 rs.locked_block = rs.proposal_block
                 rs.locked_block_parts = rs.proposal_block_parts

@@ -468,6 +468,16 @@ class TpuBatchVerifier(BatchVerifier):
 _default_backend = "tpu"
 _lock = threading.Lock()
 
+
+def _mesh_factory():
+    """Lazy factory for the multi-chip mesh backend — the import
+    touches jax device enumeration, which must not happen just
+    because the registry dict was built."""
+    from .mesh_backend import MeshBatchVerifier
+
+    return MeshBatchVerifier()
+
+
 # Backend registry: every coalesced caller goes through
 # create_batch_verifier(), so registering a backend here hands it to
 # all of them (types/validation windows, blocksync replay, light
@@ -477,6 +487,7 @@ _BACKENDS = {
     "tpu": TpuBatchVerifier,
     "cpu": CpuBatchVerifier,
     "cpu-parallel": CpuParallelBatchVerifier,
+    "mesh": _mesh_factory,
 }
 
 
@@ -488,6 +499,13 @@ def register_backend(name: str, factory) -> None:
 
 def backends() -> Tuple[str, ...]:
     return tuple(_BACKENDS)
+
+
+def default_backend() -> str:
+    """Name of the backend create_batch_verifier() would return — the
+    verify scheduler (crypto/scheduler.py) routes by it."""
+    with _lock:
+        return _default_backend
 
 
 def set_default_backend(name: str) -> None:

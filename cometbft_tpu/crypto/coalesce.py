@@ -22,7 +22,8 @@ from __future__ import annotations
 import asyncio
 from typing import List, Optional, Tuple
 
-from . import batch as crypto_batch
+from . import scheduler as crypto_sched
+from .scheduler import PRIORITY_LIVE
 from ..utils.log import get_logger
 
 _log = get_logger("coalesce")
@@ -50,10 +51,14 @@ class CoalescingVerifier:
         cache=None,
         window_s: float = DEFAULT_WINDOW_S,
         max_pending: int = DEFAULT_MAX_PENDING,
+        priority: int = PRIORITY_LIVE,
     ):
         self.cache = cache
         self.window_s = window_s
         self.max_pending = max_pending
+        # verify-scheduler class for dispatched windows: the consensus
+        # vote wave IS the live round, so LIVE by default
+        self.priority = priority
         self._pending: List[Tuple] = []
         self._timer: Optional[asyncio.Task] = None
         self._inflight: set = set()
@@ -118,13 +123,17 @@ class CoalescingVerifier:
         if not items:
             return
         self.dispatches += 1
-        verifier = crypto_batch.create_batch_verifier()
-        for pk, sb, sig, _fut in items:
-            verifier.add(pk, sb, sig)
         try:
-            # off the event loop: the batch may compile/dispatch to the
-            # device or grind host crypto — both release the GIL
-            _, oks = await asyncio.to_thread(verifier.verify)
+            # one LIVE-class ticket through the unified scheduler; the
+            # blocking resolve rides a worker thread so the loop stays
+            # free (the scheduler's dispatch may device-compile or
+            # grind host crypto — both release the GIL)
+            ticket = crypto_sched.scheduler().submit(
+                [(pk, sb, sig) for pk, sb, sig, _fut in items],
+                priority=self.priority,
+                label="vote-wave",
+            )
+            _, oks = await asyncio.to_thread(ticket.result)
         except asyncio.CancelledError:
             raise  # engine stop cancels the dispatch task
         except Exception as e:

@@ -1,0 +1,626 @@
+"""Unified verify scheduler: ONE dispatch queue for every signature
+verification consumer (docs/PERF.md "Unified verify scheduler").
+
+Before this seam each consumer reached the crypto engine through its
+own path — types/validation built a per-call BatchVerifier, the
+consensus vote coalescer and the light serving plane each window-
+batched on their own, blocksync pipelined through the same unordered
+pool — so a live round's precommit wave could queue behind a
+500-block catch-up window sharing the host pool. The scheduler is the
+single choke point those seams now submit to:
+
+- **Priority classes**: live round (0) > light session (1) >
+  catch-up/evidence (2). Dispatch granularity is one calibrated chunk
+  (~4 ms of host work, crypto/parallel_verify.chunk_size), so a live
+  batch arriving mid-storm preempts at the next chunk boundary — a
+  bounded wait of roughly workers x chunk-wall, never the storm's
+  full residue.
+- **Starvation guard**: a queued ticket older than ``promote_after_s``
+  is served ahead of higher classes once every ``promote_every``
+  picks — catch-up keeps a bounded 1/promote_every share of dispatch
+  slots under ANY sustained live load (tests/test_verify_scheduler).
+- **Per-backend lanes + calibrated routing**: the routing decision is
+  the exact decision crypto/batch.TpuBatchVerifier._route takes —
+  same _MIN_TPU_BATCH floor, same measured host-vs-device crossover
+  EWMA (crypto/batch.calibration), same explore/recovery schedule —
+  so migrating a consumer onto the scheduler cannot change WHERE its
+  lanes verify, only when. Device dispatches ride the async XLA seam
+  with the same readiness-watcher calibration feed; the ``mesh``
+  backend (crypto/mesh_backend) shards lanes over every local device
+  and degrades to host chunks when no mesh materializes.
+
+Verdicts are serial-equivalent BY CONSTRUCTION: every lane runs the
+same ``pk.verify``/kernel math the direct backends run, merged back
+in submission order (differential-tested in
+tests/test_verify_scheduler.py and gated in-bench by the
+``verify-sched`` leg).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
+
+from ..trace import global_tracer
+from ..utils.log import get_logger
+from . import batch as crypto_batch
+from .keys import Ed25519PubKey
+
+_log = get_logger("crypto.sched")
+
+# Priority classes, lower value = served first.
+PRIORITY_LIVE = 0
+PRIORITY_LIGHT = 1
+PRIORITY_CATCHUP = 2
+
+CLASS_NAMES = ("live", "light", "catchup")
+
+# Starvation guard defaults: a ticket queued longer than this is
+# "aged"; one aged chunk is served per PROMOTE_EVERY picks while any
+# aged ticket exists, so lower classes keep a bounded share of
+# dispatch slots under sustained higher-class load.
+DEFAULT_PROMOTE_AFTER_S = 0.25
+DEFAULT_PROMOTE_EVERY = 4
+
+
+def _clamp_priority(priority) -> int:
+    try:
+        p = int(priority)
+    except (TypeError, ValueError):
+        return PRIORITY_CATCHUP
+    return min(max(p, PRIORITY_LIVE), PRIORITY_CATCHUP)
+
+
+class VerifyTicket:
+    """One submitted batch: ``result()`` blocks for the merged
+    verdicts, returning ``(all_ok, oks)`` exactly like the
+    BatchVerifier async handles (crypto/batch.ResolvedVerdicts), so
+    the validation seam plumbs it through unchanged."""
+
+    __slots__ = (
+        "items", "priority", "label", "t_submit", "t_done", "oks",
+        "backend", "_chunks", "_units_left", "_event", "_routed",
+    )
+
+    def __init__(self, items, priority: int, label: str) -> None:
+        self.items = items
+        self.priority = priority
+        self.label = label
+        self.t_submit = time.perf_counter()
+        self.t_done: Optional[float] = None
+        self.oks: List[bool] = [False] * len(items)
+        self.backend: Optional[str] = None
+        self._chunks: deque = deque()
+        self._units_left = 0
+        self._event = threading.Event()
+        self._routed = False
+
+    def result(self, timeout: Optional[float] = None) -> Tuple[bool, List[bool]]:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"verify ticket ({len(self.items)} lanes, "
+                f"class={CLASS_NAMES[self.priority]}) not resolved "
+                f"within {timeout}s"
+            )
+        oks = self.oks
+        return all(oks) and bool(oks), oks
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wall(self) -> Optional[float]:
+        """Submit→resolve wall (queue wait INCLUDED — the latency the
+        priority classes exist to bound), or None while pending."""
+        done = self.t_done
+        return None if done is None else done - self.t_submit
+
+
+class VerifyScheduler:
+    """Single dispatch queue with priority classes and per-backend
+    lanes. Thread-safe; one daemon dispatcher thread started lazily on
+    first submit."""
+
+    def __init__(
+        self,
+        promote_after_s: float = DEFAULT_PROMOTE_AFTER_S,
+        promote_every: int = DEFAULT_PROMOTE_EVERY,
+    ) -> None:
+        self.promote_after_s = promote_after_s
+        self.promote_every = max(1, promote_every)
+        self._cv = threading.Condition()
+        self._queues: Tuple[deque, ...] = (deque(), deque(), deque())
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._promo_credit = 0
+        # host-pool backpressure: chunks in flight on the shared pool,
+        # bounded to the worker count so a late-arriving live ticket
+        # waits at most one chunk-wall per worker
+        self._inflight = 0
+        self._max_slots: Optional[int] = None
+        # stats (obs registry + tests + bench)
+        self.enqueued_lanes = 0
+        self.done_lanes = 0
+        self.enqueued_by_class = [0, 0, 0]
+        self.done_by_class = [0, 0, 0]
+        self.depth_hwm = 0
+        self.promoted = 0
+        self.device_dispatches = 0
+        self.host_chunks = 0
+        self.degraded = 0
+        self.tickets = 0
+
+    # --- submission ----------------------------------------------------
+
+    def submit(
+        self,
+        items: Sequence,
+        priority: int = PRIORITY_CATCHUP,
+        label: str = "",
+    ) -> VerifyTicket:
+        """Queue (pubkey, msg, sig) lanes for verification under a
+        priority class; returns immediately with a VerifyTicket."""
+        priority = _clamp_priority(priority)
+        ticket = VerifyTicket(list(items), priority, label)
+        if not ticket.items:
+            # empty batch resolves to (False, []) like BatchVerifier
+            ticket.t_done = ticket.t_submit
+            ticket._event.set()
+            return ticket
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("verify scheduler closed")
+            self.tickets += 1
+            n = len(ticket.items)
+            self.enqueued_lanes += n
+            self.enqueued_by_class[priority] += n
+            self._queues[priority].append(ticket)
+            depth = self.enqueued_lanes - self.done_lanes
+            if depth > self.depth_hwm:
+                self.depth_hwm = depth
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop,
+                    name="verify-sched",
+                    daemon=True,
+                )
+                self._thread.start()
+            self._cv.notify_all()
+        return ticket
+
+    # --- dispatcher ----------------------------------------------------
+
+    def _slots(self) -> int:
+        if self._max_slots is None:
+            from .parallel_verify import engine
+
+            self._max_slots = max(1, engine().workers)
+        return self._max_slots
+
+    def _pick_locked(self) -> Optional[VerifyTicket]:
+        """Highest-priority non-empty class, with the bounded aging
+        promotion (starvation guard). Caller holds the lock."""
+        best_cls = None
+        for cls in (PRIORITY_LIVE, PRIORITY_LIGHT, PRIORITY_CATCHUP):
+            if self._queues[cls]:
+                best_cls = cls
+                break
+        if best_cls is None:
+            return None
+        now = time.perf_counter()
+        aged = None
+        for cls in range(best_cls + 1, len(self._queues)):
+            q = self._queues[cls]
+            if q and now - q[0].t_submit > self.promote_after_s:
+                if aged is None or q[0].t_submit < aged.t_submit:
+                    aged = q[0]
+        if aged is not None:
+            self._promo_credit += 1
+            if self._promo_credit >= self.promote_every:
+                self._promo_credit = 0
+                self.promoted += 1
+                return aged
+        return self._queues[best_cls][0]
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                ticket = None
+                while True:
+                    if self._inflight < self._slots():
+                        ticket = self._pick_locked()
+                    if ticket is not None or self._closed:
+                        break
+                    # bounded wait: aging promotions must be
+                    # re-evaluated even with no new submissions
+                    self._cv.wait(0.05)
+                if ticket is None and self._closed:
+                    return
+                if ticket is None:
+                    continue
+                if ticket._routed:
+                    chunk = ticket._chunks.popleft()
+                    if not ticket._chunks:
+                        self._queues[ticket.priority].remove(ticket)
+                else:
+                    chunk = None
+                    self._queues[ticket.priority].remove(ticket)
+            try:
+                if chunk is None:
+                    self._route(ticket)
+                else:
+                    self._run_chunk(ticket, chunk)
+            except Exception as e:  # pragma: no cover - last resort
+                # verdicts must never be lost: resolve the affected
+                # lanes by per-item host verification
+                _log.error(
+                    "verify dispatch failed; per-item host fallback",
+                    err=repr(e),
+                    lanes=len(ticket.items),
+                )
+                self._fallback_serial(ticket, chunk)
+
+    # --- routing -------------------------------------------------------
+
+    def _route(self, ticket: VerifyTicket) -> None:
+        """First pop: split lanes by curve, take the calibrated
+        backend-routing decision (the same decision
+        crypto/batch.TpuBatchVerifier._route takes), dispatch the
+        device part async, queue the host part as calibrated chunks."""
+        items = ticket.items
+        ed_idx: List[int] = []
+        ed_items = []
+        other_idx: List[int] = []
+        for i, (pk, msg, sig) in enumerate(items):
+            if isinstance(pk, Ed25519PubKey):
+                ed_idx.append(i)
+                ed_items.append((msg, pk.key_bytes, sig))
+            else:
+                other_idx.append(i)
+        backend = crypto_batch.default_backend()
+        ticket.backend = backend
+        if backend not in ("tpu", "cpu", "cpu-parallel", "mesh"):
+            # custom registered backend (register_backend): preserve
+            # its semantics verbatim — build it and resolve on the
+            # dispatcher thread (priority ordering still applied at
+            # pick time; preemption granularity is the whole ticket)
+            verifier = crypto_batch.create_batch_verifier()
+            for pk, msg, sig in items:
+                verifier.add(pk, msg, sig)
+            _, oks = verifier.verify()
+            ticket.oks[:] = oks
+            ticket._routed = True
+            self._finish(ticket, len(items))
+            return
+        n_ed = len(ed_items)
+        forced = crypto_batch._MIN_TPU_BATCH <= 1
+        cal = crypto_batch.calibration
+        use_device = False
+        if backend == "tpu":
+            use_device = n_ed >= crypto_batch._MIN_TPU_BATCH and (
+                forced
+                or (
+                    (cal.device_wins(n_ed) or cal.should_explore())
+                    and not crypto_batch._jax_backend_is_cpu()
+                )
+            )
+            if use_device and not forced:
+                cal.note_device_used()
+        elif backend == "mesh":
+            # explicit operator choice: shard whenever a mesh exists
+            # (no calibration gate — the mesh IS the configured
+            # plane); honor the batch floor so tiny commits stay on
+            # host, and degrade to host chunks with no mesh
+            from .mesh_backend import mesh_devices
+
+            if mesh_devices() > 1:
+                use_device = n_ed > 0 and (
+                    forced or n_ed >= crypto_batch._MIN_TPU_BATCH
+                )
+            else:
+                ticket.backend = "mesh-degraded"
+                self.degraded += 1
+        crypto_batch.LAST_ROUTE.update(
+            path="device" if use_device else "host",
+            n=n_ed,
+            crossover=None if forced else cal.crossover(),
+        )
+        # non-ed lanes: verified inline at route time (rare curves,
+        # exactly TpuBatchVerifier._host_lanes' treatment)
+        for i in other_idx:
+            pk, msg, sig = items[i]
+            ticket.oks[i] = pk.verify(msg, sig)
+        ticket._routed = True
+        if use_device and ed_idx:
+            if self._dispatch_device(ticket, ed_idx, ed_items, backend):
+                return
+            # device dispatch failed: re-route the lanes to host
+            ticket.backend = f"{backend}-degraded"
+            self.degraded += 1
+        self._queue_host_chunks(ticket, ed_idx)
+
+    def _dispatch_device(
+        self, ticket: VerifyTicket, ed_idx, ed_items, backend: str
+    ) -> bool:
+        """Async device dispatch for the ed25519 lanes; a daemon
+        watcher feeds the calibration EWMA from true readiness
+        (wait_fetch — block_until_ready does not block through the
+        axon tunnel, crypto/batch.verify_async) and resolves the
+        ticket. Returns False when the dispatch itself fails."""
+        try:
+            from ..ops import ed25519 as _ed
+
+            t0 = time.perf_counter()
+            handle = _ed.verify_batch_async(ed_items)
+        except Exception as e:
+            _log.error(
+                "device dispatch failed; host chunks",
+                backend=backend,
+                err=repr(e),
+                lanes=len(ed_items),
+            )
+            return False
+        self.device_dispatches += 1
+        ticket._units_left += 1
+        n_ed = len(ed_items)
+        cal = crypto_batch.calibration
+
+        def _watch():
+            try:
+                getattr(handle, "wait_fetch", handle.wait)()
+                cal.observe_device(n_ed, time.perf_counter() - t0)
+                verdicts = handle.result()
+            except Exception as e:
+                _log.error(
+                    "device resolve failed; per-item host fallback",
+                    err=repr(e),
+                    lanes=n_ed,
+                )
+                verdicts = [
+                    _host_verify_one(ticket.items[i]) for i in ed_idx
+                ]
+            for i, v in zip(ed_idx, verdicts):
+                ticket.oks[i] = bool(v)
+            self._unit_done(ticket, n_ed)
+
+        threading.Thread(
+            target=_watch, name="verify-sched-dev", daemon=True
+        ).start()
+        return True
+
+    def _queue_host_chunks(self, ticket: VerifyTicket, ed_idx) -> None:
+        """Chunk the host-routed ed25519 lanes (calibrated ~4 ms of
+        serial work each — the preemption granularity) and requeue the
+        ticket at the FRONT of its class so its chunks drain before
+        later same-class arrivals."""
+        if not ed_idx:
+            if ticket._units_left == 0:
+                self._finish(ticket, 0)
+            return
+        from .parallel_verify import engine
+
+        eng = engine()
+        chunk = eng.chunk_size(len(ed_idx))
+        chunks = [
+            ed_idx[s : s + chunk] for s in range(0, len(ed_idx), chunk)
+        ]
+        with self._cv:
+            ticket._chunks.extend(chunks)
+            ticket._units_left += len(chunks)
+            self._queues[ticket.priority].appendleft(ticket)
+            self._cv.notify_all()
+
+    # --- host execution ------------------------------------------------
+
+    def _run_chunk(self, ticket: VerifyTicket, idx_chunk) -> None:
+        """One host chunk: on the shared pool when it pays (slot-
+        bounded so priorities hold at chunk granularity), inline on
+        the dispatcher thread otherwise (serial tier / tiny work)."""
+        from .parallel_verify import _verify_chunk, engine
+
+        eng = engine()
+        chunk_items = [ticket.items[i] for i in idx_chunk]
+        self.host_chunks += 1
+        pool = None
+        if ticket.backend != "cpu" and len(ticket.items) >= eng.min_parallel:
+            pool = eng._ensure_pool()
+        if pool is None:
+            oks, wall = _verify_chunk(chunk_items, eng.tier)
+            self._chunk_resolved(ticket, idx_chunk, oks, wall, eng)
+            return
+        if eng.tier == "process":
+            chunk_items = [
+                (pk, bytes(m), bytes(s)) for pk, m, s in chunk_items
+            ]
+        with self._cv:
+            self._inflight += 1
+        try:
+            fut = pool.submit(_verify_chunk, chunk_items, eng.tier)
+        except RuntimeError:
+            # pool shut down underneath us (teardown): inline
+            with self._cv:
+                self._inflight -= 1
+            oks, wall = _verify_chunk(chunk_items, eng.tier)
+            self._chunk_resolved(ticket, idx_chunk, oks, wall, eng)
+            return
+        eng._chunk_submitted()
+
+        def _done(f):
+            eng._chunk_done()
+            with self._cv:
+                self._inflight -= 1
+                self._cv.notify_all()
+            try:
+                oks, wall = f.result()
+            except Exception:  # pragma: no cover - worker died
+                oks = [
+                    _host_verify_one(ticket.items[i]) for i in idx_chunk
+                ]
+                wall = 0.0
+            self._chunk_resolved(ticket, idx_chunk, oks, wall, eng)
+
+        fut.add_done_callback(_done)
+
+    def _chunk_resolved(self, ticket, idx_chunk, oks, wall, eng) -> None:
+        for i, ok in zip(idx_chunk, oks):
+            ticket.oks[i] = bool(ok)
+        n = len(idx_chunk)
+        if wall:
+            eng._observe_chunk(n, wall)
+            if ticket.backend == "tpu":
+                # host-vs-device routing EWMA: fed only on the backend
+                # whose routing consults it (TpuBatchVerifier parity —
+                # the cpu backends never calibrated)
+                crypto_batch.calibration.observe_host(n, wall)
+        self._unit_done(ticket, n)
+
+    def _fallback_serial(self, ticket, idx_chunk) -> None:
+        idx = idx_chunk if idx_chunk is not None else range(len(ticket.items))
+        for i in idx:
+            ticket.oks[i] = _host_verify_one(ticket.items[i])
+        if idx_chunk is None:
+            # routing never completed: the whole ticket is resolved
+            ticket._routed = True
+            self._finish(ticket, len(ticket.items))
+        else:
+            self._unit_done(ticket, len(idx_chunk))
+
+    # --- completion ----------------------------------------------------
+
+    def _unit_done(self, ticket: VerifyTicket, lanes: int) -> None:
+        with self._cv:
+            ticket._units_left -= 1
+            last = ticket._units_left <= 0 and not ticket._chunks
+        if last:
+            self._finish(ticket, len(ticket.items))
+
+    def _finish(self, ticket: VerifyTicket, lanes: int) -> None:
+        ticket.t_done = time.perf_counter()
+        with self._cv:
+            n = len(ticket.items)
+            self.done_lanes += n
+            self.done_by_class[ticket.priority] += n
+            self._cv.notify_all()
+        tr = global_tracer()
+        if tr.enabled:
+            tr.complete(
+                "crypto.sched.dispatch",
+                time.monotonic_ns()
+                - int((ticket.t_done - ticket.t_submit) * 1e9),
+                int((ticket.t_done - ticket.t_submit) * 1e9),
+                tid="crypto.sched",
+                cls=CLASS_NAMES[ticket.priority],
+                backend=ticket.backend or "?",
+                lanes=len(ticket.items),
+            )
+        ticket._event.set()
+
+    # --- observability / lifecycle -------------------------------------
+
+    def queue_stats(self) -> dict:
+        """Backpressure snapshot (obs/queues.py registry): pending
+        lane depth overall + per class. Queued-but-unrouted tickets
+        count every lane; routed tickets count their unfinished
+        chunks' share. No ``maxsize`` — the queue is unbounded by
+        design, depth is load, not overload."""
+        with self._cv:
+            depth = self.enqueued_lanes - self.done_lanes
+            per = {}
+            for cls, name in enumerate(CLASS_NAMES):
+                per[f"{name}_depth"] = (
+                    self.enqueued_by_class[cls] - self.done_by_class[cls]
+                )
+            out = {
+                "depth": max(depth, 0),
+                "high_watermark": self.depth_hwm,
+                "enqueued": self.enqueued_lanes,
+                "dropped": 0,
+                "inflight_chunks": self._inflight,
+                "promoted": self.promoted,
+                "device_dispatches": self.device_dispatches,
+                "host_chunks": self.host_chunks,
+                "degraded": self.degraded,
+            }
+            out.update(per)
+            return out
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "tickets": self.tickets,
+                "lanes": self.enqueued_lanes,
+                "by_class": {
+                    name: self.enqueued_by_class[cls]
+                    for cls, name in enumerate(CLASS_NAMES)
+                },
+                "promoted": self.promoted,
+                "device_dispatches": self.device_dispatches,
+                "host_chunks": self.host_chunks,
+                "degraded": self.degraded,
+            }
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until every submitted lane resolved (tests/bench)."""
+        deadline = time.perf_counter() + timeout
+        with self._cv:
+            while self.done_lanes < self.enqueued_lanes:
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    return False
+                self._cv.wait(min(left, 0.1))
+        return True
+
+    def close(self) -> None:
+        """Stop the dispatcher after the queue drains (shutdown)."""
+        self.drain(timeout=5.0)
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+
+
+def _host_verify_one(item) -> bool:
+    """Per-item host verification — the never-raises fallback lane."""
+    pk, msg, sig = item
+    try:
+        return bool(pk.verify(msg, sig))
+    except Exception:
+        return False
+
+
+# --- process-wide default scheduler --------------------------------------
+
+_SCHED: Optional[VerifyScheduler] = None
+_SCHED_LOCK = threading.Lock()
+
+
+def scheduler() -> VerifyScheduler:
+    """The shared scheduler every verify consumer submits through
+    (types/validation, the consensus vote coalescer, light serving,
+    blocksync, statesync, evidence). Created lazily on first use."""
+    global _SCHED
+    with _SCHED_LOCK:
+        if _SCHED is None:
+            _SCHED = VerifyScheduler()
+        return _SCHED
+
+
+def set_scheduler(s: Optional[VerifyScheduler]) -> None:
+    """Swap the process-wide scheduler (tests / operator reconfig)."""
+    global _SCHED
+    with _SCHED_LOCK:
+        old, _SCHED = _SCHED, s
+    if old is not None and old is not s:
+        old.close()
+
+
+def sched_stats_if_running() -> Optional[dict]:
+    """Queue-depth gauges for the obs registry, or None when no
+    scheduler was ever built — the registry entry must never CREATE
+    the scheduler (dispatcher spin-up) just to report an idle plane."""
+    with _SCHED_LOCK:
+        s = _SCHED
+    return None if s is None else s.queue_stats()

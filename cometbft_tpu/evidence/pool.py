@@ -130,7 +130,11 @@ class EvidencePool:
         # trusting verification against the common valset, then full
         # verification by the conflicting block's own valset
         T.verify_commit_light_trusting(
-            state.chain_id, common_vals, lb.commit, all_signatures=True
+            state.chain_id,
+            common_vals,
+            lb.commit,
+            all_signatures=True,
+            priority=T.PRIORITY_CATCHUP,
         )
         T.verify_commit_light(
             state.chain_id,
@@ -139,6 +143,7 @@ class EvidencePool:
             lb.height,
             lb.commit,
             all_signatures=True,
+            priority=T.PRIORITY_CATCHUP,
         )
         # the claimed byzantine set and total power must equal what WE
         # derive from the common valset — the slashing targets cannot

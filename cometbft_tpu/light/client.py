@@ -59,6 +59,7 @@ class Client:
         signature_cache: Optional[T.SignatureCache] = None,
         header_cache=None,
         verify_engine=None,
+        priority: Optional[int] = None,
     ):
         self.chain_id = chain_id
         self.trust = trust_options
@@ -84,6 +85,10 @@ class Client:
         # commit-verify engine concurrent clients batch through
         self.header_cache = header_cache
         self.verify_engine = verify_engine
+        # verify-scheduler class for this client's commit checks
+        # (crypto/scheduler.py): serving sessions run PRIORITY_LIGHT,
+        # the statesync state provider PRIORITY_CATCHUP
+        self.priority = priority
         # blocks verified by the CURRENT verify_header call, held back
         # from the shared cache until the witness cross-check passes —
         # a valid-but-forked chain (a light-client attack the detector
@@ -200,6 +205,7 @@ class Client:
             lb.height,
             lb.commit,
             cache=self.cache,
+            priority=self.priority,
         )
         self.store.save(lb)
 
@@ -435,6 +441,7 @@ class Client:
                 self.drift,
                 cache=self.cache,
                 engine=self.verify_engine,
+                priority=self.priority,
             )
             self._note_verified(nxt)
             trusted = nxt
@@ -479,6 +486,7 @@ class Client:
                         self.drift,
                         cache=self.cache,
                         engine=self.verify_engine,
+                        priority=self.priority,
                     )
                 else:
                     trusted_next_vals = self._next_vals(trusted)
@@ -494,6 +502,7 @@ class Client:
                         self.trust_level,
                         cache=self.cache,
                         engine=self.verify_engine,
+                        priority=self.priority,
                     )
                 self._note_verified(candidate)
                 trusted = candidate

@@ -103,6 +103,7 @@ def check_against_witnesses(client, verified: LightBlock) -> None:
                 wlb.height,
                 wlb.commit,
                 cache=client.cache,
+                priority=client.priority,
             )
         except Exception:
             # provably bad witness (invalid conflicting block):

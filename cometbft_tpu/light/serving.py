@@ -468,6 +468,7 @@ class CoalescedCommitVerifier:
                 self.chain_id,
                 [e.job for e in batch],
                 cache=self.signature_cache,
+                priority=T.PRIORITY_LIGHT,
             )
         except BaseException as e:  # engine failure: everyone errors
             errors = [e] * len(batch)
@@ -597,6 +598,9 @@ class LightServingPlane:
         client.header_cache = self.cache
         client.verify_engine = self.engine
         client.cache = self.signature_cache
+        # serving sessions verify under the LIGHT scheduler class:
+        # above catch-up storms, below the live round
+        client.priority = T.PRIORITY_LIGHT
 
     def _checkout(self):
         with self._client_cond:

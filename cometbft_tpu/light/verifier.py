@@ -52,6 +52,7 @@ def verify_adjacent(
     max_clock_drift_ns: int = 10 * 10**9,
     cache: Optional[T.SignatureCache] = None,
     engine=None,
+    priority: Optional[int] = None,
 ) -> None:
     now_ns = now_ns or time.time_ns()
     if untrusted.height != trusted.height + 1:
@@ -83,6 +84,7 @@ def verify_adjacent(
         untrusted.height,
         untrusted.commit,
         cache=cache,
+        priority=priority,
     )
 
 
@@ -98,6 +100,7 @@ def verify_non_adjacent(
     trust_level: Fraction = DEFAULT_TRUST_LEVEL,
     cache: Optional[T.SignatureCache] = None,
     engine=None,
+    priority: Optional[int] = None,
 ) -> None:
     now_ns = now_ns or time.time_ns()
     if untrusted.height == trusted.height + 1:
@@ -128,6 +131,7 @@ def verify_non_adjacent(
             untrusted.commit,
             trust_level=trust_level,
             cache=cache,
+            priority=priority,
         )
     except T.ErrNotEnoughVotingPower as e:
         raise ErrNewValSetCantBeTrusted(str(e))
@@ -138,6 +142,7 @@ def verify_non_adjacent(
         untrusted.height,
         untrusted.commit,
         cache=cache,
+        priority=priority,
     )
 
 

@@ -314,6 +314,11 @@ class Node:
         from ..crypto.parallel_verify import dispatch_stats_if_running
 
         q.register("crypto.verify.dispatch", dispatch_stats_if_running)
+        # process-wide: the unified verify scheduler's per-class
+        # queue-depth gauges (live/light/catchup lanes pending)
+        from ..crypto.scheduler import sched_stats_if_running
+
+        q.register("crypto.sched", sched_stats_if_running)
 
     # --- phase switching ----------------------------------------------
 

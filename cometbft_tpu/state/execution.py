@@ -371,7 +371,11 @@ class BlockExecutor:
     # --- validation (reference :205) ----------------------------------
 
     def validate_block(
-        self, state: State, block: T.Block, skip_commit_check: bool = False
+        self,
+        state: State,
+        block: T.Block,
+        skip_commit_check: bool = False,
+        priority=None,
     ) -> None:
         bh = block.hash()
         if self._last_validated == bh:
@@ -379,6 +383,7 @@ class BlockExecutor:
         validate_block(
             state, block, cache=self.sig_cache,
             skip_commit_check=skip_commit_check,
+            priority=priority,
         )
         # block-time tolerance: reject blocks too far in the future
         # (only when enabled, reference state/validation.go:124)

@@ -19,9 +19,13 @@ def validate_block(
     block: T.Block,
     cache: Optional[T.SignatureCache] = None,
     skip_commit_check: bool = False,
+    priority: Optional[int] = None,
 ) -> None:
     """skip_commit_check: blocksync verified LastCommit already via the
-    coalesced batch path (reference blocksync SkipLastCommit flag)."""
+    coalesced batch path (reference blocksync SkipLastCommit flag).
+    ``priority``: verify-scheduler class for the LastCommit check —
+    the live consensus executor passes PRIORITY_LIVE; replay paths
+    default to catch-up."""
     block.validate_basic()
     h = block.header
     if h.chain_id != state.chain_id:
@@ -62,6 +66,7 @@ def validate_block(
                 h.height - 1,
                 block.last_commit,
                 cache=cache,
+                priority=priority,
             )
 
     # evidence

@@ -58,6 +58,9 @@ class LightClientStateProvider:
             signature_cache=signature_cache,
             header_cache=header_cache,
             verify_engine=verify_engine,
+            # statesync restore is bulk catch-up work: it must never
+            # preempt a live round sharing the verify scheduler
+            priority=T.PRIORITY_CATCHUP,
         )
 
     def cache_stats(self) -> dict:

@@ -30,6 +30,9 @@ from .canonical import (  # noqa: F401
 from .part_set import BLOCK_PART_SIZE, Part, PartSet  # noqa: F401
 from .signature_cache import SignatureCache  # noqa: F401
 from .validation import (  # noqa: F401
+    PRIORITY_CATCHUP,
+    PRIORITY_LIGHT,
+    PRIORITY_LIVE,
     CommitVerifyError,
     ErrInvalidSignature,
     ErrNotEnoughVotingPower,

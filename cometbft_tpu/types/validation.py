@@ -20,7 +20,12 @@ from __future__ import annotations
 from fractions import Fraction
 from typing import Optional
 
-from ..crypto import batch as crypto_batch
+from ..crypto import scheduler as crypto_sched
+from ..crypto.scheduler import (  # re-exported: consumers pass these
+    PRIORITY_CATCHUP,
+    PRIORITY_LIGHT,
+    PRIORITY_LIVE,
+)
 from .block import BLOCK_ID_FLAG_COMMIT, BlockID, Commit
 from .canonical import (
     PRECOMMIT_TYPE,
@@ -92,27 +97,46 @@ def _basic_checks(
         raise CommitVerifyError("wrong BlockID in commit")
 
 
-def _run_batch_async(items, cache: Optional[SignatureCache]):
+def _run_batch_async(
+    items,
+    cache: Optional[SignatureCache],
+    priority: Optional[int] = None,
+    label: str = "",
+):
     """items: list of (pubkey, sign_bytes, sig). Returns a handle whose
     ``result()`` yields list[bool] — async so callers (the blocksync
     window pipeline) can overlap host work with the verification in
-    flight. Genuinely pending on BOTH planes since the cpu-parallel
-    backend landed: device batches ride the XLA async dispatch,
-    host-routed batches ride the multi-core pool
-    (crypto/parallel_verify) — either way the caller's decode/apply
-    work proceeds while lanes verify (docs/PERF.md host plane)."""
+    flight.
+
+    THE single choke point onto the unified verify scheduler
+    (crypto/scheduler.py): cache-unskipped lanes are submitted as one
+    ticket under the caller's priority class — live round > light
+    session > catch-up/evidence (default) — and the scheduler takes
+    the calibrated backend-routing decision from there. The handle is
+    genuinely pending on every backend: device batches ride the XLA
+    async dispatch, host-routed batches ride the slot-bounded chunk
+    pipeline — either way the caller's decode/apply work proceeds
+    while lanes verify (docs/PERF.md "Unified verify scheduler")."""
     to_verify = []
+    lanes = []
     skip = [False] * len(items)
     if cache is not None:
         for i, (pk, sb, sig) in enumerate(items):
             if cache.contains(sb, sig, pk.key_bytes):
                 skip[i] = True
-    verifier = crypto_batch.create_batch_verifier()
-    for i, (pk, sb, sig) in enumerate(items):
+    for i, item in enumerate(items):
         if not skip[i]:
-            verifier.add(pk, sb, sig)
+            lanes.append(item)
             to_verify.append(i)
-    pending = verifier.verify_async() if len(verifier) else None
+    pending = (
+        crypto_sched.scheduler().submit(
+            lanes,
+            priority=PRIORITY_CATCHUP if priority is None else priority,
+            label=label,
+        )
+        if lanes
+        else None
+    )
     return _BatchHandle(items, to_verify, pending, cache)
 
 
@@ -142,11 +166,18 @@ class _BatchHandle:
         return oks
 
 
-def _run_batch(items, cache: Optional[SignatureCache]):
+def _run_batch(
+    items,
+    cache: Optional[SignatureCache],
+    priority: Optional[int] = None,
+    label: str = "",
+):
     """items: list of (pubkey, sign_bytes, sig). Returns list[bool]."""
     if not items:
         return []
-    return _run_batch_async(items, cache).result()
+    return _run_batch_async(
+        items, cache, priority=priority, label=label
+    ).result()
 
 
 def verify_commit(
@@ -156,10 +187,13 @@ def verify_commit(
     height: int,
     commit: Commit,
     cache: Optional[SignatureCache] = None,
+    priority: Optional[int] = None,
 ) -> None:
     """Full verification: every non-absent signature must be valid
     (including nil votes), and >2/3 of power must have signed block_id.
-    (reference types/validation.go:30; used by blocksync + ingest)."""
+    (reference types/validation.go:30; used by blocksync + ingest).
+    ``priority`` is the verify-scheduler class (PRIORITY_LIVE for the
+    consensus hot path; default catch-up)."""
     _basic_checks(vals, commit, height, block_id)
     items = []
     tally_idx = []
@@ -175,7 +209,7 @@ def verify_commit(
             (val.pub_key, _commit_sign_bytes(chain_id, commit, cs), cs.signature)
         )
         tally_idx.append(i)
-    oks = _run_batch(items, cache)
+    oks = _run_batch(items, cache, priority=priority, label="commit")
     tallied = 0
     for (i, ok) in zip(tally_idx, oks):
         if not ok:
@@ -307,6 +341,7 @@ def verify_commit_light(
     commit: Commit,
     cache: Optional[SignatureCache] = None,
     all_signatures: bool = False,
+    priority: Optional[int] = None,
 ) -> None:
     """Light verification: only signatures for block_id are checked and
     tallied up to the 2/3 threshold (reference :65; all_signatures=True
@@ -315,7 +350,7 @@ def verify_commit_light(
     lanes = _collect_light_lanes(
         chain_id, vals, block_id, height, commit, all_signatures, items
     )
-    oks = _run_batch(items, cache)
+    oks = _run_batch(items, cache, priority=priority, label="light")
     _fold_light_lanes(lanes, oks, vals, commit)
 
 
@@ -324,6 +359,7 @@ def verify_commits_coalesced_async(
     jobs,
     cache: Optional[SignatureCache] = None,
     light: bool = True,
+    priority: Optional[int] = None,
 ):
     """Async form of verify_commits_coalesced: enqueues ONE lane batch
     for every job's signatures and returns a handle whose ``result()``
@@ -367,7 +403,9 @@ def verify_commits_coalesced_async(
             lanes = []
         job_lanes.append(lanes)
 
-    batch_handle = _run_batch_async(items, cache)
+    batch_handle = _run_batch_async(
+        items, cache, priority=priority, label="coalesced"
+    )
     return _CoalescedHandle(batch_handle, jobs, job_lanes, errors)
 
 
@@ -416,6 +454,7 @@ def verify_commits_coalesced(
     jobs,
     cache: Optional[SignatureCache] = None,
     light: bool = True,
+    priority: Optional[int] = None,
 ) -> list:
     """Verify MANY commits in one TPU dispatch (cross-height coalescing).
 
@@ -427,7 +466,7 @@ def verify_commits_coalesced(
     north star: amortize thousands of validator sigs per XLA dispatch).
     """
     return verify_commits_coalesced_async(
-        chain_id, jobs, cache=cache, light=light
+        chain_id, jobs, cache=cache, light=light, priority=priority
     ).result()
 
 
@@ -435,6 +474,7 @@ def verify_commit_jobs_coalesced(
     chain_id: str,
     jobs,
     cache: Optional[SignatureCache] = None,
+    priority: Optional[int] = None,
 ) -> list:
     """Mixed-kind coalesced verification: MANY light and trusting
     commit checks land in ONE lane batch (the light-client serving
@@ -479,7 +519,7 @@ def verify_commit_jobs_coalesced(
         except CommitVerifyError as e:
             errors[j] = e
             metas.append(None)
-    oks = _run_batch(items, cache)
+    oks = _run_batch(items, cache, priority=priority, label="jobs")
     for j, meta in enumerate(metas):
         if meta is None:
             continue
@@ -504,6 +544,7 @@ def verify_commit_light_trusting(
     trust_level: Fraction = Fraction(1, 3),
     cache: Optional[SignatureCache] = None,
     all_signatures: bool = False,
+    priority: Optional[int] = None,
 ) -> None:
     """Trusting verification against an *old* validator set: tally power
     of trusted validators who signed; require > trust_level of trusted
@@ -512,7 +553,7 @@ def verify_commit_light_trusting(
     lanes, total, need = _collect_trusting_lanes(
         chain_id, vals, commit, trust_level, all_signatures, items
     )
-    oks = _run_batch(items, cache)
+    oks = _run_batch(items, cache, priority=priority, label="trusting")
     _fold_trusting_lanes(lanes, oks, total, need, trust_level)
 
 
@@ -523,6 +564,7 @@ def verify_extended_commit(
     height: int,
     ec,
     cache: Optional[SignatureCache] = None,
+    priority: Optional[int] = None,
 ) -> None:
     """Full extended-commit verification, shared by every path that
     persists an EC received from a peer (blocksync block responses and
@@ -544,7 +586,13 @@ def verify_extended_commit(
     if ec.height != height or ec.block_id.hash != block_hash:
         raise CommitVerifyError("extended commit does not bind to block")
     verify_commit(
-        chain_id, vals, ec.block_id, height, ec.to_commit(), cache=cache
+        chain_id,
+        vals,
+        ec.block_id,
+        height,
+        ec.to_commit(),
+        cache=cache,
+        priority=priority,
     )
     items = []
     for i, s in enumerate(ec.extended_signatures):  # bftlint: disable=ASY117 — verifying an O(V) commit payload is O(V) by construction; runs once per commit-block received and the curve math is batch-verified
@@ -568,5 +616,7 @@ def verify_extended_commit(
                 s.extension_signature,
             )
         )
-    if not all(_run_batch(items, cache)):
+    if not all(
+        _run_batch(items, cache, priority=priority, label="extension")
+    ):
         raise CommitVerifyError("invalid extension signature")

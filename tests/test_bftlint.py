@@ -50,6 +50,7 @@ FIXTURE_PATHS = {
     "ASY118": "cometbft_tpu/consensus/x.py",
     "ASY119": "cometbft_tpu/consensus/x.py",
     "ASY120": "cometbft_tpu/store/x.py",
+    "ASY121": "cometbft_tpu/blocksync/x.py",
 }
 
 
@@ -648,6 +649,40 @@ FIXTURES = [
             # bounded plain-list loop: not scan-driven, fine
             for k in doomed:
                 db.delete(k)
+        """,
+    ),
+    (
+        "ASY121",  # verify-bypass-scheduler: a hot plane building a
+        # BatchVerifier / touching the parallel-verify pool directly
+        # verifies outside the scheduler's priority classes
+        """
+        from cometbft_tpu.crypto.batch import CpuBatchVerifier
+        from cometbft_tpu.crypto import batch, parallel_verify
+        def window_verify(jobs):
+            v = CpuBatchVerifier()
+            for pk, msg, sig in jobs:
+                v.add(pk, msg, sig)
+            return v.verify()
+        def factory_verify(jobs):
+            return batch.create_batch_verifier()
+        def pool_verify(items):
+            return parallel_verify.engine().verify(items)
+        """,
+        """
+        from cometbft_tpu.crypto import scheduler as crypto_sched
+        from cometbft_tpu.crypto.parallel_verify import (
+            dispatch_stats_if_running,
+        )
+        from cometbft_tpu.crypto import parallel_verify
+        def window_verify(jobs):
+            # sanctioned: the unified scheduler's priority classes
+            t = crypto_sched.scheduler().submit(
+                jobs, priority=crypto_sched.PRIORITY_CATCHUP
+            )
+            return t.result()
+        def gauges():
+            # stats reads are not verification
+            return parallel_verify.dispatch_stats_if_running()
         """,
     ),
     (

@@ -30,9 +30,9 @@ class _DispatchCounter:
         self.calls = []
         real = reactor_mod.verify_commits_coalesced_async
 
-        def wrapped(chain_id, jobs, cache=None, light=True):
+        def wrapped(chain_id, jobs, cache=None, light=True, **kw):
             self.calls.append(len(jobs))
-            return real(chain_id, jobs, cache=cache, light=light)
+            return real(chain_id, jobs, cache=cache, light=light, **kw)
 
         monkeypatch.setattr(
             reactor_mod, "verify_commits_coalesced_async", wrapped

@@ -294,8 +294,8 @@ def test_event_loop_responsive_during_window_verify(monkeypatch):
     real = reactor_mod.verify_commits_coalesced_async
     slow_calls = []
 
-    def wrapped(chain_id, jobs, cache=None, light=True):
-        handle = real(chain_id, jobs, cache=cache, light=light)
+    def wrapped(chain_id, jobs, cache=None, light=True, **kw):
+        handle = real(chain_id, jobs, cache=cache, light=light, **kw)
 
         class Slow:
             def result(self):

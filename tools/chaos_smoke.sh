@@ -147,6 +147,27 @@ JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" \
     --subscriber-storm 150 --trace-dump "$TRACE_DIR/sub_storm" --budget
 python -m cometbft_tpu.trace timeline "$TRACE_DIR/sub_storm" --strict
 
+echo "== chaos smoke: verify storm — light + catch-up + live through ONE scheduler =="
+# the unified verify scheduler (docs/PERF.md "Unified verify
+# scheduler"): mid-schedule, a light-session storm and a
+# blocksync-style catch-up storm hammer the SAME process-wide
+# scheduler the net's live consensus verifies on. Verdict parity is
+# asserted on every ticket (bad signatures included), the live
+# class's p95 submit->resolve wall is gated on the
+# crypto.sched.dispatch budget, and the catch-up lane must keep
+# completing (aging promotion) — starvation, a budget breach, or a
+# diverged verdict exits 1; span budgets gate the run like every leg
+cat > "$TRACE_DIR/verify_storm_schedule.json" <<'EOF'
+[
+  {"action": "verify_storm", "at_height": 2},
+  {"action": "crash", "at_height": 3, "node": 1},
+  {"action": "restart", "after_s": 0.5, "node": 1}
+]
+EOF
+JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" \
+    --schedule "$TRACE_DIR/verify_storm_schedule.json" \
+    --trace-dump "$TRACE_DIR/verify_storm" --budget
+
 echo "== chaos smoke: storage lifecycle plane under faults (crash mid-prune + snapshot during prune) =="
 # the storage lifecycle plane (ISSUE 17, docs/STORAGE.md): the
 # schedule crashes a node between bounded prune batches and restarts
