@@ -70,6 +70,7 @@ _DEFAULT_BUDGETS_S = {
     "live": 1500.0,
     "serve": 1200.0,
     "rpcfanout": 1200.0,
+    "fleet": 1500.0,
     "scaling": 300.0,
     "verifysched": 600.0,
     "meshdryrun": 900.0,
@@ -2275,6 +2276,650 @@ def bench_rpcfanout() -> dict:
     }
 
 
+def bench_fleet() -> dict:
+    """Serving-fleet storm (ISSUE 19, docs/FLEET.md, docs/PERF.md
+    "Serving fleet"): N follower replicas behind a SessionRouter vs
+    ONE FanoutHub carrying the same TOTAL subscriber load, over the
+    SAME seeded committed-block event stream:
+
+    - hub   — the single-node plane (rpc/fanout.py): every session on
+      one FanoutHub, per-subscriber elastic queue + writer task;
+    - fleet — N FollowerNode replicas tail-following one StreamSource,
+      sessions admitted + least-loaded-placed by the SessionRouter,
+      replica-paced direct delivery (fleet/follower.py).
+
+    Pass-interleaved medians for the throughput legs, then ONE storm
+    pass at full scale: routed light sessions (consistency tokens,
+    shared cross-replica VerifiedHeaderCache) ride along while one
+    replica is KILLED mid-stream — every stranded session must resume
+    elsewhere with zero lost commits (store replay + live splice),
+    gap-freeness checked per session against the seeded chain and
+    frame content store-verified on a kept sample. Gates: aggregate
+    delivered-frames/s >= 2.5x the single-hub plane at equal load,
+    re-admit p99 inside the fleet.failover budget, zero sheds, and
+    the fleet.route/fleet.failover spans against
+    tools/span_budgets.toml."""
+    import asyncio
+    import statistics
+    import time as _time
+
+    import cometbft_tpu.types as T
+    from cometbft_tpu.abci import types as abci
+    from cometbft_tpu.chaos.workload import WorkloadSpec
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.fleet import (
+        FollowerNode,
+        SessionRouter,
+        StreamSource,
+    )
+    from cometbft_tpu.fleet.follower import event_payload, height_events
+    from cometbft_tpu.fleet.router import _HEIGHT_RE
+    from cometbft_tpu.light.client import Client, TrustOptions
+    from cometbft_tpu.light.provider import Provider
+    from cometbft_tpu.light.serving import (
+        LightServingPlane,
+        VerifiedHeaderCache,
+    )
+    from cometbft_tpu.light.types import LightBlock
+    from cometbft_tpu.obs.budget import (
+        default_budget_file,
+        evaluate_budgets,
+        load_budgets,
+    )
+    from cometbft_tpu.rpc.fanout import FanoutHub, _event_attrs
+    from cometbft_tpu.trace import summarize
+    from cometbft_tpu.trace.tracer import Tracer
+    from cometbft_tpu.types import events as ev
+    from cometbft_tpu.utils.pubsub_query import parse as parse_query
+
+    REPLICAS = int(os.environ.get("BENCH_FLEET_REPLICAS", "3"))
+    SUBS_PER = int(os.environ.get("BENCH_FLEET_SUBS", "10000"))
+    LIGHT = int(os.environ.get("BENCH_FLEET_LIGHT", "1000"))
+    HEIGHTS = int(os.environ.get("BENCH_FLEET_HEIGHTS", "16"))
+    TXS = int(os.environ.get("BENCH_FLEET_TXS", "2"))
+    REPEATS = int(os.environ.get("BENCH_FLEET_REPEATS", "2"))
+    LIGHT_WORKERS = int(
+        os.environ.get("BENCH_FLEET_LIGHT_WORKERS", "16")
+    )
+    LIGHT_TARGET = int(
+        os.environ.get("BENCH_FLEET_LIGHT_HEIGHTS", "256")
+    )
+    TOTAL = REPLICAS * SUBS_PER
+    KILL_AT = max(2, HEIGHTS // 2)
+    KEEP_N = 512  # sessions whose full frames are kept for parity
+    chain_id = "bench-fleet"
+
+    # --- seeded committed chain (bench_rpcfanout's block shape) -----
+    wl = WorkloadSpec(pattern="sustained", tx_bytes=64)
+    tx_rng = np.random.default_rng(5151)
+    vs, _ = T.random_validator_set(1)
+    t0_ns = time.time_ns() - (HEIGHTS + 60) * 1_000_000_000
+
+    def make_height(h, prev_bid):
+        txs = [
+            b"bench/fl%d_%d=%s"
+            % (h, i, tx_rng.bytes(wl.tx_bytes // 2).hex().encode())
+            for i in range(TXS)
+        ]
+        data = T.Data(txs=txs)
+        last_commit = (
+            T.Commit(h - 1, 0, prev_bid, []) if h > 1 else None
+        )
+        header = T.Header(
+            chain_id=chain_id,
+            height=h,
+            time_ns=t0_ns + h * 1_000_000_000,
+            last_block_id=prev_bid,
+            validators_hash=vs.hash(),
+            next_validators_hash=vs.hash(),
+            app_hash=b"\x02" * 32,
+            proposer_address=vs.validators[0].address,
+            data_hash=data.hash(),
+            last_commit_hash=last_commit.hash() if last_commit else b"",
+        )
+        return T.Block(header=header, data=data, last_commit=last_commit)
+
+    def results_fn(block, i, tx):
+        return abci.ExecTxResult(
+            code=0,
+            events=[
+                abci.Event(
+                    "transfer",
+                    [abci.EventAttribute("lane", f"l{i % 4}", True)],
+                )
+            ],
+        )
+
+    blocks = []
+    flat = []  # (height, event) in canonical delivery order
+    prev = T.BlockID()
+    for h in range(1, HEIGHTS + 1):
+        blk = make_height(h, prev)
+        prev = T.BlockID(blk.hash(), T.PartSetHeader(1, blk.hash()))
+        blocks.append(blk)
+        for e in height_events(blk, results_fn):
+            flat.append((h, e))
+
+    SHAPES = [
+        ("tm.event='NewBlock'", 70),
+        ("tm.event='Tx'", 20),
+        ("tm.event='Tx' AND transfer.lane='l1'", 7),
+        ("tm.event='NewBlockHeader'", 3),  # matches nothing published
+    ]
+    weights = [w for _, w in SHAPES]
+    srng = np.random.default_rng(107)
+    draws = srng.choice(
+        len(SHAPES), size=TOTAL, p=[w / 100 for w in weights]
+    )
+    shape_of = [int(x) for x in draws]
+    queries = [(qs, parse_query(qs)) for qs, _ in SHAPES]
+
+    # per shape: the store-derived expectation every delivered stream
+    # is judged against (heights for gap-freeness, parsed payloads for
+    # content) — THE zero-lost-commits oracle
+    exp_heights = []
+    expected_results = []
+    for qs, q in queries:
+        matched = [
+            (h, e) for h, e in flat if q.matches(_event_attrs(e))
+        ]
+        exp_heights.append([h for h, _ in matched])
+        expected_results.append(
+            [json.loads(event_payload(e, qs)) for _, e in matched]
+        )
+    per_shape_frames = [len(x) for x in exp_heights]
+    total_expected = sum(per_shape_frames[s] for s in shape_of)
+
+    class Sink:
+        __slots__ = ("count", "keep", "record", "frames", "heights",
+                     "stamps")
+
+        def __init__(self, keep=False, record=False):
+            self.count = 0
+            self.keep = keep
+            self.record = record
+            self.frames = []
+            self.heights = []
+            self.stamps = []
+
+        async def send_str(self, s):
+            self.count += 1
+            if self.record:
+                self.stamps.append(_time.monotonic())
+                m = _HEIGHT_RE.search(s)
+                if m:
+                    self.heights.append(int(m.group(1)))
+            if self.keep:
+                self.frames.append(s)
+
+    def check_content(sinks, sids, where):
+        for sid in sids:
+            got = [json.loads(x)["result"] for x in sinks[sid].frames]
+            assert got == expected_results[shape_of[sid]], (
+                f"{where}: frame stream diverged from the store for "
+                f"session {sid} ({len(got)} frames)"
+            )
+
+    # --- routed-light corpus: small signed chain, static committee --
+    light_chain = "bench-fleet-light"
+    NV = 8
+    lrng = np.random.default_rng(61)
+    light_keys = [
+        Ed25519PrivKey.from_seed(lrng.bytes(32)) for _ in range(NV)
+    ]
+    light_vs = T.ValidatorSet(
+        [T.Validator(p.pub_key(), 10) for p in light_keys]
+    )
+    priv_by_addr = {p.pub_key().address(): p for p in light_keys}
+    lt0_ns = time.time_ns() - (LIGHT_TARGET + 120) * 1_000_000_000
+
+    class MintingProvider(Provider):
+        def __init__(self):
+            self.chain_id = light_chain
+            self._minted: dict = {}
+            self._lock = threading.Lock()
+
+        def light_block(self, height: int) -> LightBlock:
+            with self._lock:
+                got = self._minted.get(height)
+            if got is not None:
+                return got
+            h = T.Header(
+                chain_id=light_chain,
+                height=height,
+                time_ns=lt0_ns + height * 1_000_000_000,
+                validators_hash=light_vs.hash(),
+                next_validators_hash=light_vs.hash(),
+            )
+            bid = T.BlockID(h.hash(), T.PartSetHeader(1, h.hash()))
+            sigs = []
+            for i, val in enumerate(light_vs.validators):
+                v = T.Vote(
+                    type_=T.PRECOMMIT,
+                    height=height,
+                    round=0,
+                    block_id=bid,
+                    timestamp_ns=h.time_ns,
+                    validator_address=val.address,
+                    validator_index=i,
+                )
+                sigs.append(
+                    T.CommitSig(
+                        block_id_flag=T.BLOCK_ID_FLAG_COMMIT,
+                        validator_address=val.address,
+                        timestamp_ns=h.time_ns,
+                        signature=priv_by_addr[val.address].sign(
+                            v.sign_bytes(light_chain)
+                        ),
+                    )
+                )
+            lb = LightBlock(
+                h,
+                T.Commit(
+                    height=height, round=0, block_id=bid,
+                    signatures=sigs,
+                ),
+                light_vs,
+            )
+            with self._lock:
+                self._minted[height] = lb
+            return lb
+
+        def report_evidence(self, evd) -> None:
+            pass
+
+    light_provider = MintingProvider()
+    light_root = light_provider.light_block(1)
+    light_trust = TrustOptions(
+        period_ns=10 * 365 * 86400 * 10**9,
+        height=1,
+        hash=light_root.hash(),
+    )
+    lreq = np.random.default_rng(1117)
+    light_sched = [
+        int(x)
+        for x in lreq.integers(
+            LIGHT_TARGET // 2, LIGHT_TARGET, size=max(LIGHT, 1)
+        )
+    ]
+
+    tracer = Tracer(name="fleet", size=1 << 18)
+
+    def hub_pass():
+        """Single-node plane at the fleet's TOTAL load: one FanoutHub,
+        every session on it — the equal-load comparator the >=2.5x
+        aggregate gate divides by."""
+        sinks = [Sink(keep=sid < KEEP_N) for sid in range(TOTAL)]
+
+        async def run():
+            bus = ev.EventBus()
+            bus.set_loop(asyncio.get_running_loop())
+            hub = FanoutHub(bus, tracer=tracer)
+            for sid in range(TOTAL):
+                qs, q = queries[shape_of[sid]]
+                hub.attach(sinks[sid], qs, q, sid)
+            t0 = _time.monotonic()
+            for _h, e in flat:
+                bus.publish(e)
+                await asyncio.sleep(0)
+            deadline = asyncio.get_running_loop().time() + 600
+            while sum(s.count for s in sinks) < total_expected:
+                if asyncio.get_running_loop().time() > deadline:
+                    raise RuntimeError(
+                        "hub delivery stalled: "
+                        f"{sum(s.count for s in sinks)}"
+                        f"/{total_expected}"
+                    )
+                await asyncio.sleep(0.005)
+            wall = _time.monotonic() - t0
+            stats = hub.queue_stats()
+            enc = hub.encodes
+            await hub.close()
+            return wall, stats, enc
+
+        wall, stats, enc = asyncio.run(run())
+        return sinks, wall, stats, enc
+
+    def fleet_pass(kill=False, light=False):
+        """N replicas behind the router over the same stream; with
+        ``kill`` one replica dies mid-storm (failover must be
+        lossless), with ``light`` routed light sessions ride along on
+        worker threads (tokens honored, shared cross-replica cache)."""
+        record = kill
+        sinks = [
+            Sink(keep=sid < KEEP_N, record=record)
+            for sid in range(TOTAL)
+        ]
+        out = {}
+
+        async def run():
+            source = StreamSource(results_fn=results_fn)
+            planes = None
+            if light:
+                shared_cache = VerifiedHeaderCache(
+                    light_chain, tracer=tracer
+                )
+                planes = [
+                    LightServingPlane(
+                        [
+                            Client(
+                                light_chain, light_trust,
+                                light_provider,
+                            )
+                            for _ in range(2)
+                        ],
+                        max_sessions=LIGHT + 64,
+                        max_inflight=LIGHT_WORKERS,
+                        cache=shared_cache,
+                        tracer=tracer,
+                    )
+                    for _ in range(REPLICAS)
+                ]
+            replicas = [
+                FollowerNode(
+                    f"bench-r{i}",
+                    source,
+                    light_plane=planes[i] if planes else None,
+                    poll_s=0.02,
+                    tracer=tracer,
+                )
+                for i in range(REPLICAS)
+            ]
+            router = SessionRouter(
+                replicas,
+                store_source=source,
+                max_sessions=TOTAL + 64,
+                # the bench feeds heights as fast as delivery allows —
+                # transient lag is the workload, not a stall; lag
+                # shedding is exercised by tests/chaos, not here
+                max_lag_heights=HEIGHTS + 64,
+                lag_poll_s=0.05,
+                token_wait_s=10.0,
+                resume_replay_max=max(64, HEIGHTS),
+                tracer=tracer,
+            )
+            for r in replicas:
+                await r.start()
+            await router.start()
+            sessions = []
+            for sid in range(TOTAL):
+                qs, q = queries[shape_of[sid]]
+                sessions.append(
+                    await router.subscribe(
+                        sinks[sid], qs, q, sub_id=sid
+                    )
+                )
+            victim = replicas[0]
+            victim_sids = (
+                [
+                    sid
+                    for sid, sess in enumerate(sessions)
+                    if router._sessions.get(sess) is victim
+                ]
+                if kill
+                else []
+            )
+            light_futs = []
+            ex = None
+            light_lat = []
+            llock = threading.Lock()
+            if light:
+                import concurrent.futures as _cf
+
+                loop = asyncio.get_running_loop()
+                ex = _cf.ThreadPoolExecutor(LIGHT_WORKERS)
+
+                def light_one(i):
+                    # deterministic stagger spreads the light storm
+                    # across the ingest window
+                    _time.sleep((i % 100) * 0.003)
+                    lt0 = _time.monotonic()
+                    token = router.issue_token()
+                    lb = router.serve_light(light_sched[i], token)
+                    dt = (_time.monotonic() - lt0) * 1e3
+                    assert lb.height == light_sched[i]
+                    assert (
+                        lb.hash()
+                        == light_provider.light_block(
+                            light_sched[i]
+                        ).hash()
+                    )
+                    with llock:
+                        light_lat.append(dt)
+
+                light_futs = [
+                    loop.run_in_executor(ex, light_one, i)
+                    for i in range(LIGHT)
+                ]
+            t0 = _time.monotonic()
+            t_kill = None
+            for h, blk in enumerate(blocks, 1):
+                source.advance(blk)
+                await asyncio.sleep(0)
+                if kill and h == KILL_AT:
+                    # the victim must actually be mid-stream: let it
+                    # serve through this height, then kill it with
+                    # more heights still coming
+                    while victim.served_height() < h:
+                        await asyncio.sleep(0.002)
+                    t_kill = _time.monotonic()
+                    await victim.kill()
+            deadline = asyncio.get_running_loop().time() + 600
+            while sum(s.count for s in sinks) < total_expected:
+                if asyncio.get_running_loop().time() > deadline:
+                    raise RuntimeError(
+                        "fleet delivery stalled: "
+                        f"{sum(s.count for s in sinks)}"
+                        f"/{total_expected}; sheds="
+                        f"{router.fleet_status()['sheds']}"
+                    )
+                await asyncio.sleep(0.005)
+            wall = _time.monotonic() - t0
+            if light_futs:
+                await asyncio.gather(*light_futs)
+                ex.shutdown(wait=True)
+            out["wall"] = wall
+            out["t_kill"] = t_kill
+            out["victim_sids"] = victim_sids
+            out["encodes"] = sum(
+                r.fanout.encodes for r in replicas
+            )
+            out["status"] = router.fleet_status()
+            out["light_lat"] = sorted(light_lat)
+            await router.close()
+            for r in replicas:
+                await r.stop()
+
+        asyncio.run(run())
+        return sinks, out
+
+    # --- throughput legs: hub vs fleet at equal TOTAL load ----------
+    runs = {"hub": [], "fleet": []}
+    hub_sheds = 0
+    parity_checked = False
+    for _ in range(REPEATS):
+        h_sinks, h_wall, h_stats, h_enc = hub_pass()
+        hub_sheds += h_stats["dropped"]
+        f_sinks, f_out = fleet_pass()
+        st = f_out["status"]
+        assert (
+            st["sheds"]["admit"] == 0
+            and st["sheds"]["lag"] == 0
+            and st["sheds"]["failover"] == 0
+        ), f"fleet shed sessions in a clean pass: {st['sheds']}"
+        if not parity_checked:
+            keep = [
+                sid
+                for sid in range(min(KEEP_N, TOTAL))
+                if per_shape_frames[shape_of[sid]]
+            ]
+            check_content(h_sinks, keep, "hub")
+            check_content(f_sinks, keep, "fleet")
+            parity_checked = True
+        runs["hub"].append(
+            {
+                "wall_s": h_wall,
+                "frames_per_s": total_expected / h_wall,
+                "encodes": h_enc,
+            }
+        )
+        runs["fleet"].append(
+            {
+                "wall_s": f_out["wall"],
+                "frames_per_s": total_expected / f_out["wall"],
+                "encodes": f_out["encodes"],
+            }
+        )
+        del h_sinks, f_sinks
+    assert hub_sheds == 0, (
+        f"{hub_sheds} frames shed by the hub with instant-drain sinks"
+    )
+    med = {
+        mode: {
+            k: round(statistics.median(r[k] for r in rs), 3)
+            for k in ("wall_s", "frames_per_s", "encodes")
+        }
+        for mode, rs in runs.items()
+    }
+    ratio = _ratio(
+        med["fleet"]["frames_per_s"], med["hub"]["frames_per_s"]
+    )
+    assert ratio is not None and ratio >= 2.5, (
+        f"fleet aggregate only {ratio}x the single-hub plane at "
+        "equal load (gate: >=2.5x)"
+    )
+
+    # --- the storm pass: kill one replica mid-stream ----------------
+    s_sinks, s_out = fleet_pass(kill=True, light=LIGHT > 0)
+    st = s_out["status"]
+    victim_sids = s_out["victim_sids"]
+    t_kill = s_out["t_kill"]
+    assert t_kill is not None and victim_sids, (
+        "storm pass never killed a replica"
+    )
+    assert st["failovers"] >= 1, f"no failover recorded: {st}"
+    assert st["sessions_resumed"] == len(victim_sids), (
+        f"{st['sessions_resumed']}/{len(victim_sids)} stranded "
+        "sessions resumed"
+    )
+    assert (
+        st["sheds"]["admit"] == 0
+        and st["sheds"]["lag"] == 0
+        and st["sheds"]["failover"] == 0
+    ), f"storm pass shed sessions: {st['sheds']}"
+    # zero lost commits, store-verified: every session's delivered
+    # height sequence equals the chain-derived expectation (order,
+    # multiplicity, no gap at the kill/resume splice)
+    lost = 0
+    for sid in range(TOTAL):
+        if s_sinks[sid].heights != exp_heights[shape_of[sid]]:
+            lost += 1
+    assert lost == 0, (
+        f"{lost} sessions lost or reordered commits across the "
+        "replica kill"
+    )
+    check_content(
+        s_sinks,
+        [
+            sid
+            for sid in range(min(KEEP_N, TOTAL))
+            if per_shape_frames[shape_of[sid]]
+        ],
+        "storm",
+    )
+    # re-admit latency: kill -> first replayed frame, per stranded
+    # session that still had frames coming
+    readmit_ms = []
+    for sid in victim_sids:
+        if not per_shape_frames[shape_of[sid]]:
+            continue
+        post = [ts for ts in s_sinks[sid].stamps if ts > t_kill]
+        if post:
+            readmit_ms.append((post[0] - t_kill) * 1e3)
+    readmit_ms.sort()
+
+    def rpct(p):
+        return round(
+            readmit_ms[int(p * (len(readmit_ms) - 1))], 3
+        )
+
+    assert readmit_ms, "no stranded session saw a post-kill frame"
+    # mirror of the fleet.failover p99 budget (span_budgets.toml)
+    assert rpct(0.99) <= 20000.0, (
+        f"re-admit p99 {rpct(0.99)}ms blew the 20s failover envelope"
+    )
+    light_lat = s_out["light_lat"]
+    light_stats = None
+    if LIGHT:
+        assert len(light_lat) == LIGHT, (
+            f"{len(light_lat)}/{LIGHT} routed light sessions served"
+        )
+        assert st["tokens_issued"] >= LIGHT
+        light_stats = {
+            "served": len(light_lat),
+            "p50_ms": round(
+                light_lat[int(0.50 * (len(light_lat) - 1))], 3
+            ),
+            "p99_ms": round(
+                light_lat[int(0.99 * (len(light_lat) - 1))], 3
+            ),
+        }
+    del s_sinks
+
+    # --- span-budget gate (fleet.route + fleet.failover) ------------
+    tsum = summarize({"fleet": tracer.snapshot()})
+    verdicts = [
+        v
+        for v in evaluate_budgets(
+            tsum, load_budgets(default_budget_file())
+        )
+        if v["span"] in ("fleet.route", "fleet.failover")
+    ]
+    budget_ok = all(v["ok"] for v in verdicts)
+    assert budget_ok, f"fleet budget breached: {verdicts}"
+
+    return {
+        "rate": med["fleet"]["frames_per_s"],
+        "replicas": REPLICAS,
+        "sessions": TOTAL,
+        "light_sessions": LIGHT,
+        "heights": HEIGHTS,
+        "expected_frames": total_expected,
+        "repeats": REPEATS,
+        "shapes": [qs for qs, _ in SHAPES],
+        "hub": med["hub"],
+        "fleet": med["fleet"],
+        "aggregate_ratio": ratio,
+        "encode_ratio": _ratio(
+            med["hub"]["encodes"], med["fleet"]["encodes"]
+        ),
+        "storm": {
+            "wall_s": round(s_out["wall"], 3),
+            "frames_per_s": round(
+                total_expected / s_out["wall"], 1
+            ),
+            "killed_sessions": len(victim_sids),
+            "resumed": st["sessions_resumed"],
+            "failovers": st["failovers"],
+            "readmit_p50_ms": rpct(0.50),
+            "readmit_p99_ms": rpct(0.99),
+            "sheds": st["sheds"],
+            "lost_commits": 0,
+            "light": light_stats,
+        },
+        "budget": {"ok": budget_ok, "verdicts": verdicts},
+        "note": (
+            "hub = one FanoutHub carrying the fleet's whole session "
+            "load (the single-node plane); fleet = routed sessions "
+            "over replica-paced direct delivery. Equal seeded load, "
+            "pass-interleaved medians; storm pass kills a replica "
+            "mid-stream and every stranded session resumes "
+            "elsewhere, gap-free against the store (heights + "
+            "content) with routed light sessions riding along."
+        ),
+    }
+
+
 def bench_scaling() -> dict:
     """Committee-scaling probe (docs/LINT.md "Complexity rules"): the
     runtime half of the static complexity pass. Drives the hot-path
@@ -3013,6 +3658,7 @@ def main() -> None:
             "lifecycle",
             "serve",
             "rpcfanout",
+            "fleet",
             "scaling",
             "verifysched",
             "meshdryrun",
@@ -3163,6 +3809,12 @@ def main() -> None:
         # subscribers, one-encode-per-group vs per-subscriber
         # serialization, >=5x gate + delivery p99 budget-gated
         run_config("rpcfanout", bench_rpcfanout)
+    if "fleet" in todo:
+        # host-only serving-fleet storm (ISSUE 19): follower replicas
+        # behind the SessionRouter vs one FanoutHub at equal total
+        # load, mid-storm replica kill with lossless resume, routed
+        # light sessions — >=2.5x aggregate gate, budget-gated
+        run_config("fleet", bench_fleet)
     if "scaling" in todo:
         # host-only committee-scaling exponent gate (complexity
         # plane): seconds-cheap, always runs — a fixed super-linear

@@ -912,3 +912,68 @@ def verify_bypass_scheduler(ctx: FileContext) -> List[Finding]:
             )
         )
     return out
+
+
+# Fleet code that serves a request off a replica's serving plane
+# without going through SessionRouter admission (ASY122): the router
+# is the ONE seam that holds the fleet's invariants — gate admission
+# (counted sheds, bounded waits), consistency tokens (never serve
+# below the token), lag-aware degradation and failover accounting. A
+# direct plane call from fleet/ code serves unadmitted, untokened and
+# uncounted. The sanctioned module is router.py itself; plane
+# lifecycle calls (drain/resume/stats/register_queues) are not
+# serving and stay clean.
+_ASY122_PREFIX = "cometbft_tpu/fleet/"
+_ASY122_ROUTER_SEAM = "router.py"
+
+# serving entry points on the plane/cache/session objects; "serve" is
+# matched only through an explicit plane receiver so unrelated
+# `.serve()` spellings elsewhere in fleet code don't false-positive
+_ASY122_SERVE_CALLS = {"open_session", "verified_block", "get_or_verify"}
+
+
+@rule(
+    "ASY122",
+    "serve-bypass-router",
+    "fleet/ code reaching a replica's serving plane directly "
+    "(open_session / verified_block / get_or_verify / "
+    "light_plane.serve) instead of going through SessionRouter "
+    "admission: a bypass serves unadmitted (no gate, no counted "
+    "shed), untokened (can serve below a consistency token) and "
+    "invisible to lag degradation/failover — route through "
+    "router.serve_light / route_light / subscribe",
+)
+def serve_bypass_router(ctx: FileContext) -> List[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if _ASY122_PREFIX not in path or path.endswith(
+        "/" + _ASY122_ROUTER_SEAM
+    ):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func) or ""
+        parts = name.split(".")
+        offending = None
+        if parts[-1] in _ASY122_SERVE_CALLS:
+            offending = parts[-1]
+        elif parts[-1] == "serve" and any(
+            "plane" in p for p in parts[:-1]
+        ):
+            offending = name
+        if offending is None:
+            continue
+        out.append(
+            Finding(
+                ctx.path, node.lineno, node.col_offset,
+                "ASY122", "serve-bypass-router",
+                f"`{name}(...)` reaches the serving plane without "
+                "SessionRouter admission: fleet code must serve "
+                "through the router seam (serve_light / route_light "
+                "/ subscribe) so the request is gate-admitted, "
+                "token-checked and counted by lag/failover "
+                "accounting",
+            )
+        )
+    return out

@@ -115,6 +115,19 @@ def main(argv=None) -> int:
         "fanout.deliver span)",
     )
     ap.add_argument(
+        "--fleet",
+        type=int,
+        default=0,
+        metavar="N",
+        help="attach an in-process serving fleet of N follower "
+        "replicas behind a SessionRouter for the whole run "
+        "(cometbft_tpu/fleet, docs/FLEET.md): routed subscriber "
+        "sessions stream commits throughout, a scheduled "
+        "replica_kill strands them mid-stream, and the run asserts "
+        "lossless failover (zero lost commits) + lag-shed isolation "
+        "(a replica_kill in the schedule implies --fleet 3)",
+    )
+    ap.add_argument(
         "--fastpath",
         action="store_true",
         help="run every node with the live-consensus fast path "
@@ -156,6 +169,7 @@ def main(argv=None) -> int:
                     config_hook=config_hook,
                     light_storm=args.light_storm,
                     subscriber_storm=args.subscriber_storm,
+                    fleet=args.fleet,
                 )
             )
     finally:
@@ -185,6 +199,7 @@ def main(argv=None) -> int:
                     "proposers": report.proposers,
                     "light_storm": report.light_storm,
                     "subscriber_storm": report.subscriber_storm,
+                    "fleet": report.fleet,
                     "sanitizer_findings": report.sanitizer_findings,
                 },
                 f,

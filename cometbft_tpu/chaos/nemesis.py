@@ -252,6 +252,16 @@ class Nemesis:
             return await net.crash_mid_prune(ev.node, abort_after)
         if ev.action == "snapshot_during_prune":
             return await net.snapshot_during_prune(ev.node)
+        if ev.action == "replica_kill":
+            # the victim replica comes from the MASTER rng unless
+            # pinned: schedule execution is sequential, so the draw is
+            # deterministic per (seed, schedule)
+            idx = ev.replica
+            if idx is None:
+                idx = net.table.rng.randint(
+                    0, max(0, net.fleet_size() - 1)
+                )
+            return await net.replica_kill(idx)
         if ev.action == "byzantine":
             # tamper bytes come from the MASTER rng: schedule execution
             # is sequential, so the draw is deterministic per run

@@ -116,6 +116,10 @@ class ChaosReport:
     # websocket subscriber storm against a live node's fan-out plane
     # (ISSUE 15; --subscriber-storm N): delivery/encode/shed stats
     subscriber_storm: Dict[str, object] = field(default_factory=dict)
+    # serving-fleet leg (ISSUE 19; run_schedule(fleet=N) or a
+    # scheduled replica_kill): per-replica status, failover/shed
+    # counters and the lag-shed isolation probe verdict
+    fleet: Dict[str, object] = field(default_factory=dict)
     # runtime concurrency sanitizer (analysis/runtime.py): every
     # finding the per-process sanitizer recorded during the run.
     # Un-injected findings also land in ``violations`` (the matrix
@@ -188,6 +192,25 @@ class ChaosReport:
                 f"{ss.get('encodes')} serializations, "
                 f"{ss.get('dropped')} shed, parity "
                 + ("OK" if ss.get("parity_ok") else "BROKEN")
+            )
+        if self.fleet:
+            fl = self.fleet
+            lp = fl.get("lag_probe") or {}
+            lines.append(
+                f"serving fleet: {len(fl.get('replicas', []))} "
+                f"replicas, {fl.get('sessions')} sessions, "
+                f"killed {fl.get('killed')}, "
+                f"{fl.get('failovers')} failovers / "
+                f"{fl.get('sessions_resumed')} resumed, sheds "
+                f"{fl.get('sheds')}, "
+                f"{fl.get('delivered_frames')} frames"
+                + (
+                    f"; lag probe on {lp.get('victim')}: degraded="
+                    f"{lp.get('degraded')} recovered="
+                    f"{lp.get('recovered')}"
+                    if lp
+                    else ""
+                )
             )
         if self.dial_failures or self.conns_killed:
             lines.append(
@@ -281,6 +304,9 @@ class ChaosNet:
         # reconnect plane + conns killed by pong-timeout injection
         self.dial_failures = 0
         self.conns_killed = 0
+        # serving-fleet harness (FleetHarness) attached by
+        # run_schedule(fleet=N); replica_kill dispatches through it
+        self.fleet_harness: Optional["FleetHarness"] = None
 
     # --- node lifecycle -----------------------------------------------
 
@@ -906,6 +932,21 @@ class ChaosNet:
 
     # --- introspection -------------------------------------------------
 
+    def fleet_size(self) -> int:
+        h = self.fleet_harness
+        return h.size() if h is not None else 0
+
+    async def replica_kill(self, idx: int) -> dict:
+        """Kill one fleet follower mid-stream (nemesis
+        ``replica_kill``); the router's failover is judged by
+        FleetHarness.finish()."""
+        if self.fleet_harness is None:
+            raise RuntimeError(
+                "replica_kill requires a fleet: "
+                "run_schedule(..., fleet=N)"
+            )
+        return await self.fleet_harness.replica_kill(idx)
+
     def running_nodes(self):
         return [
             (cn.name, cn.node) for cn in self.nodes if cn.node is not None
@@ -1238,6 +1279,288 @@ async def _run_subscriber_storm(
     }
 
 
+class _FleetSink:
+    """In-process frame sink for one routed fleet session: records
+    every delivered frame's height so zero-lost-commits is checkable
+    as stream contiguity."""
+
+    __slots__ = ("heights", "frames")
+
+    def __init__(self):
+        self.heights: List[int] = []
+        self.frames = 0
+
+    async def send_str(self, frame: str) -> None:
+        from ..fleet.router import _HEIGHT_RE
+
+        self.frames += 1
+        m = _HEIGHT_RE.search(frame)
+        if m:
+            self.heights.append(int(m.group(1)))
+
+
+class FleetHarness:
+    """In-process serving fleet riding a chaos net (docs/FLEET.md,
+    docs/CHAOS.md ``replica_kill``): N FollowerNode replicas tail a
+    StreamSource pumped from the committee's most advanced store, a
+    SessionRouter fronts them, and a pool of routed subscriber
+    sessions streams NewBlock commits for the whole schedule — so a
+    mid-schedule ``replica_kill`` strands real sessions and the
+    router's failover contract (zero lost commits) is judged on their
+    recorded streams. ``finish()`` also runs the lag-shed isolation
+    probe: stall one survivor past max_lag_heights and assert only
+    ITS clients shed, then recover it."""
+
+    MAX_LAG = 6
+
+    def __init__(self, net: "ChaosNet", n_replicas: int, seed: int,
+                 n_sessions: int = 24):
+        from ..fleet import FollowerNode, SessionRouter, StreamSource
+
+        self.net = net
+        self.source = StreamSource()
+        self.replicas = [
+            FollowerNode(
+                f"fleet-r{i}", self.source, tracer=global_tracer()
+            )
+            for i in range(n_replicas)
+        ]
+        self.router = SessionRouter(
+            self.replicas,
+            store_source=self.source,
+            max_lag_heights=self.MAX_LAG,
+            lag_poll_s=0.05,
+            tracer=global_tracer(),
+        )
+        self.n_sessions = n_sessions
+        self.sinks: List[_FleetSink] = []
+        self.sessions: List = []
+        self.killed: List[str] = []
+        self.violations: List[str] = []
+        self._pump_task: Optional[asyncio.Future] = None
+        self._fed = 0
+
+    async def start(self) -> None:
+        self._pump_task = spawn(self._pump(), name="fleet-pump")
+        for r in self.replicas:
+            await r.start(from_height=self.source.height())
+        await self.router.start()
+        for _ in range(self.n_sessions):
+            sink = _FleetSink()
+            sess = await self.router.subscribe(
+                sink, "tm.event='NewBlock'"
+            )
+            self.sinks.append(sink)
+            self.sessions.append(sess)
+
+    async def _pump(self) -> None:
+        """Feed the fleet source from the committee: the in-process
+        stand-in for blocksync tail-follow (same blocks, same order)."""
+        while True:
+            try:
+                running = self.net.running_nodes()
+                if running:
+                    _, top = max(running, key=lambda t: t[1].height)
+                    store = top.parts.block_store
+                    if self._fed < store.base() - 1:
+                        self._fed = store.base() - 1
+                    while self._fed < store.height():
+                        b = store.load_block(self._fed + 1)
+                        if b is None:
+                            break
+                        self.source.advance(b)
+                        self._fed += 1
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # a crash closed the store under the reader; the next
+                # pass re-reads from a survivor
+                pass
+            await asyncio.sleep(POLL_S)
+
+    def size(self) -> int:
+        return len(self.replicas)
+
+    async def replica_kill(self, idx: int) -> dict:
+        r = self.replicas[idx % len(self.replicas)]
+        stranded = sum(
+            1
+            for rep in self.router._sessions.values()
+            if rep is r
+        )
+        await r.kill()
+        self.killed.append(r.name)
+        return {"replica": r.name, "stranded_sessions": stranded}
+
+    async def _wait(self, pred, timeout_s: float) -> bool:
+        deadline = asyncio.get_running_loop().time() + timeout_s
+        while not pred():
+            if asyncio.get_running_loop().time() >= deadline:
+                return False
+            await asyncio.sleep(POLL_S)
+        return True
+
+    async def finish(self) -> dict:
+        """Judge the fleet contract and return the report section."""
+        v = self.violations
+        # failover must have re-homed every stranded session off the
+        # dead replicas (or shed it honestly — counted)
+        dead = [r for r in self.replicas if not r.alive]
+        ok = await self._wait(
+            lambda: not any(
+                rep in dead for rep in self.router._sessions.values()
+            ),
+            10.0,
+        )
+        if not ok:
+            v.append(
+                "fleet: sessions still mapped to a dead replica "
+                "after failover window"
+            )
+        if self.killed:
+            if self.router.failovers == 0:
+                v.append(
+                    "fleet: replica_kill executed but the router "
+                    "recorded no failover"
+                )
+            if self.router.sessions_resumed == 0:
+                v.append(
+                    "fleet: replica_kill stranded sessions but none "
+                    "were resumed (all shed)"
+                )
+        # lag-shed isolation probe on a survivor with sessions —
+        # requires the committee to still be committing (it is: the
+        # probe runs before net.stop())
+        probe: Dict[str, object] = {}
+        victim = next(
+            (
+                r
+                for r in self.replicas
+                if r.alive
+                and any(
+                    rep is r
+                    for rep in self.router._sessions.values()
+                )
+            ),
+            None,
+        )
+        if victim is not None:
+            others_before = [
+                s
+                for s, rep in self.router._sessions.items()
+                if rep is not victim
+            ]
+            victim.stalled = True
+            degraded = await self._wait(
+                lambda: any(
+                    r["degraded"]
+                    for r in self.router.fleet_status()["replicas"]
+                    if r["name"] == victim.name
+                ),
+                20.0,
+            )
+            if not degraded:
+                v.append(
+                    f"fleet: stalled {victim.name} past "
+                    f"max_lag_heights but it was never degraded"
+                )
+            else:
+                # isolation: every session that was on another replica
+                # is untouched; the victim serves no one
+                bystanders_shed = [
+                    s for s in others_before if s.closed
+                ]
+                if bystanders_shed:
+                    v.append(
+                        f"fleet: lag shed closed "
+                        f"{len(bystanders_shed)} sessions of OTHER "
+                        f"replicas — shedding must isolate the "
+                        f"stalled follower's clients"
+                    )
+                if victim.members() != 0:
+                    v.append(
+                        f"fleet: degraded {victim.name} still holds "
+                        f"{victim.members()} sessions"
+                    )
+            victim.stalled = False
+            recovered = await self._wait(
+                lambda: not any(
+                    r["degraded"]
+                    for r in self.router.fleet_status()["replicas"]
+                    if r["name"] == victim.name
+                ),
+                20.0,
+            )
+            if degraded and not recovered:
+                v.append(
+                    f"fleet: {victim.name} caught back up but was "
+                    f"never rotated back in"
+                )
+            probe = {
+                "victim": victim.name,
+                "degraded": degraded,
+                "recovered": recovered,
+                "sheds_lag": self.router.sheds_lag,
+            }
+        # zero lost commits: every live session's recorded stream is
+        # contiguous (resumed ones replayed their gap from the store)
+        resumed = 0
+        for sink, sess in zip(self.sinks, self.sessions):
+            hs = sink.heights
+            if sess.resumes:
+                resumed += 1
+            if sess.closed and sess.close_reason in (
+                "shed_lag", "failover_shed",
+            ):
+                continue  # honestly shed, not silently lossy
+            if hs and [h - hs[0] for h in hs] != list(
+                range(len(hs))
+            ):
+                v.append(
+                    f"fleet: session (resumes={sess.resumes}, "
+                    f"reason={sess.close_reason!r}) delivered a "
+                    f"non-contiguous stream — commits were lost"
+                )
+        if self.killed and resumed == 0:
+            v.append(
+                "fleet: no surviving session was resumed after "
+                "replica_kill"
+            )
+        status = self.router.fleet_status()
+        return {
+            "replicas": status["replicas"],
+            "killed": self.killed,
+            "sessions": self.n_sessions,
+            "sessions_resumed": self.router.sessions_resumed,
+            "failovers": self.router.failovers,
+            "sheds": status["sheds"],
+            "lag_probe": probe,
+            "delivered_frames": sum(s.frames for s in self.sinks),
+        }
+
+    async def stop(self) -> None:
+        t, self._pump_task = self._pump_task, None
+        if t is not None and not t.done():
+            t.cancel()
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(t, return_exceptions=True), 5.0
+                )
+            except asyncio.TimeoutError:
+                pass
+        # bounded teardown (ASY110): a wedged router/replica must not
+        # hang the chaos run past its liveness verdict
+        try:
+            await asyncio.wait_for(self.router.close(), 5.0)
+        except asyncio.TimeoutError:
+            pass
+        for r in self.replicas:
+            try:
+                await asyncio.wait_for(r.stop(), 5.0)
+            except asyncio.TimeoutError:
+                pass
+
+
 async def run_schedule(
     schedule: FaultSchedule,
     seed: int,
@@ -1254,6 +1577,7 @@ async def run_schedule(
     enable_rpc: Optional[bool] = None,
     light_storm: int = 0,
     subscriber_storm: int = 0,
+    fleet: int = 0,
 ) -> ChaosReport:
     """Execute one seeded chaos run end-to-end and return its report
     (violations recorded, not raised — callers assert on report.ok).
@@ -1294,6 +1618,12 @@ async def run_schedule(
             s.snapshot_interval = 10
             s.snapshot_keep_recent = 2
 
+    if fleet == 0 and any(
+        e.action == "replica_kill" for e in schedule.events
+    ):
+        # a scheduled replica_kill implies a fleet: default to the
+        # 3-replica deployment shape the action was designed against
+        fleet = 3
     if enable_rpc is None:
         # the statesync joiner bootstraps over the sources' RPC, and
         # the subscriber storm needs a websocket endpoint — switch
@@ -1354,8 +1684,15 @@ async def run_schedule(
                 pass
             await asyncio.sleep(2 * POLL_S)
 
+    fleet_harness = None
     try:
         await net.start()
+        if fleet > 0:
+            # fleet rides the run from the start so a mid-schedule
+            # replica_kill strands sessions that are actually live
+            fleet_harness = FleetHarness(net, fleet, seed)
+            net.fleet_harness = fleet_harness
+            await fleet_harness.start()
         if driver is not None:
             driver.start(net)
         poller = asyncio.create_task(agreement_poll())
@@ -1429,6 +1766,18 @@ async def run_schedule(
                     report.violations.append(
                         f"subscriber storm failed: {e!r}"
                     )
+            if fleet_harness is not None and net.running_nodes():
+                # judge the fleet contract while the committee still
+                # commits (the lag-shed probe needs live ingest)
+                try:
+                    report.fleet = await fleet_harness.finish()
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    report.violations.append(
+                        f"fleet leg failed: {e!r}"
+                    )
+                report.violations.extend(fleet_harness.violations)
         finally:
             stop_polling.set()
             try:
@@ -1476,6 +1825,8 @@ async def run_schedule(
         if driver is not None:
             await driver.stop()
             report.workload = driver.stats()
+        if fleet_harness is not None:
+            await fleet_harness.stop()
         await net.stop()
         if profiler is not None:
             profiler.stop()

@@ -148,6 +148,17 @@ deterministic and fast):
                       app's snapshot cadence (kvstore: height >=
                       11). Auto-sets the storage knobs like
                       ``crash_mid_prune``.
+``replica_kill``      kill one serving-fleet follower replica
+                      mid-stream (``replica=i``, or a seeded draw
+                      from the MASTER rng when unset). Requires the
+                      net to run with a fleet attached
+                      (``run_schedule(..., fleet=N)``); the
+                      SessionRouter must fail the dead replica's
+                      sessions over to the survivors with ZERO lost
+                      commits — every resumed subscriber's stream is
+                      store-verified gap-free — and lag shedding must
+                      stay isolated to the killed replica's own
+                      clients (docs/FLEET.md).
 ====================  =================================================
 
 Schedules round-trip through JSON so failing runs can be archived and
@@ -166,7 +177,7 @@ ACTIONS = (
     "stall", "crash_wave", "statesync_join", "valset_churn",
     "wal_torn_tail", "conn_kill", "reconnect_storm", "lock_inversion",
     "scaling_probe", "crash_mid_prune", "snapshot_during_prune",
-    "verify_storm",
+    "verify_storm", "replica_kill",
 )
 
 
@@ -201,6 +212,8 @@ class FaultEvent:
     storm_s: float = 1.5  # verify_storm: storm duration
     live_budget_ms: float = 2500.0  # verify_storm: live-class p95 gate
     # (the crypto.sched.dispatch budget, tools/span_budgets.toml)
+    replica: Optional[int] = None  # replica_kill: fleet replica index
+    # (None = seeded draw from the MASTER rng)
 
     def __post_init__(self):
         if self.action not in ACTIONS:

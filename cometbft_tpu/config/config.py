@@ -310,6 +310,27 @@ class CryptoConfig:
     max_lanes: int = 131072
 
 
+@dataclass
+class FleetConfig:
+    """TPU-native addition: serving-fleet knobs (cometbft_tpu/fleet,
+    docs/FLEET.md). The SessionRouter in front of N follower replicas
+    admits at most max_sessions concurrent routed sessions, holds
+    consistency-token barrier waits to token_wait_s, degrades a
+    replica stalled past max_lag_heights behind the committee head
+    (checked every lag_poll_s), and on failover replays at most
+    resume_replay_max heights from the store per resumed session
+    (beyond that the session is shed honestly rather than resumed
+    with a gap)."""
+
+    max_sessions: int = 4096
+    admit_timeout_s: float = 0.25
+    max_lag_heights: int = 8
+    lag_poll_s: float = 0.1
+    token_wait_s: float = 2.0
+    resume_replay_max: int = 512
+    drain_timeout_s: float = 5.0
+
+
 # single source of truth for the fault-injection knobs ([fuzz] TOML
 # section, reference config/config.go:896)
 from ..p2p.fuzz import FuzzConnConfig  # noqa: E402
@@ -331,6 +352,7 @@ class Config:
     )
     fuzz: FuzzConnConfig = field(default_factory=FuzzConnConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
     root_dir: str = "."
 
     def path(self, rel: str) -> str:
@@ -381,6 +403,7 @@ def load_toml(path: str) -> Config:
         ("instrumentation", "instrumentation"),
         ("fuzz", "fuzz"),
         ("crypto", "crypto"),
+        ("fleet", "fleet"),
     ):
         if section in raw:
             obj = getattr(c, cls_name)
@@ -422,6 +445,7 @@ def write_toml(cfg: Config, path: str) -> None:
         ("instrumentation", cfg.instrumentation),
         ("fuzz", cfg.fuzz),
         ("crypto", cfg.crypto),
+        ("fleet", cfg.fleet),
     ]
     with open(path, "w") as f:
         f.write("\n\n".join(emit(n, o) for n, o in sections) + "\n")

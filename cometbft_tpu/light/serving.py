@@ -560,8 +560,17 @@ class LightServingPlane:
         self.chain_id = clients[0].chain_id
         self.tracer = tracer
         self.max_sessions = max_sessions
-        self.cache = cache or VerifiedHeaderCache(
-            self.chain_id, ttl_s=cache_ttl_s, tracer=tracer
+        # identity check, NOT truthiness: the cache defines __len__,
+        # so a shared-but-still-empty cache (a fleet booting cold)
+        # would read as falsy and silently get replaced by a private
+        # one — breaking cross-replica single-flight exactly when it
+        # matters most
+        self.cache = (
+            cache
+            if cache is not None
+            else VerifiedHeaderCache(
+                self.chain_id, ttl_s=cache_ttl_s, tracer=tracer
+            )
         )
         # promote the FIRST client's signature cache to the shared one
         self.signature_cache = clients[0].cache
@@ -590,6 +599,7 @@ class LightServingPlane:
         self.sessions_shed = 0
         self.requests = 0
         self.requests_shed = 0
+        self._draining = False
 
     # --- client pool ---------------------------------------------------
 
@@ -621,6 +631,12 @@ class LightServingPlane:
 
     def open_session(self) -> Session:
         with self._session_lock:
+            if self._draining:
+                self.sessions_shed += 1
+                self.gate.count_drop()
+                raise ServingOverloadError(
+                    "serving plane draining; retry another replica"
+                )
             if len(self._sessions) >= self.max_sessions:
                 self.sessions_shed += 1
                 self.gate.count_drop()
@@ -653,6 +669,13 @@ class LightServingPlane:
             "light.serve.request", "light", height=height
         )
         with span:
+            if self._draining:
+                self.requests_shed += 1
+                self.gate.count_drop()
+                span.set(shed=True)
+                raise ServingOverloadError(
+                    "serving plane draining; retry another replica"
+                )
             if not self.gate.enter(self.admit_timeout_s):
                 self.requests_shed += 1
                 span.set(shed=True)
@@ -671,6 +694,24 @@ class LightServingPlane:
         finally:
             self._checkin(client)
 
+    # --- drain (graceful rotate-out) -----------------------------------
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Stop admitting (new sessions AND new requests shed with the
+        standard overload error) and wait — BOUNDED — for every
+        in-flight request to resolve. Returns True when the gate went
+        idle inside the budget; False means the caller rotates the
+        replica out anyway knowing requests are still in flight. Sync
+        and thread-safe: the plane is the thread-facing seam, so the
+        router calls this via ``asyncio.to_thread`` (ASY110: the wait
+        is bounded, never a hang)."""
+        self._draining = True
+        return self.gate.wait_idle(timeout_s)
+
+    def resume(self) -> None:
+        """Re-open admission after a drain (replica rotates back in)."""
+        self._draining = False
+
     # --- introspection -------------------------------------------------
 
     def register_queues(self, registry) -> None:
@@ -679,6 +720,7 @@ class LightServingPlane:
 
     def stats(self) -> dict:
         return {
+            "draining": self._draining,
             "sessions": self.active_sessions(),
             "sessions_opened": self.sessions_opened,
             "sessions_shed": self.sessions_shed,

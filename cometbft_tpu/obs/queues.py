@@ -128,6 +128,14 @@ class InstrumentedGate:
         with self._cond:
             self.dropped += n
 
+    def wait_idle(self, timeout: float) -> bool:
+        """Bounded wait for every holder to exit (drain paths: the
+        caller stops admitting first, then waits in-flight work out)."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: self._holders == 0, timeout=timeout
+            )
+
     def depth(self) -> int:
         return self._holders
 

@@ -223,6 +223,45 @@ def health(env) -> Dict[str, Any]:
                     f"head {latest} by {lag} "
                     f"(> 3x retain_blocks={cfg.retain_blocks})"
                 )
+    out["serving_role"] = _serving_role(env)
+    lag = _replica_lag(env)
+    out["replica_lag_heights"] = lag
+    cfg = getattr(env, "config", None)
+    fleet_cfg = getattr(cfg, "fleet", None) if cfg is not None else None
+    if fleet_cfg is not None and lag > fleet_cfg.max_lag_heights:
+        reasons.append(
+            f"replica lag {lag} heights > "
+            f"max_lag_heights={fleet_cfg.max_lag_heights}"
+        )
+    fr = getattr(env, "fleet_router", None)
+    if fr is not None:
+        # fleet verdict (docs/FLEET.md): per-replica lag + degraded
+        # flags straight from the router — a degraded or dead replica
+        # degrades THIS health verdict (the router is the seam an
+        # operator probes)
+        fs = fr.fleet_status()
+        out["fleet"] = {
+            "sessions": fs["sessions"],
+            "failovers": fs["failovers"],
+            "sheds": fs["sheds"],
+            "replicas": [
+                {
+                    "name": r["name"],
+                    "alive": r["alive"],
+                    "lag_heights": r["lag_heights"],
+                    "degraded": r["degraded"],
+                }
+                for r in fs["replicas"]
+            ],
+        }
+        for r in fs["replicas"]:
+            if not r["alive"]:
+                reasons.append(f"fleet: replica {r['name']} dead")
+            elif r["degraded"]:
+                reasons.append(
+                    f"fleet: replica {r['name']} degraded "
+                    f"(lag {r['lag_heights']} heights)"
+                )
     bd = getattr(env.consensus_state, "last_commit_breakdown", None)
     if bd is not None:
         # per-phase attribution of the last committed height (ISSUE 7
@@ -242,6 +281,35 @@ def health(env) -> Dict[str, Any]:
             )
         out["reasons"] = reasons
     return out
+
+
+def _serving_role(env) -> str:
+    """validator|follower (docs/FLEET.md): a node without a signing
+    key serves reads only — the fleet deployment shape."""
+    return "validator" if env.privval_pubkey is not None else "follower"
+
+
+def _replica_lag(env) -> int:
+    fn = getattr(env, "replica_lag_fn", None)
+    if fn is None:
+        return 0
+    try:
+        return max(0, int(fn()))
+    except Exception:
+        return 0
+
+
+def fleet_status(env) -> Dict[str, Any]:
+    """Per-replica serving-fleet view (docs/FLEET.md): head, sessions,
+    admission/shed counters and each replica's height/sessions/lag.
+    Only meaningful on a node fronting a SessionRouter; elsewhere it
+    answers a well-formed JSON-RPC error."""
+    fr = getattr(env, "fleet_router", None)
+    if fr is None:
+        raise RPCError(
+            -32603, "this node does not front a serving fleet"
+        )
+    return fr.fleet_status()
 
 
 def dump_tasks(env) -> Dict[str, Any]:
@@ -290,6 +358,8 @@ def status(env) -> Dict[str, Any]:
                 _own_power(state, pub) if state and pub else 0
             ),
         },
+        "serving_role": _serving_role(env),
+        "replica_lag_heights": str(_replica_lag(env)),
     }
 
 
@@ -918,6 +988,7 @@ ROUTES = {
     "health": health,
     "dump_tasks": dump_tasks,
     "status": status,
+    "fleet_status": fleet_status,
     "net_info": net_info,
     "genesis": genesis,
     "genesis_chunked": genesis_chunked,

@@ -40,6 +40,13 @@ class Environment:
     # storage lifecycle plane (store/retention.py): health verdict +
     # status surfacing; may be None (inspect mode)
     retention: object = None
+    # serving-fleet plane (cometbft_tpu/fleet, docs/FLEET.md): the
+    # SessionRouter when this node fronts a fleet (fleet_status route,
+    # health fleet verdict); replica_lag_fn () -> int reports how far
+    # THIS node's served height trails the committee head when it runs
+    # as a follower replica (status/health replica_lag_heights)
+    fleet_router: object = None
+    replica_lag_fn: object = None
     # height-keyed commit waiters, shared by broadcast_tx_commit AND
     # the gRPC broadcast API: lazily built so inspect-mode envs never
     # subscribe (field, not ctor arg — see commit_waiters())

@@ -51,6 +51,7 @@ FIXTURE_PATHS = {
     "ASY119": "cometbft_tpu/consensus/x.py",
     "ASY120": "cometbft_tpu/store/x.py",
     "ASY121": "cometbft_tpu/blocksync/x.py",
+    "ASY122": "cometbft_tpu/fleet/x.py",
 }
 
 
@@ -683,6 +684,29 @@ FIXTURES = [
         def gauges():
             # stats reads are not verification
             return parallel_verify.dispatch_stats_if_running()
+        """,
+    ),
+    (
+        "ASY122",  # serve-bypass-router: fleet code serving off a
+        # replica's plane directly skips gate admission, consistency
+        # tokens and lag/failover accounting
+        """
+        def handle_light(replica, height):
+            s = replica.light_plane.open_session()
+            return s.verified_block(height)
+        def warm(replica, cache, height, fn):
+            cache.get_or_verify(height, fn)
+            return replica.light_plane.serve(height)
+        """,
+        """
+        def handle_light(router, height, token):
+            # sanctioned: the router seam admits, tokens and counts
+            return router.serve_light(height, token)
+        def rotate_out(replica):
+            # plane lifecycle is not serving
+            replica.light_plane.drain(5.0)
+            replica.light_plane.resume()
+            return replica.light_plane.stats()
         """,
     ),
     (

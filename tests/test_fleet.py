@@ -1,0 +1,756 @@
+"""Serving fleet (ISSUE 19, docs/FLEET.md): follower replicas,
+session router, consistency tokens, lag-aware shedding, and
+read-your-writes failover with lossless height-keyed resume.
+
+Covers the contract end to end on in-process fleets:
+
+- follower tail + ReplicaFanout frames are byte-identical to the
+  validator-side FanoutHub envelope (what makes replay splices exact);
+- least-loaded placement, bounded admission (counted sheds);
+- consistency tokens route AWAY from a lagging replica, WAIT the
+  height barrier when nobody satisfies them yet, and refuse
+  (StaleReadError) rather than serve stale;
+- a lagging replica degrades only ITS clients (lag-shed isolation)
+  and rotates back in after catching up;
+- replica death mid-stream: every stranded session resumes elsewhere
+  with zero lost commits (store replay + live splice), and a router
+  WITHOUT a store source sheds honestly instead of resuming lossily;
+- LightServingPlane.drain is bounded and reversible (satellite);
+- two followers sharing one VerifiedHeaderCache verify single-flight
+  process-wide and the poison refusal is unchanged (satellite).
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+import pytest
+
+import cometbft_tpu.types as T
+from cometbft_tpu.fleet import (
+    FleetOverloadError,
+    FollowerNode,
+    NodeReplica,
+    ReplicaFanout,
+    RoutedSession,
+    SessionRouter,
+    StaleReadError,
+    StoreSource,
+    StreamSource,
+    height_events,
+)
+from cometbft_tpu.fleet.follower import event_payload
+from cometbft_tpu.fleet.router import _HEIGHT_RE
+from cometbft_tpu.light.serving import (
+    CachePoisonError,
+    LightServingPlane,
+    ServingOverloadError,
+    VerifiedHeaderCache,
+)
+from cometbft_tpu.node.inprocess import make_genesis
+from cometbft_tpu.utils.chaingen import make_chain
+from cometbft_tpu.utils.pubsub_query import parse as parse_query
+
+Q_BLOCK = "tm.event='NewBlock'"
+Q_TX = "tm.event='Tx'"
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+class StubWS:
+    def __init__(self):
+        self.frames = []
+
+    async def send_str(self, s):
+        self.frames.append(s)
+
+    def heights(self):
+        return [
+            int(_HEIGHT_RE.search(f).group(1)) for f in self.frames
+        ]
+
+
+class FailingWS(StubWS):
+    async def send_str(self, s):
+        raise RuntimeError("socket died")
+
+
+def make_block(h, prev_bid, chain_id="fleet-chain", txs=1):
+    data = T.Data(
+        txs=[b"fleet/%d_%d=v" % (h, i) for i in range(txs)]
+    )
+    last_commit = T.Commit(h - 1, 0, prev_bid, []) if h > 1 else None
+    header = T.Header(
+        chain_id=chain_id,
+        height=h,
+        time_ns=h * 1_000_000_000,
+        last_block_id=prev_bid,
+        app_hash=b"\x03" * 32,
+        data_hash=data.hash(),
+        last_commit_hash=last_commit.hash() if last_commit else b"",
+    )
+    return T.Block(header=header, data=data, last_commit=last_commit)
+
+
+def make_blocks(n, txs=1):
+    out = []
+    prev = T.BlockID()
+    for h in range(1, n + 1):
+        blk = make_block(h, prev, txs=txs)
+        prev = T.BlockID(blk.hash(), T.PartSetHeader(1, blk.hash()))
+        out.append(blk)
+    return out
+
+
+async def wait_until(pred, timeout=10.0, poll=0.01, what="condition"):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not pred():
+        if asyncio.get_running_loop().time() > deadline:
+            raise AssertionError(f"timed out waiting for {what}")
+        await asyncio.sleep(poll)
+
+
+async def _fleet(n=2, **router_kw):
+    source = StreamSource()
+    replicas = [
+        FollowerNode(f"r{i}", source, poll_s=0.01) for i in range(n)
+    ]
+    router_kw.setdefault("lag_poll_s", 0.02)
+    router = SessionRouter(
+        replicas, store_source=source, **router_kw
+    )
+    for r in replicas:
+        await r.start()
+    await router.start()
+    return source, replicas, router
+
+
+async def _teardown(router, replicas):
+    await router.close()
+    for r in replicas:
+        await r.stop()
+
+
+def _replica_of(router, sess):
+    return router._sessions.get(sess)
+
+
+# --- follower tail + frame parity -------------------------------------
+
+
+def test_follower_frames_match_hub_envelope():
+    """Routed frames are byte-identical to what a FanoutHub would
+    send: same prefix, same payload key order — the property the
+    failover replay splice depends on."""
+
+    async def main():
+        source, replicas, router = await _fleet(1)
+        ws = StubWS()
+        sess = await router.subscribe(ws, Q_BLOCK, sub_id=7)
+        blocks = make_blocks(3)
+        for b in blocks:
+            source.advance(b)
+        await wait_until(lambda: len(ws.frames) == 3, what="frames")
+        prefix = '{"jsonrpc": "2.0", "id": 7, "result": '
+        for blk, frame in zip(blocks, ws.frames):
+            e = height_events(blk)[0]
+            assert frame == prefix + event_payload(e, Q_BLOCK) + "}"
+        assert sess.last_delivered == 3
+        assert replicas[0].served_height() == 3
+        assert replicas[0].lag_heights() == 0
+        await _teardown(router, replicas)
+
+    run(main())
+
+
+def test_store_source_tail_from_genesis():
+    """A follower over a real block store (the blocksync stand-in)
+    replays the whole chain when pinned to from_height=0."""
+    gen, pvs = make_genesis(2, chain_id="fleet-store")
+    node = make_chain(gen, [pv.priv_key for pv in pvs], 6)
+    try:
+
+        async def main():
+            source = StoreSource(node.block_store)
+            assert source.height() == 6
+            follower = FollowerNode("r0", source, poll_s=0.01)
+            router = SessionRouter([follower], store_source=source)
+            await follower.start(from_height=0)
+            await router.start()
+            ws = StubWS()
+            await router.subscribe(ws, Q_BLOCK)
+            await wait_until(
+                lambda: len(ws.frames) == 6, what="store tail"
+            )
+            assert ws.heights() == list(range(1, 7))
+            await _teardown(router, [follower])
+
+        run(main())
+    finally:
+        node.close_stores()
+
+
+def test_mid_height_attach_is_a_clean_boundary():
+    """A member attached while a height is being delivered receives
+    NOTHING for that height — its first live height is a clean
+    boundary (what makes the replay splice exact)."""
+
+    async def main():
+        fan = ReplicaFanout()
+        q = parse_query(Q_BLOCK)
+        m2 = RoutedSession(StubWS(), Q_BLOCK, q, 2)
+        attached = [False]
+
+        class AttachingWS(StubWS):
+            async def send_str(self, s):
+                await super().send_str(s)
+                if not attached[0]:
+                    attached[0] = True
+                    fan.attach(m2)
+
+        m1 = RoutedSession(AttachingWS(), Q_BLOCK, q, 1)
+        blocks = make_blocks(2)
+        fan.attach(m1)
+        await fan.deliver(height_events(blocks[0]), 1)
+        assert attached[0]
+        assert len(m1.sink.frames) == 1 and m1.last_delivered == 1
+        assert m2.sink.frames == [] and m2.last_delivered == 0
+        await fan.deliver(height_events(blocks[1]), 2)
+        assert m2.sink.heights() == [2] and m2.last_delivered == 2
+        assert m1.sink.heights() == [1, 2]
+
+    run(main())
+
+
+# --- admission + placement --------------------------------------------
+
+
+def test_least_loaded_placement():
+    async def main():
+        source, replicas, router = await _fleet(3)
+        for i in range(9):
+            await router.subscribe(StubWS(), Q_BLOCK, sub_id=i)
+        assert [r.members() for r in replicas] == [3, 3, 3]
+        await _teardown(router, replicas)
+
+    run(main())
+
+
+def test_admission_bound_sheds_and_releases():
+    async def main():
+        source, replicas, router = await _fleet(1, max_sessions=2)
+        s1 = await router.subscribe(StubWS(), Q_BLOCK)
+        await router.subscribe(StubWS(), Q_BLOCK)
+        with pytest.raises(FleetOverloadError):
+            await router.subscribe(StubWS(), Q_BLOCK)
+        assert router.gate.stats()["dropped"] == 1
+        assert router.fleet_status()["sheds"]["admit"] == 1
+        # a departing session frees its admission slot
+        await router.unsubscribe(s1)
+        await router.subscribe(StubWS(), Q_BLOCK)
+        await _teardown(router, replicas)
+
+    run(main())
+
+
+def test_failed_sink_degrades_only_its_session():
+    async def main():
+        source, replicas, router = await _fleet(1)
+        bad = await router.subscribe(FailingWS(), Q_BLOCK)
+        good_ws = StubWS()
+        await router.subscribe(good_ws, Q_BLOCK)
+        source.advance(make_blocks(1)[0])
+        await wait_until(
+            lambda: bad.closed and bad not in router._sessions,
+            what="failed-sink reap",
+        )
+        assert bad.close_reason == "send_failed"
+        assert len(good_ws.frames) == 1
+        assert router.gate.stats()["depth"] == 1
+        await _teardown(router, replicas)
+
+    run(main())
+
+
+# --- consistency tokens -----------------------------------------------
+
+
+def test_token_routes_away_from_lagging_replica():
+    """A request carrying token H lands only on a replica whose
+    served height >= H — the lagging replica never sees it."""
+
+    async def main():
+        source, (r0, r1), router = await _fleet(
+            2, max_lag_heights=100
+        )
+        blocks = make_blocks(8)
+        for b in blocks[:5]:
+            source.advance(b)
+        await wait_until(
+            lambda: r0.served_height() == 5 and r1.served_height() == 5,
+            what="both at 5",
+        )
+        r0.stalled = True
+        for b in blocks[5:]:
+            source.advance(b)
+        await wait_until(
+            lambda: r1.served_height() == 8, what="r1 at 8"
+        )
+        token = router.issue_token()
+        assert token == 8
+        # ten tokened subscriptions: ALL land on the caught-up
+        # replica even though least-loaded alone would alternate
+        for i in range(10):
+            await router.subscribe(
+                StubWS(), Q_BLOCK, sub_id=i, token=token
+            )
+        assert r0.members() == 0 and r1.members() == 10
+        assert (await router.route_read(token)) is r1
+        await _teardown(router, [r0, r1])
+
+    run(main())
+
+
+def test_token_waits_barrier_then_serves():
+    """Nobody satisfies the token yet: the router parks on the most
+    advanced replica's height barrier and resolves as soon as the
+    tail catches up — it never serves below the token."""
+
+    async def main():
+        source, (r0,), router = await _fleet(
+            1, max_lag_heights=100, token_wait_s=5.0
+        )
+        blocks = make_blocks(5)
+        for b in blocks[:3]:
+            source.advance(b)
+        await wait_until(
+            lambda: r0.served_height() == 3, what="r0 at 3"
+        )
+        r0.stalled = True
+        for b in blocks[3:]:
+            source.advance(b)
+        token = router.issue_token()
+        assert token == 5
+        read = asyncio.ensure_future(router.route_read(token))
+        await asyncio.sleep(0.1)
+        assert not read.done()  # parked on the barrier, not stale
+        r0.stalled = False
+        assert (await read) is r0
+        assert r0.served_height() >= 5
+        await _teardown(router, [r0])
+
+    run(main())
+
+
+def test_token_unsatisfiable_raises_stale_read():
+    async def main():
+        source, replicas, router = await _fleet(
+            2, max_lag_heights=100, token_wait_s=0.2
+        )
+        for b in make_blocks(4)[:2]:
+            source.advance(b)
+        await wait_until(
+            lambda: all(r.served_height() == 2 for r in replicas),
+            what="both at 2",
+        )
+        for r in replicas:
+            r.stalled = True
+        source.advance(make_blocks(4)[3])
+        token = router.issue_token()
+        assert token == 4
+        with pytest.raises(StaleReadError):
+            await router.route_read(token)
+        with pytest.raises(StaleReadError):
+            await router.subscribe(StubWS(), Q_BLOCK, token=token)
+        # the refused subscribe released its admission slot
+        assert router.gate.stats()["depth"] == 0
+        await _teardown(router, replicas)
+
+    run(main())
+
+
+# --- lag-aware shedding -----------------------------------------------
+
+
+def test_lag_shed_isolates_victims_clients():
+    """A replica stalled past max_lag_heights is drained and its
+    sessions shed; bystanders on healthy replicas lose NOTHING. After
+    the victim catches back up it rotates back into placement."""
+
+    async def main():
+        source, (r0, r1), router = await _fleet(
+            2, max_lag_heights=2, lag_poll_s=0.02
+        )
+        s_a = await router.subscribe(StubWS(), Q_BLOCK, sub_id=0)
+        s_b = await router.subscribe(StubWS(), Q_BLOCK, sub_id=1)
+        victim_sess, bystander_sess = (
+            (s_a, s_b) if _replica_of(router, s_a) is r0 else (s_b, s_a)
+        )
+        blocks = make_blocks(6)
+        source.advance(blocks[0])
+        await wait_until(
+            lambda: r0.served_height() == 1 and r1.served_height() == 1,
+            what="both at 1",
+        )
+        r0.stalled = True
+        for b in blocks[1:]:
+            source.advance(b)
+        await wait_until(
+            lambda: victim_sess.closed, what="lag shed"
+        )
+        assert victim_sess.close_reason == "shed_lag"
+        st = router.fleet_status()
+        assert st["sheds"]["lag"] == 1
+        assert [
+            r["degraded"] for r in st["replicas"]
+        ] == [True, False]
+        # the bystander saw every height, uninterrupted
+        await wait_until(
+            lambda: len(bystander_sess.sink.frames) == 6,
+            what="bystander stream",
+        )
+        assert bystander_sess.sink.heights() == list(range(1, 7))
+        assert not bystander_sess.closed
+        # new placements avoid the degraded replica
+        await router.subscribe(StubWS(), Q_BLOCK, sub_id=9)
+        assert r0.members() == 0
+        # recovery: unstall -> catches up -> rotated back in
+        r0.stalled = False
+        await wait_until(
+            lambda: not router.fleet_status()["replicas"][0][
+                "degraded"
+            ],
+            what="recovery",
+        )
+        await _teardown(router, [r0, r1])
+
+    run(main())
+
+
+# --- failover ---------------------------------------------------------
+
+
+def test_failover_zero_lost_commits():
+    """Replica death mid-stream: every stranded session is re-admitted
+    on a survivor and its delivered stream is gap-free AND
+    byte-identical to an uninterrupted one (store replay + splice)."""
+
+    async def main():
+        source, (r0, r1), router = await _fleet(2)
+        sessions = []
+        for i in range(4):
+            q = Q_BLOCK if i % 2 == 0 else Q_TX
+            sessions.append(
+                await router.subscribe(StubWS(), q, sub_id=i)
+            )
+        stranded = [
+            s for s in sessions if _replica_of(router, s) is r0
+        ]
+        assert len(stranded) == 2
+        blocks = make_blocks(8, txs=2)
+        for b in blocks[:4]:
+            source.advance(b)
+        await wait_until(
+            lambda: r0.served_height() == 4 and r1.served_height() == 4,
+            what="both at 4",
+        )
+        await r0.kill()
+        for b in blocks[4:]:
+            source.advance(b)
+        await wait_until(
+            lambda: all(
+                _replica_of(router, s) is r1 for s in stranded
+            ),
+            what="failover",
+        )
+        st = router.fleet_status()
+        assert st["failovers"] == 1
+        assert st["sessions_resumed"] == 2
+        assert st["sheds"]["failover"] == 0
+        # every session — resumed or not — holds the full stream
+        exp_block = [h for h in range(1, 9)]
+        exp_tx = [h for h in range(1, 9) for _ in range(2)]
+        for s in sessions:
+            want = exp_block if s.query_str == Q_BLOCK else exp_tx
+            await wait_until(
+                lambda s=s, want=want: len(s.sink.frames)
+                == len(want),
+                what=f"full stream for {s.sub_id}",
+            )
+            assert s.sink.heights() == want, s.sub_id
+        for s in stranded:
+            assert s.resumes == 1
+        # replayed frames are byte-identical to live ones: rebuild
+        # the uninterrupted stream and compare wholesale
+        for s in stranded:
+            expect = []
+            for blk in blocks:
+                for e in height_events(blk):
+                    from cometbft_tpu.rpc.fanout import _event_attrs
+
+                    if s.query.matches(_event_attrs(e)):
+                        expect.append(
+                            s._prefix
+                            + event_payload(e, s.query_str)
+                            + "}"
+                        )
+            assert s.sink.frames == expect
+        await _teardown(router, [r0, r1])
+
+    run(main())
+
+
+def test_failover_without_store_sheds_honestly():
+    """No store to replay from -> a live-only re-admit would be lossy;
+    the router sheds instead of silently dropping commits."""
+
+    async def main():
+        source = StreamSource()
+        replicas = [
+            FollowerNode(f"r{i}", source, poll_s=0.01)
+            for i in range(2)
+        ]
+        router = SessionRouter(
+            replicas, store_source=None, lag_poll_s=0.02
+        )
+        for r in replicas:
+            await r.start()
+        await router.start()
+        ws = StubWS()
+        sess = await router.subscribe(ws, Q_BLOCK)
+        victim = _replica_of(router, sess)
+        for b in make_blocks(2):
+            source.advance(b)
+        await wait_until(
+            lambda: victim.served_height() == 2, what="victim at 2"
+        )
+        await victim.kill()
+        await wait_until(lambda: sess.closed, what="failover shed")
+        assert sess.close_reason == "failover_shed"
+        st = router.fleet_status()
+        assert st["sheds"]["failover"] == 1
+        assert st["sessions_resumed"] == 0
+        await _teardown(router, replicas)
+
+    run(main())
+
+
+# --- NodeReplica adapter ----------------------------------------------
+
+
+def test_node_replica_adapter_surface():
+    import types as _types
+
+    from cometbft_tpu.rpc.fanout import FanoutHub
+    from cometbft_tpu.types import events as ev
+
+    async def main():
+        bus = ev.EventBus()
+        bus.set_loop(asyncio.get_running_loop())
+        hub = FanoutHub(bus)
+        node = _types.SimpleNamespace(
+            parts=_types.SimpleNamespace(privval=None),
+            rpc_server=_types.SimpleNamespace(fanout=hub),
+            height=5,
+            config=_types.SimpleNamespace(
+                base=_types.SimpleNamespace(moniker="adapter")
+            ),
+        )
+        rep = NodeReplica(node)
+        assert rep.role == "follower"
+        node.parts.privval = object()
+        assert rep.role == "validator"
+        assert rep.served_height() == 5 and rep.lag_heights() == 0
+        assert await rep.wait_height(4, 0.1)
+        assert not await rep.wait_height(9, 0.05)
+        # sessions ride the node's hub; heights tracked by frame parse
+        sess = RoutedSession(StubWS(), Q_BLOCK, parse_query(Q_BLOCK), 1)
+        sess.parse_heights = rep.HUB_DELIVERY
+        rep.attach(sess)
+        assert rep.members() == 1
+        blk = make_blocks(1)[0]
+        bus.publish(
+            ev.Event(
+                ev.EVENT_NEW_BLOCK,
+                {
+                    "block": blk,
+                    "block_id": None,
+                    "result_events": [],
+                },
+                {"height": "1"},
+            )
+        )
+        await wait_until(
+            lambda: len(sess.sink.frames) == 1, what="hub frame"
+        )
+        assert sess.last_delivered == 1  # parsed, no on_height signal
+        await rep.detach_member(sess)
+        assert rep.members() == 0
+        await hub.close()
+
+    run(main())
+
+
+# --- fleet status -----------------------------------------------------
+
+
+def test_fleet_status_shape():
+    async def main():
+        source, replicas, router = await _fleet(2)
+        await router.subscribe(StubWS(), Q_BLOCK)
+        router.issue_token()
+        st = router.fleet_status()
+        assert st["sessions"] == 1
+        assert st["tokens_issued"] == 1
+        assert set(st["sheds"]) == {"admit", "lag", "failover"}
+        assert st["admission"]["maxsize"] == 4096
+        assert len(st["replicas"]) == 2
+        for rs in st["replicas"]:
+            assert rs["role"] == "follower"
+            assert rs["alive"] and not rs["degraded"]
+            assert rs["lag_heights"] == 0
+        assert json.dumps(st)  # JSON-serializable for /fleet_status
+        await _teardown(router, replicas)
+
+    run(main())
+
+
+# --- satellites: plane drain + shared cross-replica cache -------------
+
+N_VALS = 2
+CHAIN_LEN = 8
+
+
+@pytest.fixture(scope="module")
+def chain():
+    gen, pvs = make_genesis(N_VALS, chain_id="fleet-light")
+    node = make_chain(gen, [pv.priv_key for pv in pvs], CHAIN_LEN)
+    yield gen, pvs, node
+    node.close_stores()
+
+
+def _light_client(gen, node):
+    from cometbft_tpu.light.client import Client, TrustOptions
+    from cometbft_tpu.light.provider import StoreBackedProvider
+
+    provider = StoreBackedProvider(
+        gen.chain_id, node.block_store, node.state_store
+    )
+    root = provider.light_block(1)
+    return Client(
+        gen.chain_id,
+        TrustOptions(
+            period_ns=24 * 3600 * 10**9, height=1, hash=root.hash()
+        ),
+        provider,
+    )
+
+
+def test_plane_drain_is_bounded_and_reversible(chain):
+    gen, _, node = chain
+    plane = LightServingPlane([_light_client(gen, node)])
+    assert plane.serve(5).height == 5
+    # a held in-flight slot: drain must time out BOUNDED, not hang
+    assert plane.gate.enter(1.0)
+    t0 = time.monotonic()
+    assert plane.drain(timeout_s=0.3) is False
+    assert 0.25 <= time.monotonic() - t0 < 2.0
+    assert plane.stats()["draining"]
+    # draining sheds new work with the standard overload error
+    with pytest.raises(ServingOverloadError):
+        plane.serve(6)
+    with pytest.raises(ServingOverloadError):
+        plane.open_session()
+    shed_before = plane.requests_shed
+    assert shed_before >= 1
+    # in-flight resolves -> drain completes promptly
+    plane.gate.exit()
+    assert plane.drain(timeout_s=1.0) is True
+    plane.resume()
+    assert not plane.stats()["draining"]
+    assert plane.serve(5).height == 5  # cache hit, serving again
+
+
+def test_cross_replica_shared_cache_single_flight(chain):
+    """Two followers, one VerifiedHeaderCache: a height requested
+    through BOTH replicas' planes concurrently verifies exactly once
+    process-wide, and the poison refusal is unchanged."""
+    import dataclasses
+
+    from cometbft_tpu.light.types import LightBlock
+
+    gen, _, node = chain
+    cache = VerifiedHeaderCache(gen.chain_id)
+    planes = [
+        LightServingPlane([_light_client(gen, node)], cache=cache)
+        for _ in range(2)
+    ]
+
+    async def main():
+        source = StoreSource(node.block_store)
+        followers = [
+            FollowerNode(
+                f"r{i}", source, light_plane=planes[i], poll_s=0.01
+            )
+            for i in range(2)
+        ]
+        router = SessionRouter(followers, store_source=source)
+        for f in followers:
+            await f.start()
+        await router.start()
+
+        verify_calls = []
+        for p in planes:
+            orig = p._verify
+
+            def counted(h, _orig=orig):
+                verify_calls.append(h)
+                time.sleep(0.05)  # hold the flight so peers pile up
+                return _orig(h)
+
+            p._verify = counted
+
+        # concurrent requests for the SAME height through BOTH
+        # replicas: the shared cache single-flights them fleet-wide
+        got = []
+        threads = [
+            threading.Thread(
+                target=lambda t=tok: got.append(
+                    router.serve_light(6, t)
+                )
+            )
+            for tok in (None, None, None, None)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            await asyncio.to_thread(t.join)
+        assert len(got) == 4
+        assert len(verify_calls) == 1, verify_calls
+        assert all(lb.height == 6 for lb in got)
+        assert cache.hits + cache.flight_waits >= 3
+        # second replica's plane now hits the shared cache cold-free
+        before = len(verify_calls)
+        assert planes[1].serve(6).height == 6
+        assert len(verify_calls) == before
+
+        # poison refusal is unchanged with a shared cache
+        lb = got[0]
+        poisoned = LightBlock(
+            header=dataclasses.replace(
+                lb.header, app_hash=b"\x66" * 32
+            ),
+            commit=lb.commit,
+            validator_set=lb.validator_set,
+        )
+        entries_before = len(cache)
+        with pytest.raises(CachePoisonError):
+            cache.publish(poisoned)
+        assert len(cache) == entries_before
+
+        await _teardown(router, followers)
+
+    run(main())

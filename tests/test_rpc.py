@@ -118,6 +118,32 @@ def test_ws_subscription_new_block():
     run(main())
 
 
+def test_serving_role_and_fleet_status():
+    """ISSUE 19 satellites: status/health carry serving_role +
+    replica_lag_heights, and /fleet_status answers honestly on a node
+    that fronts no fleet."""
+
+    async def main():
+        node, cli = await _single_node()
+        st = await cli.status()
+        # a privval-carrying node is a validator; its own head IS its
+        # committee view, so replica lag is zero
+        assert st["serving_role"] == "validator"
+        assert st["replica_lag_heights"] == "0"
+        h = await cli.call("health")
+        assert h["serving_role"] == "validator"
+        assert h["replica_lag_heights"] == 0
+        assert "fleet" not in h  # no router attached
+        # fleet_status on a routerless node: a clean JSON-RPC error,
+        # not a 404 and not a fabricated empty fleet
+        with pytest.raises(RPCClientError, match="serving fleet"):
+            await cli.call("fleet_status")
+        await cli.close()
+        await node.stop()
+
+    run(main())
+
+
 def test_misc_routes():
     async def main():
         node, cli = await _single_node()

@@ -147,6 +147,27 @@ JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" \
     --subscriber-storm 150 --trace-dump "$TRACE_DIR/sub_storm" --budget
 python -m cometbft_tpu.trace timeline "$TRACE_DIR/sub_storm" --strict
 
+echo "== chaos smoke: 3-replica serving fleet — replica_kill mid-stream, lossless failover =="
+# the serving fleet (ISSUE 19, docs/FLEET.md): three follower
+# replicas tail the live net behind the SessionRouter while routed
+# subscriber sessions stream commits; the schedule kills one replica
+# mid-stream and the run asserts lossless failover (every stranded
+# session resumed elsewhere with ZERO lost commits, height-keyed
+# replay from the store) + lag-shed isolation (only the victim's
+# clients move); fleet.route / fleet.failover spans budget-gated
+# (exit 2 on breach) and the commit waterfalls must stay complete
+cat > "$TRACE_DIR/fleet_schedule.json" <<'EOF'
+[
+  {"action": "replica_kill", "at_height": 3, "replica": 0},
+  {"action": "crash", "at_height": 4, "node": 1},
+  {"action": "restart", "after_s": 0.5, "node": 1}
+]
+EOF
+JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" \
+    --schedule "$TRACE_DIR/fleet_schedule.json" --fleet 3 \
+    --trace-dump "$TRACE_DIR/fleet" --budget
+python -m cometbft_tpu.trace timeline "$TRACE_DIR/fleet" --strict
+
 echo "== chaos smoke: verify storm — light + catch-up + live through ONE scheduler =="
 # the unified verify scheduler (docs/PERF.md "Unified verify
 # scheduler"): mid-schedule, a light-session storm and a
