@@ -1200,6 +1200,482 @@ def bench_live() -> dict:
     }
 
 
+def bench_finalize() -> dict:
+    """Native finalize lane ablation (ISSUE 20, docs/PERF.md "Native
+    finalize lane"): three legs —
+
+    - localnet — a 4-validator LocalNet driving the vecbank app
+      (models/vecbank.py, the vectorized apply — sub-ms per block, so
+      the finalize span exposes the hash/encode lane instead of
+      drowning it under a pure-Python per-tx app apply) at thousands
+      of 16-byte transfers per height, pipelined finalize + off-loop
+      apply ON in BOTH modes, native lane vs the portable twin
+      (loader forced unavailable), order-ALTERNATED repeats with
+      medians: blocks/s, the consensus.finalize span p95 the lane
+      targets, and the WAL->apply sub-leg median where the per-item
+      work lived;
+    - apply    — vecbank (models/vecbank.py) vectorized scatter-add vs
+      scalar per-tx apply over IDENTICAL blocks, app-hash parity
+      asserted per pass — carries the >=1.5x blocks/s gate;
+    - parity   — in-bench byte-parity: finalize_pass native vs the
+      portable twin over an event-heavy randomized block (unicode
+      attrs, an empty-event tx), AND the degraded path
+      (GRAFT_NATIVE_FINALIZE=0 — what a no-g++ box runs) pinned to the
+      same bytes. A run whose parity leg fails raises — the number is
+      only worth recording if the bytes agree.
+    """
+    import asyncio
+    import random
+    import shutil
+    import statistics
+    import tempfile
+
+    from cometbft_tpu.abci import types as abci
+    from cometbft_tpu.config.config import test_config
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.models.vecbank import (
+        VecBankApplication,
+        make_block_txs,
+        make_transfer,
+    )
+    from cometbft_tpu.node.inprocess import (
+        LocalNet,
+        build_node,
+        make_genesis,
+    )
+    from cometbft_tpu.state import native_finalize
+
+    n_nodes = int(os.environ.get("BENCH_FIN_NODES", "4"))
+    heights = int(os.environ.get("BENCH_FIN_HEIGHTS", "12"))
+    txs_per_height = int(os.environ.get("BENCH_FIN_TXS", "2000"))
+    repeats = int(os.environ.get("BENCH_FIN_REPEATS", "4"))
+    n_accounts = 1 << 14
+    apply_txs = int(os.environ.get("BENCH_FIN_APPLY_TXS", "4096"))
+    apply_heights = int(os.environ.get("BENCH_FIN_APPLY_HEIGHTS", "40"))
+
+    # mode toggling: the loader is process-wide state (module-level
+    # _mod/_tried, the wirecodec discipline), so the portable mode
+    # forces "tried, nothing loaded" and the native mode resets and
+    # re-resolves OFF the measured path (the .so is cached — no g++
+    # inside a timed run)
+    def force_portable():
+        with native_finalize._lock:
+            native_finalize._mod = None
+            native_finalize._tried = True
+
+    def restore_native():
+        with native_finalize._lock:
+            native_finalize._mod = None
+            native_finalize._tried = False
+        return native_finalize.module()
+
+    def run_once() -> dict:
+        base = tempfile.mkdtemp(prefix="bench_fin_")
+        old_backend = crypto_batch._default_backend
+        crypto_batch.set_default_backend("cpu")
+        try:
+            gen, pvs = make_genesis(n_nodes, chain_id="bench-fin")
+            nodes = []
+            for i, pv in enumerate(pvs):
+                home = os.path.join(base, f"n{i}")
+                os.makedirs(home, exist_ok=True)
+                cfg = test_config(home)
+                cfg.base.moniker = f"n{i}"
+                cfg.base.db_backend = "sqlite"  # real persist leg
+                # PACED heights: commit waits let the mempool refill
+                # so every block actually carries ~txs_per_height txs
+                # — free-running heights drain the feeder instantly
+                # and finalize near-empty blocks (nothing to hash)
+                cfg.consensus.skip_timeout_commit = False
+                cfg.consensus.timeout_commit_s = 0.25
+                cfg.tx_index.indexer = "null"
+                # both modes ride the full fast path — the ablation
+                # isolates the native hash/encode lane, nothing else
+                cfg.consensus.wal_group_commit_ms = 2.0
+                cfg.consensus.finalize_pipeline = True
+                cfg.consensus.finalize_offload_apply = True
+                nodes.append(
+                    build_node(
+                        gen,
+                        pv,
+                        app=VecBankApplication(n_accounts=n_accounts),
+                        config=cfg,
+                        home=home,
+                        wal=True,
+                    )
+                )
+            net = LocalNet(nodes)
+
+            async def main():
+                await net.start()
+
+                async def feed():
+                    # unique valid transfers (dedup-safe: the amount
+                    # term keeps every tx distinct until i wraps),
+                    # RATE-MATCHED to block cadence: overfeeding just
+                    # grows the mempool until post-commit re-checks
+                    # dominate every span and drown the ablation
+                    i = 0
+                    # ~txs_per_height per commit-timeout window
+                    per_tick = max(1, txs_per_height // 5)
+                    while True:
+                        for _ in range(per_tick):
+                            try:
+                                nodes[i % n_nodes].mempool.check_tx(
+                                    make_transfer(
+                                        i % n_accounts,
+                                        (i * 7 + 3) % n_accounts,
+                                        (i % 997) + 1,
+                                    )
+                                )
+                            except Exception:
+                                pass
+                            i += 1
+                        await asyncio.sleep(0.05)
+
+                feeder = asyncio.ensure_future(feed())
+                t0 = time.perf_counter()
+                await net.wait_for_height(heights, timeout=600)
+                wall = time.perf_counter() - t0
+                feeder.cancel()
+                await net.stop()
+                return wall
+
+            wall = asyncio.run(main())
+            for h in range(1, heights + 1):
+                hs = {
+                    n.block_store.load_block_meta(h).block_id.hash
+                    for n in nodes
+                }
+                assert len(hs) == 1, f"disagreement at height {h}"
+            fin_ns, apply_ms, hp_ms = [], [], []
+            for n in nodes:
+                for e in n.tracer.snapshot():
+                    if e["name"] == "consensus.finalize.hash_persist":
+                        hp_ms.append(e["dur_ns"] / 1e6)
+                    if e["name"] != "consensus.finalize":
+                        continue
+                    fin_ns.append(e["dur_ns"])
+                    a = (e.get("args") or {}).get("apply_ms")
+                    if a is not None:
+                        apply_ms.append(a)
+            fin_ns.sort()
+            out = {
+                "wall_s": wall,
+                "blocks_per_s": heights / wall,
+                "p95_finalize_ms": (
+                    fin_ns[int(0.95 * (len(fin_ns) - 1))] / 1e6
+                    if fin_ns
+                    else None
+                ),
+                # the WAL->apply sub-leg: where the per-item
+                # hash/encode lived before the native pass — a much
+                # tighter signal than the whole span (which also
+                # carries sqlite persist + loop-handoff scheduling)
+                "med_apply_ms": (
+                    statistics.median(apply_ms) if apply_ms else None
+                ),
+                # the leg the lane OWNS: hash/encode + response
+                # persist on the thread hop — the direct before/after
+                "med_hash_persist_ms": (
+                    statistics.median(hp_ms) if hp_ms else None
+                ),
+                "finalize_spans": len(fin_ns),
+            }
+            for n in nodes:
+                n.close_stores()
+            return out
+        finally:
+            crypto_batch.set_default_backend(old_backend)
+            shutil.rmtree(base, ignore_errors=True)
+
+    def localnet_leg() -> dict:
+        runs = {"portable": [], "native": []}
+        native_ok = False
+
+        def one(mode: str):
+            if mode == "portable":
+                force_portable()
+            else:
+                nonlocal native_ok
+                native_ok = restore_native() is not None
+            runs[mode].append(run_once())
+
+        try:
+            for i in range(repeats):
+                # ALTERNATE the order each repeat: this box's cpu
+                # throttling drifts over a leg, and a fixed A-then-B
+                # order would bill the drift to whichever mode always
+                # runs second
+                first, second = (
+                    ("portable", "native")
+                    if i % 2 == 0
+                    else ("native", "portable")
+                )
+                one(first)
+                one(second)
+        finally:
+            restore_native()
+        med = {
+            mode: {
+                "blocks_per_s": round(
+                    statistics.median(
+                        r["blocks_per_s"] for r in rs
+                    ),
+                    2,
+                ),
+                "p95_finalize_ms": round(
+                    statistics.median(
+                        r["p95_finalize_ms"] or 0 for r in rs
+                    ),
+                    2,
+                ),
+                "med_apply_ms": round(
+                    statistics.median(
+                        r["med_apply_ms"] or 0 for r in rs
+                    ),
+                    2,
+                ),
+                "med_hash_persist_ms": round(
+                    statistics.median(
+                        r["med_hash_persist_ms"] or 0 for r in rs
+                    ),
+                    2,
+                ),
+            }
+            for mode, rs in runs.items()
+        }
+        out = {
+            "native_module_loaded": native_ok,
+            **med,
+            "blocks_per_s_speedup": _ratio(
+                med["native"]["blocks_per_s"],
+                med["portable"]["blocks_per_s"],
+            ),
+        }
+        p_p = med["portable"]["p95_finalize_ms"]
+        n_p = med["native"]["p95_finalize_ms"]
+        if p_p and n_p:
+            out["p95_finalize_reduction"] = round(1.0 - n_p / p_p, 3)
+        p_a = med["portable"]["med_apply_ms"]
+        n_a = med["native"]["med_apply_ms"]
+        if p_a and n_a:
+            out["apply_ms_reduction"] = round(1.0 - n_a / p_a, 3)
+        p_h = med["portable"]["med_hash_persist_ms"]
+        n_h = med["native"]["med_hash_persist_ms"]
+        if p_h and n_h:
+            out["hash_persist_reduction"] = round(1.0 - n_h / p_h, 3)
+        if not native_ok:
+            out["note"] = (
+                "native module unavailable on this box: both modes "
+                "ran the portable twin (honest degraded ablation)"
+            )
+        return out
+
+    def apply_leg() -> dict:
+        """Vectorized vs scalar vecbank apply over identical blocks —
+        the blocks/s ceiling of the state-apply half of the lane.
+        Digest-parity asserted per pass; >=1.5x gate asserted here
+        (wraparound-commutative scatter-add vs the per-tx loop)."""
+        rng = random.Random(20)
+        blocks = [
+            make_block_txs(rng, apply_txs, 1 << 14)
+            for _ in range(apply_heights)
+        ]
+
+        def drive(scalar: bool):
+            app = VecBankApplication(scalar=scalar)
+            t0 = time.perf_counter()
+            for h, txs in enumerate(blocks, 1):
+                app.finalize_block(
+                    abci.RequestFinalizeBlock(height=h, txs=txs)
+                )
+                app.commit()
+            dt = time.perf_counter() - t0
+            return app.app_hash, apply_heights / dt
+
+        s_rates, v_rates = [], []
+        for _ in range(3):  # pass-interleaved, like every host leg
+            sh, sr = drive(scalar=True)
+            vh, vr = drive(scalar=False)
+            assert sh == vh, "vecbank scalar/vector app-hash diverged"
+            s_rates.append(sr)
+            v_rates.append(vr)
+        s = statistics.median(s_rates)
+        v = statistics.median(v_rates)
+        speedup = v / s
+        assert speedup >= 1.5, (
+            f"vectorized apply speedup {speedup:.2f}x < 1.5x gate"
+        )
+        return {
+            "txs_per_block": apply_txs,
+            "blocks": apply_heights,
+            "scalar_blocks_per_s": round(s, 2),
+            "vector_blocks_per_s": round(v, 2),
+            "speedup": round(speedup, 2),
+            "digest_parity": True,
+        }
+
+    def parity_leg() -> dict:
+        """finalize_pass byte-parity, asserted in-bench: whatever mode
+        the box resolves vs the forced-portable twin, and the env-gated
+        degraded path vs the same twin."""
+        rng = random.Random(7)
+        txs = [rng.randbytes(rng.randrange(1, 200)) for _ in range(24)]
+        results = []
+        for i, _ in enumerate(txs):
+            evs = []
+            if i % 3 != 1:  # every third tx ships no events
+                for j in range(rng.randrange(1, 4)):
+                    evs.append(
+                        abci.Event(
+                            type_=f"transfer.{j}",
+                            attributes=[
+                                abci.EventAttribute(
+                                    key=f"k{j}",
+                                    value=f"vé-{i}-{j}",
+                                    index=bool(j % 2),
+                                )
+                            ],
+                        )
+                    )
+            results.append(
+                abci.ExecTxResult(
+                    code=i % 2,
+                    data=rng.randbytes(8),
+                    gas_wanted=i,
+                    gas_used=i * 2,
+                    codespace="bench" if i % 4 == 0 else "",
+                    events=evs,
+                )
+            )
+        resp = abci.ResponseFinalizeBlock(
+            tx_results=results,
+            events=[
+                abci.Event(
+                    type_="block.reward",
+                    attributes=[
+                        abci.EventAttribute(
+                            key="amount", value="42", index=True
+                        )
+                    ],
+                )
+            ],
+        )
+
+        def same(a, b) -> bool:
+            return (
+                a.tx_hashes == b.tx_hashes
+                and a.results_enc == b.results_enc
+                and a.results_hash == b.results_hash
+                and a.tx_events_enc == b.tx_events_enc
+                and a.block_events_enc == b.block_events_enc
+            )
+
+        port = native_finalize.finalize_pass(txs, resp, portable=True)
+        live = native_finalize.finalize_pass(txs, resp)
+        assert same(live, port), "native finalize_pass parity broke"
+
+        # degraded path: the env gate is exactly what a no-compiler
+        # box (or an operator opt-out) runs — same bytes, native=False
+        old_env = os.environ.get("GRAFT_NATIVE_FINALIZE")
+        os.environ["GRAFT_NATIVE_FINALIZE"] = "0"
+        with native_finalize._lock:
+            native_finalize._mod = None
+            native_finalize._tried = False
+        try:
+            gated = native_finalize.finalize_pass(txs, resp)
+            assert not gated.native, "env gate did not disable native"
+            assert same(gated, port), "degraded-path parity broke"
+        finally:
+            if old_env is None:
+                os.environ.pop("GRAFT_NATIVE_FINALIZE", None)
+            else:
+                os.environ["GRAFT_NATIVE_FINALIZE"] = old_env
+            restore_native()
+        # raw single-threaded compute ratio on a realistic big block
+        # (1000 txs, 1 indexed attr each): the lane's win with no
+        # scheduler in the frame — the localnet caveat's counterpart
+        big_txs = [
+            rng.randbytes(64) for _ in range(1000)
+        ]
+        big_resp = abci.ResponseFinalizeBlock(
+            tx_results=[
+                abci.ExecTxResult(
+                    code=0,
+                    events=[
+                        abci.Event(
+                            type_="app",
+                            attributes=[
+                                abci.EventAttribute(
+                                    key="key",
+                                    value=f"r{i}",
+                                    index=True,
+                                )
+                            ],
+                        )
+                    ],
+                )
+                for i in range(1000)
+            ]
+        )
+
+        def med_ms(portable: bool, n: int = 9) -> float:
+            ts = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                native_finalize.finalize_pass(
+                    big_txs, big_resp,
+                    portable=True if portable else None,
+                )
+                ts.append(time.perf_counter() - t0)
+            return statistics.median(ts) * 1e3
+
+        med_ms(True, 2)
+        med_ms(False, 2)  # warm
+        p_ms, n_ms = med_ms(True), med_ms(False)
+        return {
+            "native_ran": live.native,
+            "degraded_env_gate_parity": True,
+            "txs": len(txs),
+            "parity_ok": True,
+            "pass_portable_ms": round(p_ms, 2),
+            "pass_native_ms": round(n_ms, 2),
+            "pass_speedup": _ratio(p_ms, n_ms),
+        }
+
+    parity = parity_leg()  # gate FIRST: no number without parity
+    run_once()  # warm pass (sqlite, allocator, native .so resolve)
+    localnet = localnet_leg()
+    apply_ = apply_leg()
+    return {
+        "rate": localnet["native"]["blocks_per_s"],
+        "nodes": n_nodes,
+        "heights": heights,
+        "txs_per_height": txs_per_height,
+        "repeats_per_mode": repeats,
+        "localnet": localnet,
+        "apply": apply_,
+        "parity": parity,
+        "verdict_parity": _verdict_parity(),
+        "note": (
+            "localnet = native lane vs portable twin on the pipelined "
+            "fast path, vecbank app, paced 2000-tx heights "
+            "(consensus.finalize p95 is the lane's target span); "
+            "apply = vecbank scatter-add vs per-tx loop (>=1.5x "
+            "gate, digest parity per pass); parity = finalize_pass "
+            "bytes pinned native==portable==env-gated degraded. "
+            "Order-alternated medians throughout. CAVEAT "
+            "(hash_persist span): 4 in-process nodes oversubscribe "
+            "2 vCPUs, so the native pass's GIL-FREE window gets "
+            "billed wall-clock loop work the portable (GIL-holding) "
+            "twin simply blocks — read the end-to-end numbers "
+            "(blocks/s, p95, apply_ms) for the verdict and the "
+            "single-threaded micro ratio for the raw compute win"
+        ),
+    }
+
+
 def bench_lifecycle() -> dict:
     """Storage lifecycle plane overhead gate (ISSUE 17,
     docs/STORAGE.md): the SAME 4-validator LocalNet workload with the
@@ -3655,6 +4131,7 @@ def main() -> None:
             "pipeline",
             "ingest",
             "live",
+            "finalize",
             "lifecycle",
             "serve",
             "rpcfanout",
@@ -3794,6 +4271,13 @@ def main() -> None:
         # batched — the first optimization leg behind the PR 7 quorum
         # waterfall
         run_config("live", bench_live)
+    if "finalize" in todo:
+        # host-only native finalize lane ablation (ISSUE 20): one
+        # GIL-releasing hash/encode pass per block vs the portable
+        # twin on a 4-node LocalNet (consensus.finalize p95 target),
+        # vecbank vectorized-vs-scalar apply >=1.5x gate, byte-parity
+        # asserted in-bench incl. the env-gated degraded path
+        run_config("finalize", bench_finalize)
     if "lifecycle" in todo:
         # host-only storage lifecycle ablation (ISSUE 17): 4-node
         # LocalNet, retention plane OFF vs ON — <5% overhead gate +

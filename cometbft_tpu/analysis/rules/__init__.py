@@ -2,6 +2,7 @@
 from . import (  # noqa: F401
     async_rules,
     complexity_rules,
+    finalize_rules,
     interproc_rules,
     jax_rules,
     trace_rules,

@@ -177,6 +177,13 @@ class ConsensusConfig:
     # messages park and replay at height entry. Off = the reference's
     # blocking finalize.
     finalize_pipeline: bool = False
+    # native finalize lane riding the pipeline (docs/PERF.md): the
+    # hash/encode/persist leg of the ABCI apply (one GIL-releasing
+    # native pass per block, state/native_finalize.py) takes a second
+    # to_thread hop so the loop keeps relaying gossip through it.
+    # Only engages when finalize_pipeline is on; off = the apply runs
+    # whole on-loop exactly like the serial path.
+    finalize_offload_apply: bool = True
 
     def propose_timeout(self, round_: int) -> float:
         return self.timeout_propose_s + self.timeout_propose_delta_s * round_

@@ -1556,17 +1556,61 @@ class ConsensusState:
 
         async def run():
             try:
-                # only the disk legs go off-loop: the loop keeps
-                # relaying votes/parts while sqlite + the end-height
-                # fsync grind, then the (pure-Python, GIL-bound) ABCI
-                # apply runs back on the loop exactly like the serial
-                # path — same order, same fail points
+                # the disk legs go off-loop: the loop keeps relaying
+                # votes/parts while sqlite + the end-height fsync
+                # grind
                 t_fin, t_persist, t_wal = await asyncio.to_thread(
                     self._finalize_persist, block, parts, commit
                 )
-                timings = self._finalize_apply(
-                    block, bid, t_fin, t_persist, t_wal
-                )
+                if self.config.finalize_offload_apply:
+                    # native finalize lane (state/native_finalize.py):
+                    # the ABCI dispatch stays on-loop (app-owned,
+                    # GIL-ful), but the hash/encode/persist leg —
+                    # which the native pass runs with the GIL
+                    # RELEASED — rides a second thread hop, so the
+                    # loop relays gossip through it too. Same phase
+                    # order and fail points as the serial apply_block.
+                    t0 = time.monotonic()
+                    resp = self.block_exec.apply_finalize(
+                        self.state, block, verified=True
+                    )
+                    def hash_persist():
+                        # timed THREAD-SIDE: the span is the leg the
+                        # native lane owns (tx hashes, result encodes,
+                        # LastResultsHash, event encodes, the response
+                        # write) without the loop-resume latency of
+                        # the to_thread hop, which on a saturated box
+                        # dwarfs the work itself
+                        t_a = time.monotonic_ns()
+                        out = self.block_exec.apply_hash_persist(
+                            self.state, bid, block, resp
+                        )
+                        return out, t_a, time.monotonic_ns()
+
+                    (new_state, artifacts), t_a, t_b = (
+                        await asyncio.to_thread(hash_persist)
+                    )
+                    self.tracer.complete(
+                        "consensus.finalize.hash_persist", t_a,
+                        t_b - t_a,
+                        tid="consensus", height=block.height,
+                        native=artifacts.native,
+                    )
+                    new_state = self.block_exec.apply_complete(
+                        new_state, bid, block, resp, artifacts, t0
+                    )
+                    t_apply = time.monotonic_ns()
+                    fail_point("cs-after-apply")  # :1837
+                    timings = (
+                        new_state, t_fin, t_persist, t_wal, t_apply
+                    )
+                else:
+                    # legacy shape: the whole (pure-Python, GIL-bound)
+                    # ABCI apply runs back on the loop exactly like
+                    # the serial path
+                    timings = self._finalize_apply(
+                        block, bid, t_fin, t_persist, t_wal
+                    )
             except asyncio.CancelledError:
                 raise
             except Exception:

@@ -120,13 +120,23 @@ def _compute_root(
 
 def proofs_from_byte_slices(items: Sequence[bytes]):
     """Returns (root, [Proof per item])."""
-    trails, root_node = _trails_from_byte_slices(list(items))
+    return proofs_from_leaf_hashes([leaf_hash(it) for it in items])
+
+
+def proofs_from_leaf_hashes(leaf_hashes: Sequence[bytes]):
+    """Returns (root, [Proof per leaf]) from PRECOMPUTED leaf hashes
+    (sha256(0x00 || item) each) — the seam that lets the proposal
+    path hash block-part chunks natively with the GIL released
+    (state/native_finalize.part_leaf_hashes) while the trail/aunt
+    construction stays here; identical output to
+    ``proofs_from_byte_slices`` on the same items."""
+    trails, root_node = _trails_from_leaf_hashes(list(leaf_hashes))
     root = root_node.hash if root_node else _sha256(b"")
     proofs = []
     for i, trail in enumerate(trails):
         proofs.append(
             Proof(
-                total=len(items),
+                total=len(leaf_hashes),
                 index=i,
                 leaf_hash=trail.hash,
                 aunts=trail.flatten_aunts(),
@@ -156,16 +166,16 @@ class _Node:
         return out
 
 
-def _trails_from_byte_slices(items: List[bytes]):
-    n = len(items)
+def _trails_from_leaf_hashes(leaf_hashes: List[bytes]):
+    n = len(leaf_hashes)
     if n == 0:
         return [], None
     if n == 1:
-        node = _Node(leaf_hash(items[0]))
+        node = _Node(leaf_hashes[0])
         return [node], node
     k = _split_point(n)
-    lefts, left_root = _trails_from_byte_slices(items[:k])
-    rights, right_root = _trails_from_byte_slices(items[k:])
+    lefts, left_root = _trails_from_leaf_hashes(leaf_hashes[:k])
+    rights, right_root = _trails_from_leaf_hashes(leaf_hashes[k:])
     root = _Node(inner_hash(left_root.hash, right_root.hash))
     left_root.parent = root
     left_root.right = right_root

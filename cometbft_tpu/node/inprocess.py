@@ -133,9 +133,13 @@ def build_node(
     # thread NOW so no event loop ever pays it (ASY114 found the
     # subprocess.run reachable from reactor hot paths; module() falls
     # back to the portable codec while the build is in flight)
+    from ..state import native_finalize as _native_finalize
     from ..utils import wirecodec as _wirecodec
 
     _wirecodec.prewarm()
+    # same discipline for the native finalize lane (one GIL-releasing
+    # hash/encode pass per block, state/native_finalize.py)
+    _native_finalize.prewarm()
     # tracing plane: one ring per node; cross-node planes (the crypto
     # worker pool) land on the process-wide tracer, enabled the first
     # time any tracing node is built

@@ -62,6 +62,14 @@ def _event_attrs(e: ev.Event) -> Dict[str, list]:
         attrs["tx.height"] = [str(e.data.get("height", ""))]
         if "hash" in e.attrs:
             attrs["tx.hash"] = [e.attrs["hash"].upper()]
+        flat = e.data.get("events_flat")
+        if flat is not None:
+            # the finalize lane already flattened the attributes once
+            # (state/native_finalize.py) — read the shared form
+            for type_, kvis in flat:
+                for k, v, _ in kvis:
+                    attrs.setdefault(f"{type_}.{k}", []).append(v)
+            return attrs
         result = e.data.get("result")
         from ..abci.types import attr_kvi
 

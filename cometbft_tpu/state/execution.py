@@ -19,6 +19,7 @@ from ..crypto import merkle
 from ..types import events as ev
 from ..utils import codec, proto
 from ..utils.fail import fail_point
+from . import native_finalize
 from .state_types import BLOCK_VERSION, State
 from .validation import validate_block
 
@@ -30,13 +31,14 @@ DEFAULT_BLOCK_TIME_TOLERANCE_NS = 0
 
 
 def results_hash(tx_results: List[abci.ExecTxResult]) -> bytes:
+    # bftlint: disable-next=ASY123 — portable twin of the native lane; the finalize path reads artifacts.results_hash, this serves compat callers (light proxy, replay) on short lists
     return merkle.hash_from_byte_slices([r.encode() for r in tx_results])
 
 
 def _enc_abci_event(e: abci.Event) -> bytes:
     out = proto.field_string(1, e.type_)
     for a in e.attributes:
-        k, v, idx = abci.attr_kvi(a)
+        k, v, idx = abci.attr_kvi(a)  # bftlint: disable=ASY123 — portable event encoder: the finalize path carries pre-encoded artifacts; this serves no-artifact callers (decode roundtrips, tests)
         out += proto.field_bytes(
             2,
             proto.field_string(1, k)
@@ -61,16 +63,27 @@ def _dec_abci_event(b: bytes) -> abci.Event:
     return abci.Event(type_=proto.get1(m, 1, b"").decode(), attributes=attrs)
 
 
-def encode_finalize_response(resp: abci.ResponseFinalizeBlock) -> bytes:
+def encode_finalize_response(
+    resp: abci.ResponseFinalizeBlock, artifacts=None
+) -> bytes:
     # NOTE: per-tx events ride NEW fields (4: block events, 5: one
     # aligned event-list per tx_result) because r.encode() feeds
     # LastResultsHash and must stay byte-stable (ISSUE 15: the stored
     # response is the indexer's crash-replay source — events lost
     # here would be index rows lost to a crash). Old records simply
     # lack fields 4/5 and decode event-less, as before.
+    #
+    # When the finalize pass already ran, ``artifacts`` carries the
+    # result/event bytes encoded once for LastResultsHash — fields
+    # 1/4/5 reuse them instead of re-encoding (byte-identical: the
+    # portable twin is differential-tested against both encoders).
     out = b""
-    for r in resp.tx_results:
-        out += proto.field_message(1, r.encode())
+    if artifacts is not None:
+        for rb in artifacts.results_enc:
+            out += proto.field_message(1, rb)
+    else:
+        for r in resp.tx_results:
+            out += proto.field_message(1, r.encode())  # bftlint: disable=ASY123 — no-artifacts fallback (tests/compat); apply_hash_persist always passes artifacts
     for vu in resp.validator_updates:
         out += proto.field_message(
             2,
@@ -79,8 +92,20 @@ def encode_finalize_response(resp: abci.ResponseFinalizeBlock) -> bytes:
             + proto.field_varint(3, vu.power),
         )
     out += proto.field_bytes(3, resp.app_hash)
+    if artifacts is not None:
+        for eb in artifacts.block_events_enc:
+            out += proto.field_message(4, eb)
+        for i, evs in enumerate(artifacts.tx_events_enc):
+            if not evs:
+                continue  # empty fields encode to nothing; key by index
+            out += proto.field_message(
+                5,
+                proto.field_varint(1, i)
+                + b"".join(proto.field_message(2, eb) for eb in evs),
+            )
+        return out
     for e in resp.events:
-        out += proto.field_message(4, _enc_abci_event(e))
+        out += proto.field_message(4, _enc_abci_event(e))  # bftlint: disable=ASY123 — no-artifacts fallback (tests/compat); apply_hash_persist always passes artifacts
     for i, r in enumerate(resp.tx_results):
         if not r.events:
             continue  # empty fields encode to nothing; key by index
@@ -88,7 +113,7 @@ def encode_finalize_response(resp: abci.ResponseFinalizeBlock) -> bytes:
             5,
             proto.field_varint(1, i)
             + b"".join(
-                proto.field_message(2, _enc_abci_event(e))
+                proto.field_message(2, _enc_abci_event(e))  # bftlint: disable=ASY123 — no-artifacts fallback (tests/compat); apply_hash_persist always passes artifacts
                 for e in r.events
             ),
         )
@@ -401,6 +426,28 @@ class BlockExecutor:
         verified: bool = False,
     ) -> State:
         t0 = time.monotonic()
+        resp = self.apply_finalize(state, block, verified=verified)
+        new_state, artifacts = self.apply_hash_persist(
+            state, block_id, block, resp
+        )
+        return self.apply_complete(
+            new_state, block_id, block, resp, artifacts, t0
+        )
+
+    # The three finalize phases. The serial apply_block above is their
+    # sequential composition — same order, same fail points. The
+    # pipelined path (consensus/state.py _start_pipelined_finalize)
+    # splits at the phase seams instead: apply_finalize stays on-loop
+    # (ABCI dispatch is app-owned and GIL-ful), apply_hash_persist
+    # rides asyncio.to_thread (the native finalize pass releases the
+    # GIL for the hash/encode leg and sqlite releases it for the
+    # write), apply_complete lands back on-loop (mempool lock, event
+    # bus, observers).
+
+    def apply_finalize(
+        self, state: State, block: T.Block, verified: bool = False
+    ) -> abci.ResponseFinalizeBlock:
+        """Phase 1 (on-loop): validate + ABCI FinalizeBlock."""
         if not verified:
             self.validate_block(state, block)
         req = abci.RequestFinalizeBlock(
@@ -419,19 +466,42 @@ class BlockExecutor:
         fail_point("exec-after-finalize")  # reference execution.go:313
         if len(resp.tx_results) != len(block.data.txs):
             raise RuntimeError("app returned wrong number of tx results")
+        return resp
+
+    def apply_hash_persist(
+        self, state: State, block_id: T.BlockID, block: T.Block, resp
+    ):
+        """Phase 2 (thread-ridable): one native finalize pass — per-tx
+        sha256, ExecTxResult encodes, LastResultsHash, event encodes —
+        then the stored response + state save reusing those bytes."""
+        artifacts = native_finalize.finalize_pass(block.data.txs, resp)
         self.store.save_finalize_block_response(
-            block.height, encode_finalize_response(resp)
+            block.height, encode_finalize_response(resp, artifacts)
         )
         fail_point("exec-after-save-response")  # :320
-        new_state = self._update_state(state, block_id, block, resp)
+        new_state = self._update_state(
+            state, block_id, block, resp, artifacts
+        )
+        return new_state, artifacts
+
+    def apply_complete(
+        self,
+        new_state: State,
+        block_id: T.BlockID,
+        block: T.Block,
+        resp,
+        artifacts=None,
+        t0: Optional[float] = None,
+    ) -> State:
+        """Phase 3 (on-loop): commit, evidence, prune, events."""
         self._commit(new_state, block, resp)
         if self.evpool:
             self.evpool.update(new_state, block.evidence)
         self._prune(new_state)
-        self._fire_events(block, block_id, resp)
+        self._fire_events(block, block_id, resp, artifacts)
         # observability hook (reference state/execution.go:292
         # BlockProcessingTime metric)
-        if self.block_processing_observer is not None:
+        if self.block_processing_observer is not None and t0 is not None:
             try:
                 self.block_processing_observer(time.monotonic() - t0)
             except Exception:
@@ -481,7 +551,8 @@ class BlockExecutor:
                 pass
 
     def _update_state(
-        self, state: State, block_id: T.BlockID, block: T.Block, resp
+        self, state: State, block_id: T.BlockID, block: T.Block, resp,
+        artifacts=None,
     ) -> State:
         nvals = state.next_validators.copy()
         changed = state.last_height_validators_changed
@@ -523,38 +594,52 @@ class BlockExecutor:
             last_height_validators_changed=changed,
             consensus_params=params,
             last_height_consensus_params_changed=params_changed,
-            last_results_hash=results_hash(resp.tx_results),
+            last_results_hash=(
+                artifacts.results_hash
+                if artifacts is not None
+                else results_hash(resp.tx_results)
+            ),
             app_hash=resp.app_hash,
         )
         self.store.save(new_state)
         return new_state
 
-    def _fire_events(self, block, block_id, resp) -> None:
+    def _fire_events(self, block, block_id, resp, artifacts=None) -> None:
         if self.event_bus is None:
             return
+        new_block_data = {
+            "block": block,
+            "block_id": block_id,
+            "result_events": resp.events,
+        }
+        if artifacts is not None:
+            # thread the once-flattened/encoded forms so the indexer
+            # and fan-out never re-walk the attributes (optional keys:
+            # events published from replay or tests simply lack them
+            # and every consumer falls back to flattening itself)
+            new_block_data["events_flat"] = artifacts.block_events_flat
+            new_block_data["events_enc"] = artifacts.block_events_enc
         self.event_bus.publish_type(
-            ev.EVENT_NEW_BLOCK,
-            {
-                "block": block,
-                "block_id": block_id,
-                "result_events": resp.events,
-            },
-            height=block.height,
+            ev.EVENT_NEW_BLOCK, new_block_data, height=block.height
         )
         self.event_bus.publish_type(
             ev.EVENT_NEW_BLOCK_HEADER, block.header, height=block.height
         )
         for i, tx in enumerate(block.data.txs):
-            self.event_bus.publish_type(
-                ev.EVENT_TX,
-                {
-                    "height": block.height,
-                    "index": i,
-                    "tx": tx,
-                    "result": resp.tx_results[i],
-                },
-                hash=hashlib.sha256(tx).hexdigest(),
-            )
+            data = {
+                "height": block.height,
+                "index": i,
+                "tx": tx,
+                "result": resp.tx_results[i],
+            }
+            if artifacts is not None:
+                data["tx_hash"] = artifacts.tx_hashes[i]
+                data["events_flat"] = artifacts.tx_events_flat[i]
+                data["events_enc"] = artifacts.tx_events_enc[i]
+                h = artifacts.tx_hashes[i].hex()
+            else:
+                h = hashlib.sha256(tx).hexdigest()  # bftlint: disable=ASY123 — no-artifacts fallback (replay/tests); the finalize path reuses artifacts.tx_hashes
+            self.event_bus.publish_type(ev.EVENT_TX, data, hash=h)
         if resp.validator_updates:
             self.event_bus.publish_type(
                 ev.EVENT_VALIDATOR_SET_UPDATES, resp.validator_updates

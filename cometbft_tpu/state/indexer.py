@@ -58,10 +58,12 @@ def _dec_height(b: Optional[bytes]) -> int:
     return struct.unpack(">Q", b)[0] if b else 0
 
 
-def _enc_record(height: int, index: int, tx: bytes, result) -> bytes:
+def _enc_record(
+    height: int, index: int, tx: bytes, result, events_enc=None
+) -> bytes:
     from .execution import encode_finalize_response  # noqa: F401
 
-    res_b = _enc_tx_result(result)
+    res_b = _enc_tx_result(result, events_enc)
     return (
         proto.field_varint(1, height)
         + proto.field_varint(2, index + 1)
@@ -70,7 +72,7 @@ def _enc_record(height: int, index: int, tx: bytes, result) -> bytes:
     )
 
 
-def _enc_tx_result(r) -> bytes:
+def _enc_tx_result(r, events_enc=None) -> bytes:
     out = (
         proto.field_varint(1, r.code)
         + proto.field_bytes(2, r.data)
@@ -78,6 +80,13 @@ def _enc_tx_result(r) -> bytes:
         + proto.field_varint(4, r.gas_wanted)
         + proto.field_varint(5, r.gas_used)
     )
+    if events_enc is not None:
+        # the field-6 payload is byte-identical to the finalize lane's
+        # encoded-event bytes (state/native_finalize.py) — reuse them
+        # instead of re-walking the attributes
+        for eb in events_enc:
+            out += proto.field_bytes(6, eb)
+        return out
     for e in r.events:
         attrs = b""
         for a in e.attributes:
@@ -142,15 +151,37 @@ class TxIndexer:
         self._lock = threading.Lock()
 
     def tx_sets(
-        self, height: int, index: int, tx: bytes, result: abci.ExecTxResult
+        self,
+        height: int,
+        index: int,
+        tx: bytes,
+        result: abci.ExecTxResult,
+        tx_hash: Optional[bytes] = None,
+        events_flat=None,
+        events_enc=None,
     ) -> List[Tuple[bytes, bytes]]:
         """The (key, value) rows for one tx — pure, deterministic:
         re-running on the same inputs produces byte-identical rows,
-        which is what makes crash replay idempotent."""
-        h = hashlib.sha256(tx).digest()
-        sets = [(b"tx:h:" + h, _enc_record(height, index, tx, result))]
+        which is what makes crash replay idempotent.
+
+        ``tx_hash``/``events_flat``/``events_enc`` are the finalize
+        lane's precomputed forms (state/native_finalize.py) — byte-
+        identical to deriving them here, just not re-derived."""
+        h = tx_hash if tx_hash is not None else hashlib.sha256(tx).digest()
+        sets = [
+            (b"tx:h:" + h, _enc_record(height, index, tx, result, events_enc))
+        ]
         # implicit attributes (reference: tx.height is always indexed)
         sets.append((_attr_key("tx.height", str(height), height, index), h))
+        if events_flat is not None:
+            for type_, kvis in events_flat:
+                for k, v, idx in kvis:
+                    if not idx:
+                        continue
+                    sets.append(
+                        (_attr_key(f"{type_}.{k}", v, height, index), h)
+                    )
+            return sets
         for e in result.events:
             for a in e.attributes:
                 k, v, idx = abci.attr_kvi(a)
@@ -302,10 +333,11 @@ class BlockIndexer:
         self.db = db
 
     def block_sets(
-        self, height: int, events: List[abci.Event]
+        self, height: int, events: List[abci.Event], events_flat=None
     ) -> List[Tuple[bytes, bytes]]:
         """Pure (key, value) rows for one block's events (same
-        idempotency contract as TxIndexer.tx_sets)."""
+        idempotency contract as TxIndexer.tx_sets). ``events_flat``
+        is the finalize lane's once-flattened form when available."""
         sets = [
             (
                 b"blk:e:block.height="
@@ -315,6 +347,21 @@ class BlockIndexer:
                 b"",
             )
         ]
+        if events_flat is not None:
+            for type_, kvis in events_flat:
+                for k, v, idx in kvis:
+                    if not idx:
+                        continue
+                    sets.append(
+                        (
+                            b"blk:e:"
+                            + f"{type_}.{k}={v}".encode()
+                            + b":"
+                            + struct.pack(">Q", height),
+                            b"",
+                        )
+                    )
+            return sets
         for e in events:
             for a in e.attributes:
                 k, v, idx = abci.attr_kvi(a)
@@ -422,14 +469,30 @@ def prune_index(
 
 
 class HeightBundle:
-    """Everything one height needs indexed, sealed once complete."""
+    """Everything one height needs indexed, sealed once complete.
 
-    __slots__ = ("height", "txs", "block_events")
+    ``extras`` maps tx index -> (tx_hash, events_flat, events_enc)
+    from the finalize lane's one pass (state/native_finalize.py);
+    ``block_events_flat`` is the once-flattened block-event form.
+    Both are optional — bundles built by replay or tests lack them
+    and the flush derives everything itself, byte-identically."""
 
-    def __init__(self, height: int, txs: list, block_events: list):
+    __slots__ = ("height", "txs", "block_events", "extras",
+                 "block_events_flat")
+
+    def __init__(
+        self,
+        height: int,
+        txs: list,
+        block_events: list,
+        extras: Optional[dict] = None,
+        block_events_flat=None,
+    ):
         self.height = height
         self.txs = txs  # [(index, tx_bytes, ExecTxResult)]
         self.block_events = block_events
+        self.extras = extras
+        self.block_events_flat = block_events_flat
 
 
 class IndexerService:
@@ -561,18 +624,33 @@ class IndexerService:
             blk = e.data["block"]
             with self._plock:
                 p = self._pending.setdefault(
-                    blk.height, {"txs": [], "events": [], "expected": None}
+                    blk.height,
+                    {"txs": [], "events": [], "expected": None,
+                     "extras": {}, "events_flat": None},
                 )
                 p["events"] = list(e.data.get("result_events") or [])
+                p["events_flat"] = e.data.get("events_flat")
                 p["expected"] = len(blk.data.txs)
                 bundle = self._maybe_seal_locked(blk.height)
         elif e.type_ == ev.EVENT_TX and isinstance(e.data, dict):
             d = e.data
             with self._plock:
                 p = self._pending.setdefault(
-                    d["height"], {"txs": [], "events": [], "expected": None}
+                    d["height"],
+                    {"txs": [], "events": [], "expected": None,
+                     "extras": {}, "events_flat": None},
                 )
                 p["txs"].append((d["index"], d["tx"], d["result"]))
+                if "tx_hash" in d:
+                    # the finalize lane's precomputed forms ride the
+                    # event data as optional keys (state/execution.py
+                    # _fire_events); keyed by index so the sort at
+                    # seal time can't misalign them
+                    p["extras"][d["index"]] = (
+                        d["tx_hash"],
+                        d.get("events_flat"),
+                        d.get("events_enc"),
+                    )
                 bundle = self._maybe_seal_locked(d["height"])
         if bundle is not None:
             self._seal(bundle)
@@ -589,7 +667,11 @@ class IndexerService:
         for h in [h for h in self._pending if h < height]:
             self._pending.pop(h, None)
         return HeightBundle(
-            height, sorted(p["txs"], key=lambda t: t[0]), p["events"]
+            height,
+            sorted(p["txs"], key=lambda t: t[0]),
+            p["events"],
+            extras=p.get("extras") or None,
+            block_events_flat=p.get("events_flat"),
         )
 
     def _seal(self, bundle: HeightBundle) -> None:
@@ -670,13 +752,22 @@ class IndexerService:
             with span:
                 if self._kv_db is not None:
                     sets: List[Tuple[bytes, bytes]] = []
+                    extras = bundle.extras or {}
                     for i, tx, res in bundle.txs:
+                        th, efl, een = extras.get(i) or (None, None, None)
                         sets.extend(
-                            self.tx_indexer.tx_sets(bundle.height, i, tx, res)
+                            self.tx_indexer.tx_sets(
+                                bundle.height, i, tx, res,
+                                tx_hash=th,
+                                events_flat=efl,
+                                events_enc=een,
+                            )
                         )
                     sets.extend(
                         self.block_indexer.block_sets(
-                            bundle.height, bundle.block_events
+                            bundle.height,
+                            bundle.block_events,
+                            events_flat=bundle.block_events_flat,
                         )
                     )
                     # marker advances CONTIGUOUSLY only: an

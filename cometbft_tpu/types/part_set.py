@@ -46,7 +46,16 @@ class PartSet:
         chunks = [
             data[i : i + part_size] for i in range(0, len(data), part_size)
         ] or [b""]
-        root, proofs = merkle.proofs_from_byte_slices(chunks)
+        # proposal-path leaf hashing rides the native finalize lane
+        # when built (sha256(0x00 || 64KB chunk) per part with the GIL
+        # released); proofs/root come out identical either way
+        from ..state import native_finalize
+
+        lh = native_finalize.part_leaf_hashes(chunks)
+        if lh is not None:
+            root, proofs = merkle.proofs_from_leaf_hashes(lh)
+        else:
+            root, proofs = merkle.proofs_from_byte_slices(chunks)
         ps = cls(PartSetHeader(total=len(chunks), hash=root))
         for i, (c, pr) in enumerate(zip(chunks, proofs)):
             ps.parts[i] = Part(index=i, bytes_=c, proof=pr)

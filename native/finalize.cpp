@@ -1,0 +1,610 @@
+// Native finalize lane (CPython extension): ONE GIL-releasing pass
+// per block over the finalize data path.
+//
+// PR 11 pipelined finalize but measured that the pure-Python
+// apply/hash leg cannot be threaded — it just fights the GIL — so it
+// stayed on-loop and became the dominant span of the commit
+// waterfall. This module moves exactly that leg's byte work to C++
+// behind a single call: per-tx SHA-256, ExecTxResult encoding, the
+// RFC 6962 LastResultsHash fold and ABCI event/attr encoding all run
+// with the GIL RELEASED (inputs are copied into a C++ arena first),
+// so consensus/state.py can ride the whole hash+persist phase on
+// asyncio.to_thread and the event loop keeps scheduling.
+//
+// Byte-parity contract: every output is byte-identical to the
+// pure-Python implementations in state/execution.py (results_hash,
+// _enc_abci_event, ExecTxResult.encode) — the Python path stays the
+// semantic source of truth and the no-compiler fallback
+// (state/native_finalize.py, differential-tested in
+// tests/test_native_finalize.py).
+//
+// The SHA-256 / proto-writer / merkle helpers mirror
+// native/wirecodec.cpp (same deterministic proto subset: zero
+// varints and empty bytes omitted, negatives as 64-bit two's
+// complement).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <dlfcn.h>
+
+#include <array>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace {
+
+// --- proto writer (mirror utils/proto.py) -------------------------------
+
+struct Buf {
+  std::vector<uint8_t> d;
+  void put_varint(uint64_t v) {
+    while (v >= 0x80) {
+      d.push_back((uint8_t)(v | 0x80));
+      v >>= 7;
+    }
+    d.push_back((uint8_t)v);
+  }
+  void put_tag(unsigned field, unsigned wire) {
+    put_varint((uint64_t)((field << 3) | wire));
+  }
+  // matches proto.field_varint: zero omitted; negatives two's-complement
+  void field_varint(unsigned field, int64_t v) {
+    if (v == 0) return;
+    put_tag(field, 0);
+    put_varint((uint64_t)v);
+  }
+  // matches proto.field_bytes / field_string: empty omitted
+  void field_bytes(unsigned field, const uint8_t* p, size_t n) {
+    if (n == 0) return;
+    put_tag(field, 2);
+    put_varint((uint64_t)n);
+    d.insert(d.end(), p, p + n);
+  }
+  void field_bytes(unsigned field, const std::string& s) {
+    field_bytes(field, (const uint8_t*)s.data(), s.size());
+  }
+};
+
+// --- SHA-256 (FIPS 180-4, from-spec; wirecodec.cpp twin) ----------------
+
+struct Sha256 {
+  uint32_t h[8];
+  uint8_t buf[64];
+  uint64_t len = 0;
+  size_t fill = 0;
+
+  static constexpr uint32_t K[64] = {
+      0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+      0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+      0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+      0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+      0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+      0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+      0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+      0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+      0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+      0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+      0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+      0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+      0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+  Sha256() { reset(); }
+  void reset() {
+    h[0] = 0x6a09e667; h[1] = 0xbb67ae85; h[2] = 0x3c6ef372;
+    h[3] = 0xa54ff53a; h[4] = 0x510e527f; h[5] = 0x9b05688c;
+    h[6] = 0x1f83d9ab; h[7] = 0x5be0cd19;
+    len = 0;
+    fill = 0;
+  }
+  static uint32_t rotr(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+  }
+  void block(const uint8_t* p) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = ((uint32_t)p[4 * i] << 24) | ((uint32_t)p[4 * i + 1] << 16) |
+             ((uint32_t)p[4 * i + 2] << 8) | p[4 * i + 3];
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 =
+          rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 =
+          rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+  void update(const uint8_t* p, size_t n) {
+    len += n;
+    if (fill) {
+      while (n && fill < 64) {
+        buf[fill++] = *p++;
+        n--;
+      }
+      if (fill == 64) {
+        block(buf);
+        fill = 0;
+      }
+    }
+    while (n >= 64) {
+      block(p);
+      p += 64;
+      n -= 64;
+    }
+    while (n) {
+      buf[fill++] = *p++;
+      n--;
+    }
+  }
+  void final(uint8_t out[32]) {
+    uint64_t bits = len * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t z = 0;
+    while (fill != 56) update(&z, 1);
+    uint8_t lb[8];
+    for (int i = 0; i < 8; i++) lb[i] = (uint8_t)(bits >> (56 - 8 * i));
+    update(lb, 8);
+    for (int i = 0; i < 8; i++) {
+      out[4 * i] = (uint8_t)(h[i] >> 24);
+      out[4 * i + 1] = (uint8_t)(h[i] >> 16);
+      out[4 * i + 2] = (uint8_t)(h[i] >> 8);
+      out[4 * i + 3] = (uint8_t)h[i];
+    }
+  }
+};
+constexpr uint32_t Sha256::K[64];
+
+// one-shot SHA256 via libcrypto when present (hardware SHA
+// extensions); portable fallback is the same function, so digests
+// are identical either way
+typedef unsigned char* (*fn_ossl_sha256)(const unsigned char*, size_t,
+                                         unsigned char*);
+
+static fn_ossl_sha256 ossl_sha256() {
+  static fn_ossl_sha256 fn = []() -> fn_ossl_sha256 {
+    const char* names[] = {"libcrypto.so.3", "libcrypto.so.1.1",
+                           "libcrypto.so"};
+    for (const char* n : names) {
+      if (void* lib = dlopen(n, RTLD_NOW | RTLD_GLOBAL)) {
+        if (void* sym = dlsym(lib, "SHA256"))
+          return reinterpret_cast<fn_ossl_sha256>(sym);
+      }
+    }
+    return nullptr;
+  }();
+  return fn;
+}
+
+static void sha256_oneshot(const uint8_t* p, size_t n, uint8_t out[32]) {
+  fn_ossl_sha256 fast = ossl_sha256();
+  if (fast) {
+    fast((const unsigned char*)p, n, out);
+    return;
+  }
+  Sha256 s;
+  s.update(p, n);
+  s.final(out);
+}
+
+static void leaf_hash(const uint8_t* p, size_t n, uint8_t out[32]) {
+  Sha256 s;
+  uint8_t pfx = 0x00;
+  s.update(&pfx, 1);
+  s.update(p, n);
+  s.final(out);
+}
+
+static void inner_hash(const uint8_t l[32], const uint8_t r[32],
+                       uint8_t out[32]) {
+  Sha256 s;
+  uint8_t pfx = 0x01;
+  s.update(&pfx, 1);
+  s.update(l, 32);
+  s.update(r, 32);
+  s.final(out);
+}
+
+// binary-carry RFC 6962 reduction (crypto/merkle.hash_from_byte_slices)
+struct TreeAcc {
+  std::vector<std::pair<std::array<uint8_t, 32>, size_t>> stack;
+  void push_leaf(const uint8_t* p, size_t n) {
+    std::array<uint8_t, 32> h;
+    leaf_hash(p, n, h.data());
+    size_t s = 1;
+    while (!stack.empty() && stack.back().second == s) {
+      std::array<uint8_t, 32> m;
+      inner_hash(stack.back().first.data(), h.data(), m.data());
+      stack.pop_back();
+      h = m;
+      s *= 2;
+    }
+    stack.emplace_back(h, s);
+  }
+  void root(uint8_t out[32]) {
+    if (stack.empty()) {  // empty tree: SHA-256("")
+      Sha256 s;
+      s.final(out);
+      return;
+    }
+    std::array<uint8_t, 32> h = stack.back().first;
+    stack.pop_back();
+    while (!stack.empty()) {
+      std::array<uint8_t, 32> m;
+      inner_hash(stack.back().first.data(), h.data(), m.data());
+      stack.pop_back();
+      h = m;
+    }
+    std::memcpy(out, h.data(), 32);
+  }
+};
+
+// --- copy-in arena ------------------------------------------------------
+//
+// Everything below the GIL line works on these plain structs only; no
+// Python object is touched between Py_BEGIN/END_ALLOW_THREADS.
+
+struct AttrIn {
+  std::string k, v;
+  int64_t idx;
+};
+
+struct EventIn {
+  std::string type;
+  std::vector<AttrIn> attrs;
+};
+
+struct ResultIn {
+  int64_t code, gas_wanted, gas_used;
+  std::string data, codespace;
+  std::vector<EventIn> events;
+};
+
+static bool copy_str(PyObject* o, std::string* out) {
+  char* p;
+  Py_ssize_t n;
+  if (PyBytes_AsStringAndSize(o, &p, &n) < 0) return false;
+  out->assign(p, (size_t)n);
+  return true;
+}
+
+static bool copy_i64(PyObject* o, int64_t* out) {
+  *out = (int64_t)PyLong_AsLongLong(o);
+  return !PyErr_Occurred();
+}
+
+// events: sequence of (type_bytes, [(k_bytes, v_bytes, idx_int), ...])
+static bool copy_events(PyObject* events, std::vector<EventIn>* out) {
+  PyObject* seq = PySequence_Fast(events, "events must be a sequence");
+  if (!seq) return false;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  out->resize((size_t)n);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* ev = PySequence_Fast_GET_ITEM(seq, i);
+    PyObject* tseq = PySequence_Fast(ev, "event must be a tuple");
+    if (!tseq) {
+      Py_DECREF(seq);
+      return false;
+    }
+    if (PySequence_Fast_GET_SIZE(tseq) < 2) {
+      Py_DECREF(tseq);
+      Py_DECREF(seq);
+      PyErr_SetString(PyExc_ValueError, "event tuple needs 2 items");
+      return false;
+    }
+    EventIn& e = (*out)[(size_t)i];
+    if (!copy_str(PySequence_Fast_GET_ITEM(tseq, 0), &e.type)) {
+      Py_DECREF(tseq);
+      Py_DECREF(seq);
+      return false;
+    }
+    PyObject* aseq = PySequence_Fast(
+        PySequence_Fast_GET_ITEM(tseq, 1), "attrs must be a sequence");
+    if (!aseq) {
+      Py_DECREF(tseq);
+      Py_DECREF(seq);
+      return false;
+    }
+    Py_ssize_t na = PySequence_Fast_GET_SIZE(aseq);
+    e.attrs.resize((size_t)na);
+    for (Py_ssize_t j = 0; j < na; j++) {
+      PyObject* at = PySequence_Fast_GET_ITEM(aseq, j);
+      PyObject* atseq = PySequence_Fast(at, "attr must be a tuple");
+      if (!atseq || PySequence_Fast_GET_SIZE(atseq) < 3) {
+        Py_XDECREF(atseq);
+        Py_DECREF(aseq);
+        Py_DECREF(tseq);
+        Py_DECREF(seq);
+        if (!PyErr_Occurred())
+          PyErr_SetString(PyExc_ValueError, "attr tuple needs 3 items");
+        return false;
+      }
+      AttrIn& a = e.attrs[(size_t)j];
+      if (!copy_str(PySequence_Fast_GET_ITEM(atseq, 0), &a.k) ||
+          !copy_str(PySequence_Fast_GET_ITEM(atseq, 1), &a.v) ||
+          !copy_i64(PySequence_Fast_GET_ITEM(atseq, 2), &a.idx)) {
+        Py_DECREF(atseq);
+        Py_DECREF(aseq);
+        Py_DECREF(tseq);
+        Py_DECREF(seq);
+        return false;
+      }
+      Py_DECREF(atseq);
+    }
+    Py_DECREF(aseq);
+    Py_DECREF(tseq);
+  }
+  Py_DECREF(seq);
+  return true;
+}
+
+// mirror state/execution._enc_abci_event over the flattened form
+static void encode_event(const EventIn& e, Buf* out) {
+  out->field_bytes(1, e.type);
+  Buf sub;
+  for (const AttrIn& a : e.attrs) {
+    sub.d.clear();
+    sub.field_bytes(1, a.k);
+    sub.field_bytes(2, a.v);
+    sub.field_varint(3, a.idx ? 1 : 0);
+    out->field_bytes(2, sub.d.data(), sub.d.size());
+  }
+}
+
+// mirror abci.ExecTxResult.encode (fields 1, 2, 5, 6, 8)
+static void encode_result(const ResultIn& r, Buf* out) {
+  out->field_varint(1, r.code);
+  out->field_bytes(2, r.data);
+  out->field_varint(5, r.gas_wanted);
+  out->field_varint(6, r.gas_used);
+  out->field_bytes(8, r.codespace);
+}
+
+static PyObject* bytes_from(const std::vector<uint8_t>& v) {
+  return PyBytes_FromStringAndSize((const char*)v.data(),
+                                   (Py_ssize_t)v.size());
+}
+
+// --- finalize_pass ------------------------------------------------------
+//
+// finalize_pass(txs, results) ->
+//     (tx_hashes, results_enc, results_hash, tx_events_enc)
+//
+//   txs:      sequence[bytes]
+//   results:  sequence[(code, data, gas_wanted, gas_used,
+//                       codespace_bytes, events)]
+//   events:   sequence[(type_bytes, [(k, v, idx), ...])]
+//
+//   tx_hashes:     list[bytes32]        sha256(tx) per tx
+//   results_enc:   list[bytes]          ExecTxResult.encode() per result
+//   results_hash:  bytes32              RFC 6962 root over results_enc
+//   tx_events_enc: list[list[bytes]]    _enc_abci_event per event per tx
+//
+// Inputs are copied into a C++ arena under the GIL; ALL hashing and
+// encoding then runs with the GIL released.
+static PyObject* fz_finalize_pass(PyObject*, PyObject* args) {
+  PyObject* txs_o;
+  PyObject* results_o;
+  if (!PyArg_ParseTuple(args, "OO", &txs_o, &results_o)) return nullptr;
+
+  // copy-in: txs
+  std::vector<std::string> txs;
+  {
+    PyObject* seq = PySequence_Fast(txs_o, "txs must be a sequence");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    txs.resize((size_t)n);
+    for (Py_ssize_t i = 0; i < n; i++) {
+      if (!copy_str(PySequence_Fast_GET_ITEM(seq, i), &txs[(size_t)i])) {
+        Py_DECREF(seq);
+        return nullptr;
+      }
+    }
+    Py_DECREF(seq);
+  }
+
+  // copy-in: results
+  std::vector<ResultIn> results;
+  {
+    PyObject* seq =
+        PySequence_Fast(results_o, "results must be a sequence");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    results.resize((size_t)n);
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject* r = PySequence_Fast_GET_ITEM(seq, i);
+      PyObject* rseq = PySequence_Fast(r, "result must be a tuple");
+      if (!rseq) {
+        Py_DECREF(seq);
+        return nullptr;
+      }
+      if (PySequence_Fast_GET_SIZE(rseq) < 6) {
+        Py_DECREF(rseq);
+        Py_DECREF(seq);
+        PyErr_SetString(PyExc_ValueError, "result tuple needs 6 items");
+        return nullptr;
+      }
+      ResultIn& ri = results[(size_t)i];
+      if (!copy_i64(PySequence_Fast_GET_ITEM(rseq, 0), &ri.code) ||
+          !copy_str(PySequence_Fast_GET_ITEM(rseq, 1), &ri.data) ||
+          !copy_i64(PySequence_Fast_GET_ITEM(rseq, 2), &ri.gas_wanted) ||
+          !copy_i64(PySequence_Fast_GET_ITEM(rseq, 3), &ri.gas_used) ||
+          !copy_str(PySequence_Fast_GET_ITEM(rseq, 4), &ri.codespace) ||
+          !copy_events(PySequence_Fast_GET_ITEM(rseq, 5), &ri.events)) {
+        Py_DECREF(rseq);
+        Py_DECREF(seq);
+        return nullptr;
+      }
+      Py_DECREF(rseq);
+    }
+    Py_DECREF(seq);
+  }
+
+  // compute: GIL released — no Python object is touched in here
+  std::vector<std::array<uint8_t, 32>> tx_hashes(txs.size());
+  std::vector<std::vector<uint8_t>> res_enc(results.size());
+  std::vector<std::vector<std::vector<uint8_t>>> ev_enc(results.size());
+  uint8_t root[32];
+  Py_BEGIN_ALLOW_THREADS;
+  for (size_t i = 0; i < txs.size(); i++)
+    sha256_oneshot((const uint8_t*)txs[i].data(), txs[i].size(),
+                   tx_hashes[i].data());
+  TreeAcc acc;
+  Buf b;
+  for (size_t i = 0; i < results.size(); i++) {
+    b.d.clear();
+    encode_result(results[i], &b);
+    res_enc[i] = b.d;
+    acc.push_leaf(b.d.data(), b.d.size());
+    ev_enc[i].resize(results[i].events.size());
+    for (size_t j = 0; j < results[i].events.size(); j++) {
+      b.d.clear();
+      encode_event(results[i].events[j], &b);
+      ev_enc[i][j] = b.d;
+    }
+  }
+  acc.root(root);
+  Py_END_ALLOW_THREADS;
+
+  // copy-out
+  PyObject* hashes = PyList_New((Py_ssize_t)tx_hashes.size());
+  PyObject* encs = PyList_New((Py_ssize_t)res_enc.size());
+  PyObject* evs = PyList_New((Py_ssize_t)ev_enc.size());
+  PyObject* root_b = PyBytes_FromStringAndSize((const char*)root, 32);
+  if (!hashes || !encs || !evs || !root_b) goto oom;
+  for (size_t i = 0; i < tx_hashes.size(); i++) {
+    PyObject* h =
+        PyBytes_FromStringAndSize((const char*)tx_hashes[i].data(), 32);
+    if (!h) goto oom;
+    PyList_SET_ITEM(hashes, (Py_ssize_t)i, h);
+  }
+  for (size_t i = 0; i < res_enc.size(); i++) {
+    PyObject* e = bytes_from(res_enc[i]);
+    if (!e) goto oom;
+    PyList_SET_ITEM(encs, (Py_ssize_t)i, e);
+  }
+  for (size_t i = 0; i < ev_enc.size(); i++) {
+    PyObject* per_tx = PyList_New((Py_ssize_t)ev_enc[i].size());
+    if (!per_tx) goto oom;
+    PyList_SET_ITEM(evs, (Py_ssize_t)i, per_tx);
+    for (size_t j = 0; j < ev_enc[i].size(); j++) {
+      PyObject* e = bytes_from(ev_enc[i][j]);
+      if (!e) goto oom;
+      PyList_SET_ITEM(per_tx, (Py_ssize_t)j, e);
+    }
+  }
+  return Py_BuildValue("(NNNN)", hashes, encs, root_b, evs);
+oom:
+  Py_XDECREF(hashes);
+  Py_XDECREF(encs);
+  Py_XDECREF(evs);
+  Py_XDECREF(root_b);
+  return nullptr;
+}
+
+// encode_events(events) -> list[bytes]: _enc_abci_event per event
+// over the flattened form (block-level events ride this; the per-tx
+// events ride finalize_pass). GIL released for the encode loop.
+static PyObject* fz_encode_events(PyObject*, PyObject* args) {
+  PyObject* events_o;
+  if (!PyArg_ParseTuple(args, "O", &events_o)) return nullptr;
+  std::vector<EventIn> events;
+  if (!copy_events(events_o, &events)) return nullptr;
+  std::vector<std::vector<uint8_t>> enc(events.size());
+  Py_BEGIN_ALLOW_THREADS;
+  Buf b;
+  for (size_t i = 0; i < events.size(); i++) {
+    b.d.clear();
+    encode_event(events[i], &b);
+    enc[i] = b.d;
+  }
+  Py_END_ALLOW_THREADS;
+  PyObject* out = PyList_New((Py_ssize_t)enc.size());
+  if (!out) return nullptr;
+  for (size_t i = 0; i < enc.size(); i++) {
+    PyObject* e = bytes_from(enc[i]);
+    if (!e) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, (Py_ssize_t)i, e);
+  }
+  return out;
+}
+
+// leaf_hashes(items) -> list[bytes32]: RFC 6962 leaf hash
+// sha256(0x00 || item) per item, GIL released — the proposal path's
+// block-part hashing (types/part_set.py PartSet.from_data feeds the
+// 64KB part chunks through here; merkle.proofs_from_leaf_hashes
+// builds identical proofs over the precomputed leaves).
+static PyObject* fz_leaf_hashes(PyObject*, PyObject* args) {
+  PyObject* items_o;
+  if (!PyArg_ParseTuple(args, "O", &items_o)) return nullptr;
+  std::vector<std::string> items;
+  {
+    PyObject* seq = PySequence_Fast(items_o, "items must be a sequence");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    items.resize((size_t)n);
+    for (Py_ssize_t i = 0; i < n; i++) {
+      if (!copy_str(PySequence_Fast_GET_ITEM(seq, i),
+                    &items[(size_t)i])) {
+        Py_DECREF(seq);
+        return nullptr;
+      }
+    }
+    Py_DECREF(seq);
+  }
+  std::vector<std::array<uint8_t, 32>> hashes(items.size());
+  Py_BEGIN_ALLOW_THREADS;
+  for (size_t i = 0; i < items.size(); i++)
+    leaf_hash((const uint8_t*)items[i].data(), items[i].size(),
+              hashes[i].data());
+  Py_END_ALLOW_THREADS;
+  PyObject* out = PyList_New((Py_ssize_t)hashes.size());
+  if (!out) return nullptr;
+  for (size_t i = 0; i < hashes.size(); i++) {
+    PyObject* h =
+        PyBytes_FromStringAndSize((const char*)hashes[i].data(), 32);
+    if (!h) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, (Py_ssize_t)i, h);
+  }
+  return out;
+}
+
+static PyMethodDef Methods[] = {
+    {"finalize_pass", fz_finalize_pass, METH_VARARGS,
+     "finalize_pass(txs, results) -> (tx_hashes, results_enc, "
+     "results_hash, tx_events_enc); one GIL-releasing pass"},
+    {"encode_events", fz_encode_events, METH_VARARGS,
+     "encode_events(events) -> list[bytes] (_enc_abci_event form)"},
+    {"leaf_hashes", fz_leaf_hashes, METH_VARARGS,
+     "leaf_hashes(items) -> list of RFC 6962 leaf hashes"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static struct PyModuleDef Module = {PyModuleDef_HEAD_INIT, "_finalize",
+                                    nullptr, -1, Methods};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__finalize(void) {
+  return PyModule_Create(&Module);
+}

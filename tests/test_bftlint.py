@@ -52,6 +52,7 @@ FIXTURE_PATHS = {
     "ASY120": "cometbft_tpu/store/x.py",
     "ASY121": "cometbft_tpu/blocksync/x.py",
     "ASY122": "cometbft_tpu/fleet/x.py",
+    "ASY123": "cometbft_tpu/state/x.py",
 }
 
 
@@ -707,6 +708,40 @@ FIXTURES = [
             replica.light_plane.drain(5.0)
             replica.light_plane.resume()
             return replica.light_plane.stats()
+        """,
+    ),
+    (
+        "ASY123",  # per-item-hash-in-finalize-path: a for-loop
+        # hashing per tx reached from a finalize phase root — the
+        # host overhead the native finalize lane batches away
+        """
+        import hashlib
+        class Exec:
+            def apply_block(self, state, block):
+                resp = self.proxy.finalize_block(block)
+                self._persist(block, resp)
+            def _persist(self, block, resp):
+                hashes = []
+                for tx in block.txs:
+                    hashes.append(hashlib.sha256(tx).digest())
+                self.store.save(block.height, hashes)
+        """,
+        """
+        import hashlib
+        from cometbft_tpu.state import native_finalize
+        class Exec:
+            def apply_block(self, state, block):
+                resp = self.proxy.finalize_block(block)
+                # sanctioned shape: ONE batched native pass, the
+                # artifacts carry every per-item derivation
+                arts = native_finalize.finalize_pass(block.txs, resp)
+                self._persist(block, arts)
+            def _persist(self, block, arts):
+                self.store.save(block.height, arts.results_hash)
+            def decode_rows(self, rows):
+                # not finalize-reachable: per-item work off the
+                # apply path is out of scope
+                return [hashlib.sha256(r).digest() for r in rows]
         """,
     ),
     (

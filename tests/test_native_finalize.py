@@ -1,0 +1,340 @@
+"""Native finalize lane vs pure Python (native/finalize.cpp).
+
+The one GIL-releasing finalize pass — per-tx SHA-256, ExecTxResult
+encodes, LastResultsHash, ABCI event encodes, part leaf hashes — must
+be byte-identical to the portable Python twin AND to the pre-lane
+implementations it replaced (execution.results_hash, _enc_abci_event,
+r.encode(), hashlib.sha256). The portable path stays the semantic
+source of truth and the no-compiler fallback; the loader mirrors the
+wirecodec prewarm discipline and must degrade gracefully around a
+corrupted build artifact (the crash-mid-build test below).
+
+Native-backed cases skip cleanly when the extension cannot build; the
+portable/degraded-path cases always run.
+"""
+
+import hashlib
+import os
+import random
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.crypto import merkle
+from cometbft_tpu.state import execution, native_finalize
+from cometbft_tpu.state.execution import (
+    _enc_abci_event,
+    decode_finalize_response,
+    encode_finalize_response,
+)
+from cometbft_tpu.state.indexer import _enc_tx_result
+
+nat = native_finalize.module()
+needs_native = pytest.mark.skipif(
+    nat is None, reason="native finalize unavailable (no compiler)"
+)
+
+rng = random.Random(20)
+
+
+def _rand_attr(i):
+    roll = rng.random()
+    if roll < 0.2:
+        return (b"bk%d" % i, b"bv%d" % i)  # bare 2-tuple, idx=True
+    if roll < 0.4:
+        return ("k%d" % i, "vé%d" % i, rng.random() < 0.5)  # unicode
+    return abci.EventAttribute(
+        key="key%d" % i,
+        value="value-%d" % rng.randrange(1000),
+        index=rng.random() < 0.7,
+    )
+
+
+def _rand_event():
+    return abci.Event(
+        type_=rng.choice(["app", "transfer", "vént", ""]),
+        attributes=[_rand_attr(i) for i in range(rng.randrange(0, 4))],
+    )
+
+
+def _rand_result(force_empty_events=False):
+    return abci.ExecTxResult(
+        code=rng.choice([0, 0, 0, 1, 5]),
+        data=bytes(rng.randbytes(rng.randrange(0, 24))),
+        gas_wanted=rng.randrange(0, 2**40),
+        gas_used=rng.randrange(0, 2**40),
+        codespace=rng.choice(["", "", "bank", "cøde"]),
+        events=(
+            []
+            if force_empty_events
+            else [_rand_event() for _ in range(rng.randrange(0, 3))]
+        ),
+    )
+
+
+def _rand_block(n_txs=None):
+    n = rng.randrange(0, 9) if n_txs is None else n_txs
+    txs = [bytes(rng.randbytes(rng.randrange(0, 64))) for _ in range(n)]
+    # force some empty-event txs so the index-keyed field-5 alignment
+    # (skip-by-index) is always exercised
+    results = [
+        _rand_result(force_empty_events=(i % 3 == 1)) for i in range(n)
+    ]
+    resp = abci.ResponseFinalizeBlock(
+        events=[_rand_event() for _ in range(rng.randrange(0, 3))],
+        tx_results=results,
+        app_hash=bytes(rng.randbytes(32)),
+    )
+    return txs, resp
+
+
+def _check_parity(txs, resp, arts):
+    """arts (either backend) against the pre-lane derivations."""
+    assert arts.tx_hashes == [hashlib.sha256(t).digest() for t in txs]
+    assert arts.results_enc == [r.encode() for r in resp.tx_results]
+    assert arts.results_hash == execution.results_hash(resp.tx_results)
+    assert arts.tx_events_enc == [
+        [_enc_abci_event(e) for e in r.events] for r in resp.tx_results
+    ]
+    assert arts.block_events_enc == [
+        _enc_abci_event(e) for e in resp.events
+    ]
+
+
+# --- differential fuzz -------------------------------------------------
+
+
+@needs_native
+def test_native_vs_portable_byte_identical():
+    for _ in range(40):
+        txs, resp = _rand_block()
+        a_nat = native_finalize.finalize_pass(txs, resp)
+        a_py = native_finalize.finalize_pass(txs, resp, portable=True)
+        assert a_nat.native and not a_py.native
+        for attr in (
+            "tx_hashes",
+            "results_enc",
+            "results_hash",
+            "tx_events_flat",
+            "tx_events_enc",
+            "block_events_flat",
+            "block_events_enc",
+        ):
+            assert getattr(a_nat, attr) == getattr(a_py, attr), attr
+        _check_parity(txs, resp, a_nat)
+
+
+def test_portable_pass_matches_legacy_derivations():
+    """The degraded (no-g++) path: portable artifacts must equal the
+    pre-lane per-item implementations byte for byte."""
+    for _ in range(25):
+        txs, resp = _rand_block()
+        arts = native_finalize.finalize_pass(txs, resp, portable=True)
+        _check_parity(txs, resp, arts)
+
+
+def test_encode_finalize_response_artifacts_identical():
+    """Stored-response bytes with artifacts == without, and the
+    decode roundtrip (incl. index-keyed empty-event alignment)."""
+    for portable in (True, False):
+        for _ in range(20):
+            txs, resp = _rand_block()
+            arts = native_finalize.finalize_pass(
+                txs, resp, portable=portable
+            )
+            plain = encode_finalize_response(resp)
+            with_arts = encode_finalize_response(resp, arts)
+            assert plain == with_arts
+            back = decode_finalize_response(with_arts)
+            assert [r.encode() for r in back.tx_results] == [
+                r.encode() for r in resp.tx_results
+            ]
+            assert [
+                [_enc_abci_event(e) for e in r.events]
+                for r in back.tx_results
+            ] == [
+                [_enc_abci_event(e) for e in r.events]
+                for r in resp.tx_results
+            ]
+
+
+def test_enc_tx_result_precomputed_events_identical():
+    for _ in range(20):
+        r = _rand_result()
+        enc = [_enc_abci_event(e) for e in r.events]
+        assert _enc_tx_result(r, enc) == _enc_tx_result(r)
+
+
+def test_indexer_rows_with_precomputed_forms_identical():
+    from cometbft_tpu.utils.kv import MemKV
+
+    from cometbft_tpu.state.indexer import BlockIndexer, TxIndexer
+
+    txi = TxIndexer(MemKV())
+    bi = BlockIndexer(MemKV())
+    for _ in range(15):
+        txs, resp = _rand_block(n_txs=4)
+        arts = native_finalize.finalize_pass(txs, resp, portable=True)
+        for i, tx in enumerate(txs):
+            plain = txi.tx_sets(7, i, tx, resp.tx_results[i])
+            pre = txi.tx_sets(
+                7, i, tx, resp.tx_results[i],
+                tx_hash=arts.tx_hashes[i],
+                events_flat=arts.tx_events_flat[i],
+                events_enc=arts.tx_events_enc[i],
+            )
+            assert plain == pre
+        assert bi.block_sets(7, resp.events) == bi.block_sets(
+            7, resp.events, events_flat=arts.block_events_flat
+        )
+
+
+def test_flatten_events_single_pass_form():
+    evs = [_rand_event() for _ in range(6)]
+    flat = native_finalize.flatten_events(evs)
+    assert [native_finalize.encode_event_flat(fe) for fe in flat] == [
+        _enc_abci_event(e) for e in evs
+    ]
+
+
+# --- part hashing ------------------------------------------------------
+
+
+@needs_native
+def test_part_leaf_hashes_native_parity():
+    chunks = [bytes(rng.randbytes(n)) for n in (0, 1, 100, 65536, 7)]
+    lh = native_finalize.part_leaf_hashes(chunks)
+    assert lh == [merkle.leaf_hash(c) for c in chunks]
+
+
+def test_proofs_from_leaf_hashes_identical():
+    for n in (1, 2, 3, 5, 8, 13):
+        items = [bytes(rng.randbytes(50)) for _ in range(n)]
+        r1, p1 = merkle.proofs_from_byte_slices(items)
+        r2, p2 = merkle.proofs_from_leaf_hashes(
+            [merkle.leaf_hash(it) for it in items]
+        )
+        assert r1 == r2
+        assert p1 == p2
+        assert r1 == merkle.hash_from_byte_slices(items)
+        for i, p in enumerate(p2):
+            assert p.verify(r2, items[i])
+
+
+def test_partset_from_data_matches_python_proofs(monkeypatch):
+    """PartSet.from_data must produce identical header/proofs whether
+    the native leaf hasher engaged or not."""
+    from cometbft_tpu.types.part_set import PartSet
+
+    data = bytes(rng.randbytes(3 * 65536 + 123))
+    ps_maybe_native = PartSet.from_data(data)
+    monkeypatch.setattr(native_finalize, "_mod", None)
+    monkeypatch.setattr(native_finalize, "_tried", True)
+    ps_py = PartSet.from_data(data)
+    assert ps_maybe_native.header == ps_py.header
+    for a, b in zip(ps_maybe_native.parts, ps_py.parts):
+        assert (a.index, a.bytes_, a.proof) == (b.index, b.bytes_, b.proof)
+
+
+# --- loader discipline (crash-mid-build, prewarm, env gate) ------------
+
+
+def _fresh_loader_state(monkeypatch, so_path):
+    monkeypatch.setattr(native_finalize, "_SO", str(so_path))
+    monkeypatch.setattr(native_finalize, "_mod", None)
+    monkeypatch.setattr(native_finalize, "_tried", False)
+
+
+def test_corrupt_build_artifact_degrades_then_recovers(
+    tmp_path, monkeypatch
+):
+    """Crash-mid-build shape (mirrors the wirecodec discipline): a
+    truncated/garbage .so left by a killed build must not take the
+    node down — module() returns None, every caller keeps the
+    byte-identical portable path — and a later clean build recovers."""
+    so = tmp_path / "_finalize.so"
+    so.write_bytes(b"\x7fELFgarbage-not-a-real-object")
+    # make the artifact look NEWER than the source so the loader
+    # tries to load it as-is instead of rebuilding over it
+    src_mtime = os.path.getmtime(native_finalize._SRC)
+    os.utime(so, (src_mtime + 60, src_mtime + 60))
+    _fresh_loader_state(monkeypatch, so)
+    assert native_finalize.module() is None
+    assert native_finalize._tried  # no retry storm on the hot path
+    txs, resp = _rand_block(n_txs=3)
+    arts = native_finalize.finalize_pass(txs, resp)
+    assert not arts.native
+    _check_parity(txs, resp, arts)
+    if nat is None:
+        return  # no compiler: recovery leg can't build
+    # operator clears the corrupt artifact; the next cold start's
+    # prewarm rebuilds and the lane comes back
+    so.unlink()
+    _fresh_loader_state(monkeypatch, so)
+    t = native_finalize.prewarm()
+    assert t is not None
+    t.join(120)
+    mod = native_finalize.module()
+    assert mod is not None
+    a_nat = native_finalize.finalize_pass(txs, resp)
+    assert a_nat.native
+    assert a_nat.results_hash == arts.results_hash
+    assert a_nat.results_enc == arts.results_enc
+
+
+def test_env_gate_disables_native(tmp_path, monkeypatch):
+    monkeypatch.setenv("GRAFT_NATIVE_FINALIZE", "0")
+    _fresh_loader_state(monkeypatch, tmp_path / "_finalize.so")
+    assert native_finalize.module() is None
+    txs, resp = _rand_block(n_txs=2)
+    arts = native_finalize.finalize_pass(txs, resp)
+    assert not arts.native
+    _check_parity(txs, resp, arts)
+
+
+def test_prewarm_is_idempotent_once_tried(monkeypatch):
+    monkeypatch.setattr(native_finalize, "_tried", True)
+    assert native_finalize.prewarm() is None
+
+
+# --- vectorized hot-state apply ----------------------------------------
+
+
+def test_vecbank_scalar_vs_vector_digest_identical():
+    from cometbft_tpu.models.vecbank import (
+        VecBankApplication,
+        make_block_txs,
+        make_transfer,
+    )
+
+    r = random.Random(11)
+    vec = VecBankApplication(n_accounts=512)
+    ser = VecBankApplication(n_accounts=512, scalar=True)
+    if vec._np is None:
+        pytest.skip("numpy unavailable")
+    assert vec.app_hash == ser.app_hash
+    for h in range(1, 8):
+        txs = make_block_txs(r, 64, 512)
+        txs.append(b"bogus")  # invalid length
+        txs.append(make_transfer(9999, 0, 5))  # out-of-range account
+        ra = vec.finalize_block(
+            abci.RequestFinalizeBlock(txs=txs, height=h)
+        )
+        rb = ser.finalize_block(
+            abci.RequestFinalizeBlock(txs=txs, height=h)
+        )
+        assert ra.app_hash == rb.app_hash
+        assert [t.code for t in ra.tx_results] == [
+            t.code for t in rb.tx_results
+        ]
+        vec.commit()
+        ser.commit()
+    assert vec.height == ser.height == 7
+    # wraparound transfer: commutativity holds mod 2^64 either way
+    big = make_transfer(1, 2, (1 << 64) - 3)
+    for app in (vec, ser):
+        app.finalize_block(
+            abci.RequestFinalizeBlock(txs=[big, big], height=8)
+        )
+        app.commit()
+    assert vec.app_hash == ser.app_hash

@@ -115,6 +115,29 @@ JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos --seed "$SEED" --fastpath \
     --trace-dump "$TRACE_DIR/fastpath_run" --budget
 python -m cometbft_tpu.trace timeline "$TRACE_DIR/fastpath_run" --strict
 
+echo "== chaos smoke: native finalize lane under faults (fastpath matrix, strict waterfalls) =="
+# the native finalize lane (ISSUE 20, docs/PERF.md "Native finalize
+# lane"): the fastpath matrix again with the lane explicitly
+# exercised — the extension is resolved UP FRONT (off the schedules;
+# the prewarm discipline), then every fastpath node finalizes through
+# one GIL-releasing finalize_pass on the offloaded thread hop. The
+# changed span shape (consensus.finalize.hash_persist riding inside
+# the pipelined finalize, docs/TRACE.md) must keep per-height commit
+# attribution complete on EVERY scenario (--strict exits 3 on a gap)
+# and the span budgets clean (exit 2). On a no-g++ box the loader
+# degrades to the byte-identical portable twin and the same gates
+# still hold — that is the lane's contract, not a skip.
+if JAX_PLATFORMS=cpu python -c 'from cometbft_tpu.state import native_finalize as nf; raise SystemExit(0 if nf.module() is not None else 1)'; then
+    echo "   native finalize extension: built + loaded"
+else
+    echo "   native finalize extension: UNAVAILABLE (portable twin carries the slice)"
+fi
+JAX_PLATFORMS=cpu python -m cometbft_tpu.chaos matrix --seed "$SEED" \
+    --count 3 --fastpath --budget --trace-dump "$TRACE_DIR/native_lane"
+for d in "$TRACE_DIR/native_lane"/m*-*; do
+    python -m cometbft_tpu.trace timeline "$d" --strict
+done
+
 echo "== chaos smoke: 5-scenario factory matrix, budget-gated =="
 # seeded workload x network x lifecycle matrix (docs/CHAOS.md
 # "Scenario factory"): any 5-window covers crash_wave,
